@@ -1,0 +1,27 @@
+"""TPU-native QLDPC fault-tolerance simulation framework.
+
+A ground-up JAX/XLA rebuild of the capabilities of
+deltaXdeltaQ/QLDPC_Fault_Tolerance: logical-error-rate / threshold /
+effective-distance estimation for CSS LDPC codes under code-capacity,
+phenomenological, and circuit-level noise, with BP / BP+OSD and space-time
+decoders.
+
+Layers (bottom to top):
+  codes/     CSS code objects, GF(2) linalg, HGP construction, loaders, code gen
+  ops/       TPU kernels: batched min-sum/product-sum BP, GF(2) matmul
+  noise/     PRNG-keyed error samplers (pure JAX)
+  decoders/  decoder objects + factory classes (params-dict contract of the
+             reference's DecoderClass.GetDecoder), host C++ OSD fallback
+  circuits/  circuit IR, CX scheduling, noise plugin, TPU Pauli-frame detector
+             sampler, detector-error-model extraction
+  sim/       Monte-Carlo engines (data / phenom / phenom-ST / circuit / circuit-ST)
+  parallel/  device-mesh sharding of the shot/grid axes
+  sweep/     code-family orchestration, threshold & distance fits
+  compat/    drop-in shims for the reference module/API names
+"""
+
+__version__ = "0.1.0"
+
+from . import codes
+
+__all__ = ["codes", "__version__"]

@@ -1,0 +1,146 @@
+"""Loaders for the code assets in the reference's ``codes_lib/``.
+
+The reference persists codes as pickled bposd.hgp objects, ``.mat`` Hx/Hz
+pairs, and ``.npy``/``.txt`` matrices (reference src/Simulators.py:65-71 and
+notebook cells).  The pickles reference bposd classes; ``load_pickle_code``
+unpickles them without bposd installed by shimming the class lookup and then
+rebuilding a CssCode from the stored arrays.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from . import gf2
+from .css import CssCode
+
+__all__ = [
+    "load_pickle_code",
+    "load_mat_pair",
+    "load_npy_pair",
+    "save_code",
+    "load_code",
+    "load_object",
+    "save_object",
+]
+
+
+class _Shim:
+    """Absorbs the state of any unpicklable class instance."""
+
+    def __init__(self, *a, **k):
+        pass
+
+
+class _PermissiveUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        try:
+            return super().find_class(module, name)
+        except Exception:
+            return type(name, (_Shim,), {"__module__": module})
+
+
+def load_object(filename: str):
+    """Reference-compatible load_object (src/Simulators.py:69-71), tolerant of
+    missing third-party modules inside the pickle."""
+    with open(filename, "rb") as f:
+        return _PermissiveUnpickler(f).load()
+
+
+def save_object(obj, filename: str) -> None:
+    """Reference-compatible save_object (src/Simulators.py:65-67)."""
+    with open(filename, "wb") as f:
+        pickle.dump(obj, f, pickle.HIGHEST_PROTOCOL)
+
+
+def load_pickle_code(path: str) -> CssCode:
+    """Load a pickled code object (e.g. codes_lib/hgp_34_n225.pkl) into a CssCode."""
+    obj = load_object(path)
+    d = obj if isinstance(obj, dict) else obj.__dict__
+    kwargs = {}
+    for key in ("hx", "hz", "lx", "lz"):
+        v = d.get(key)
+        if v is None:
+            continue
+        if hasattr(v, "toarray"):
+            v = v.toarray()
+        kwargs[key] = gf2.to_gf2(v)
+    code = CssCode(name=os.path.splitext(os.path.basename(path))[0], **kwargs)
+    if "D" in d and d["D"] is not None:
+        try:
+            code.D = int(d["D"])
+        except (TypeError, ValueError):
+            pass
+    return code
+
+
+def _mat_matrix(path: str) -> np.ndarray:
+    from scipy.io import loadmat
+
+    data = loadmat(path)
+    keys = [k for k in data if not k.startswith("__")]
+    if len(keys) != 1:
+        raise ValueError(f"expected one matrix in {path}, found keys {keys}")
+    m = data[keys[0]]
+    if hasattr(m, "toarray"):
+        m = m.toarray()
+    return gf2.to_gf2(m)
+
+
+def load_mat_pair(hx_path: str, hz_path: str | None = None, name: str = "") -> CssCode:
+    """Load an Hx/Hz ``.mat`` pair (GB codes A1-A4, LP codes; notebook cells 7-8)."""
+    if hz_path is None:
+        if "_hx" not in hx_path:
+            raise ValueError("cannot infer hz path")
+        hz_path = hx_path.replace("_hx", "_hz")
+    hx = _mat_matrix(hx_path)
+    hz = _mat_matrix(hz_path)
+    if not name:
+        name = os.path.basename(hx_path).replace("_hx.mat", "")
+    return CssCode(hx=hx, hz=hz, name=name)
+
+
+def load_npy_pair(hx_path: str, hz_path: str | None = None, name: str = "") -> CssCode:
+    """Load an Hx/Hz ``.npy`` pair (tanner_code1)."""
+    if hz_path is None:
+        hz_path = hx_path.replace("_hx", "_hz")
+    hx = gf2.to_gf2(np.load(hx_path))
+    hz = gf2.to_gf2(np.load(hz_path))
+    if not name:
+        name = os.path.basename(hx_path).replace("_hx.npy", "")
+    return CssCode(hx=hx, hz=hz, name=name)
+
+
+def save_code(code: CssCode, path: str) -> None:
+    """Persist a CssCode as .npz (our native format; avoids pickle fragility)."""
+    np.savez_compressed(
+        path,
+        hx=code.hx,
+        hz=code.hz,
+        lx=code.lx,
+        lz=code.lz,
+        name=np.array(code.name),
+        D=np.array(-1 if code.D is None else code.D),
+    )
+
+
+def load_code(path: str) -> CssCode:
+    """Load a CssCode: dispatches on extension (.npz/.pkl/.mat/.npy)."""
+    if path.endswith(".npz"):
+        data = np.load(path, allow_pickle=False)
+        code = CssCode(
+            hx=data["hx"], hz=data["hz"], lx=data["lx"], lz=data["lz"],
+            name=str(data["name"]),
+        )
+        d = int(data["D"])
+        code.D = None if d < 0 else d
+        return code
+    if path.endswith(".pkl"):
+        return load_pickle_code(path)
+    if path.endswith(".mat"):
+        return load_mat_pair(path)
+    if path.endswith(".npy"):
+        return load_npy_pair(path)
+    raise ValueError(f"unknown code format: {path}")

@@ -1,0 +1,129 @@
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import (
+    CssCode,
+    classical_code_distance,
+    gf2,
+    hgp,
+    load_code,
+    load_mat_pair,
+    load_npy_pair,
+    load_pickle_code,
+    rep_code,
+    ring_code,
+)
+from conftest import REFERENCE_CODES_LIB
+
+
+def test_rep_and_ring_codes():
+    assert rep_code(3).shape == (2, 3)
+    assert ring_code(3).shape == (3, 3)
+    assert classical_code_distance(rep_code(5)) == 5
+    assert classical_code_distance(ring_code(4)) == 4
+
+
+def test_surface_code_from_hgp():
+    # hgp(rep_code(d), rep_code(d)) is the distance-d surface code
+    d = 3
+    code = hgp(rep_code(d), rep_code(d), compute_distance=True)
+    assert code.N == d * d + (d - 1) * (d - 1)  # 13
+    assert code.K == 1
+    code.validate()
+    assert code.D == d
+
+
+def test_toric_code_from_hgp():
+    # hgp(ring_code(d), ring_code(d)) is the [[2d^2, 2, d]] toric code
+    # (SpaceTimeDecodingDemo cell 1 uses d=3)
+    d = 3
+    code = hgp(ring_code(d), ring_code(d))
+    assert code.N == 2 * d * d
+    assert code.K == 2
+    code.validate()
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFERENCE_CODES_LIB, "hgp_34_n225.pkl")),
+    reason="reference codes_lib not mounted",
+)
+def test_hgp_matches_reference_pickle_exactly():
+    """Our hgp() convention must reproduce bposd's hx/hz bit-for-bit."""
+    import pickle
+
+    from qldpc_fault_tolerance_tpu.codes.loaders import load_object
+
+    obj = load_object(os.path.join(REFERENCE_CODES_LIB, "hgp_34_n225.pkl"))
+    h1 = gf2.to_gf2(obj.__dict__["h1"])
+    ref_hx = gf2.to_gf2(obj.__dict__["hx"])
+    ref_hz = gf2.to_gf2(obj.__dict__["hz"])
+    code = hgp(h1, h1)
+    assert np.array_equal(code.hx, ref_hx)
+    assert np.array_equal(code.hz, ref_hz)
+    assert code.N == 225 and code.K == 17
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFERENCE_CODES_LIB, "hgp_34_n225.pkl")),
+    reason="reference codes_lib not mounted",
+)
+def test_load_pickle_code():
+    code = load_pickle_code(os.path.join(REFERENCE_CODES_LIB, "hgp_34_n225.pkl"))
+    assert (code.N, code.K) == (225, 17)
+    code.validate()
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFERENCE_CODES_LIB, "GenBicycleA1_hx.mat")),
+    reason="reference codes_lib not mounted",
+)
+@pytest.mark.parametrize(
+    "stem,expected",
+    [("GenBicycleA1", (126, 12)), ("GenBicycleA2", (254, 14)), ("GenBicycleA3", (510, 16))],
+)
+def test_load_gb_codes(stem, expected):
+    code = load_mat_pair(os.path.join(REFERENCE_CODES_LIB, stem + "_hx.mat"))
+    assert (code.N, code.K) == expected
+    code.validate()
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFERENCE_CODES_LIB, "LP_Matg8_L16_Dmin12_hx.mat")),
+    reason="reference codes_lib not mounted",
+)
+def test_load_lp_code():
+    code = load_mat_pair(
+        os.path.join(REFERENCE_CODES_LIB, "LP_Matg8_L16_Dmin12_hx.mat")
+    )
+    assert (code.N, code.K) == (544, 80)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REFERENCE_CODES_LIB, "tanner_code1_hx.npy")),
+    reason="reference codes_lib not mounted",
+)
+def test_load_tanner_npy():
+    code = load_npy_pair(os.path.join(REFERENCE_CODES_LIB, "tanner_code1_hx.npy"))
+    assert code.hx.shape == (240, 360)
+    assert code.hz.shape == (120, 360)
+
+
+def test_save_load_roundtrip(tmp_path):
+    from qldpc_fault_tolerance_tpu.codes import save_code
+
+    code = hgp(rep_code(3), rep_code(3))
+    code.D = 3
+    p = str(tmp_path / "c.npz")
+    save_code(code, p)
+    code2 = load_code(p)
+    assert np.array_equal(code.hx, code2.hx)
+    assert np.array_equal(code.lz, code2.lz)
+    assert code2.D == 3
+
+
+def test_css_rejects_invalid():
+    with pytest.raises(ValueError):
+        CssCode(hx=np.array([[1, 1, 0]]), hz=np.array([[1, 0, 0]]))

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import gf2
+
+
+def random_mat(rng, m, n, density=0.3):
+    return (rng.random((m, n)) < density).astype(np.uint8)
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_rref_reproduces_rowspace(seed):
+    rng = np.random.default_rng(seed)
+    a = random_mat(rng, 12, 20)
+    r, pivots = gf2.rref(a)
+    assert gf2.rank(a) == len(pivots)
+    # row space preserved: every original row solvable in terms of reduced rows
+    basis = r[: len(pivots)]
+    for row in a:
+        assert gf2.solve(basis.T, row) is not None
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_nullspace_annihilates(seed):
+    rng = np.random.default_rng(seed + 100)
+    a = random_mat(rng, 10, 25)
+    ns = gf2.nullspace(a)
+    assert ns.shape[0] == 25 - gf2.rank(a)
+    if ns.shape[0]:
+        assert not gf2.gf2_mul(a, ns.T).any()
+        assert gf2.rank(ns) == ns.shape[0]
+
+
+def test_rank_against_known():
+    a = np.array([[1, 1, 0], [0, 1, 1], [1, 0, 1]])  # rank 2 over GF(2)
+    assert gf2.rank(a) == 2
+    assert gf2.rank(np.eye(4)) == 4
+    assert gf2.rank(np.zeros((3, 3))) == 0
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_solve_roundtrip(seed):
+    rng = np.random.default_rng(seed + 200)
+    a = random_mat(rng, 15, 10)
+    x_true = (rng.random(10) < 0.5).astype(np.uint8)
+    b = gf2.gf2_mul(a, x_true[:, None]).ravel()
+    x = gf2.solve(a, b)
+    assert x is not None
+    assert np.array_equal(gf2.gf2_mul(a, x[:, None]).ravel(), b)
+
+
+def test_solve_inconsistent():
+    a = np.array([[1, 0], [1, 0]])
+    assert gf2.solve(a, np.array([1, 0])) is None
+
+
+def test_incremental_reducer():
+    red = gf2.IncrementalRowReducer(4)
+    assert red.add([1, 1, 0, 0])
+    assert red.add([0, 1, 1, 0])
+    assert not red.add([1, 0, 1, 0])  # sum of the first two
+    assert red.rank == 2
