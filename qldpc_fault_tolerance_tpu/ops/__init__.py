@@ -1,0 +1,13 @@
+from .bp import BPResult, TannerGraph, bp_decode, build_tanner_graph, llr_from_probs
+from .linalg import as_device_gf2, gf2_matmul, syndrome
+
+__all__ = [
+    "BPResult",
+    "TannerGraph",
+    "bp_decode",
+    "build_tanner_graph",
+    "llr_from_probs",
+    "as_device_gf2",
+    "gf2_matmul",
+    "syndrome",
+]

@@ -1,0 +1,31 @@
+"""Device GF(2) linear algebra.
+
+The reference computes syndromes / residual checks as host numpy
+``H @ e % 2`` products per shot (src/Simulators.py:127-156).  Here they are
+batched matmuls on the MXU: float32 accumulation is exact for row sums far
+below 2**24, so ``mod 2`` of the product is exact.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def gf2_matmul(x, h_t):
+    """Batched GF(2) product ``x @ h_t`` (mod 2).
+
+    x: (..., n) any integer/bool dtype; h_t: (n, m) 0/1.
+    Returns (..., m) uint8.
+    """
+    acc = jnp.matmul(x.astype(jnp.float32), h_t.astype(jnp.float32))
+    return jnp.mod(acc, 2.0).astype(jnp.uint8)
+
+
+def syndrome(h, e):
+    """Syndrome ``H @ e % 2`` for batched errors e: (..., n) -> (..., m)."""
+    return gf2_matmul(e, jnp.asarray(h).T)
+
+
+def as_device_gf2(a) -> jnp.ndarray:
+    """Host {0,1} matrix -> device uint8 array."""
+    return jnp.asarray(np.asarray(a), dtype=jnp.uint8)

@@ -1,0 +1,142 @@
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import gf2, hgp, rep_code
+from qldpc_fault_tolerance_tpu.ops import (
+    bp_decode,
+    build_tanner_graph,
+    gf2_matmul,
+    llr_from_probs,
+)
+
+
+def test_gf2_matmul_matches_numpy():
+    rng = np.random.default_rng(0)
+    h = (rng.random((20, 35)) < 0.2).astype(np.uint8)
+    e = (rng.random((7, 35)) < 0.3).astype(np.uint8)
+    got = np.asarray(gf2_matmul(jnp.asarray(e), jnp.asarray(h.T)))
+    want = e @ h.T % 2
+    assert np.array_equal(got, want)
+
+
+def test_tanner_graph_roundtrip():
+    h = np.array([[1, 1, 0, 1], [0, 1, 1, 0], [1, 0, 1, 1]], dtype=np.uint8)
+    g = build_tanner_graph(h)
+    chk_nbr = np.asarray(g.chk_nbr)
+    chk_mask = np.asarray(g.chk_mask)
+    # every nonzero of H appears exactly once in the row adjacency
+    rebuilt = np.zeros_like(h)
+    for i in range(h.shape[0]):
+        for s in range(chk_nbr.shape[1]):
+            if chk_mask[i, s]:
+                rebuilt[i, chk_nbr[i, s]] ^= 1
+    assert np.array_equal(rebuilt, h)
+    # cross slot maps are mutually consistent
+    var_nbr = np.asarray(g.var_nbr)
+    var_slot = np.asarray(g.var_nbr_slot)
+    chk_slot = np.asarray(g.chk_nbr_slot)
+    for i in range(h.shape[0]):
+        for s in range(chk_nbr.shape[1]):
+            if not chk_mask[i, s]:
+                continue
+            j, t = chk_nbr[i, s], chk_slot[i, s]
+            assert var_nbr[j, t] == i
+            assert var_slot[j, t] == s
+
+
+def test_minsum_single_check_hand_computed():
+    # H = [1 1 1], llr = [1, 2, 3], syndrome = [1], scale = 1:
+    # check->var msgs: v0: -min(2,3) = -2 ; v1: -min(1,3) = -1 ; v2: -min(1,2) = -1
+    # posteriors: [-1, 1, 2] -> error = [1,0,0]; matches syndrome -> converged iter 1
+    g = build_tanner_graph(np.array([[1, 1, 1]], dtype=np.uint8))
+    p = 1.0 / (1.0 + np.exp(np.array([1.0, 2.0, 3.0])))  # probs giving those llrs
+    res = bp_decode(
+        g,
+        jnp.asarray([[1]], dtype=jnp.uint8),
+        llr_from_probs(p),
+        max_iter=5,
+        ms_scaling_factor=1.0,
+    )
+    assert np.array_equal(np.asarray(res.error)[0], [1, 0, 0])
+    assert bool(res.converged[0])
+    assert int(res.iterations[0]) == 1
+    np.testing.assert_allclose(np.asarray(res.posterior_llr)[0], [-1.0, 1.0, 2.0], atol=1e-3)
+
+
+def test_minsum_scaling_factor_applied():
+    g = build_tanner_graph(np.array([[1, 1, 1]], dtype=np.uint8))
+    p = 1.0 / (1.0 + np.exp(np.array([1.0, 2.0, 3.0])))
+    res = bp_decode(
+        g,
+        jnp.asarray([[0]], dtype=jnp.uint8),
+        llr_from_probs(p),
+        max_iter=1,
+        ms_scaling_factor=0.5,
+        early_stop=False,
+    )
+    # zero syndrome: messages positive, scaled by 0.5: posteriors = llr + 0.5*min_excl
+    np.testing.assert_allclose(
+        np.asarray(res.posterior_llr)[0], [1 + 1.0, 2 + 0.5, 3 + 0.5], atol=1e-3
+    )
+
+
+@pytest.mark.parametrize("method", ["minimum_sum", "product_sum"])
+def test_repetition_code_corrects_single_error(method):
+    h = rep_code(7)
+    g = build_tanner_graph(h)
+    e = np.zeros(7, dtype=np.uint8)
+    e[3] = 1
+    synd = h @ e % 2
+    res = bp_decode(
+        g,
+        jnp.asarray(synd[None]),
+        llr_from_probs(np.full(7, 0.05)),
+        max_iter=20,
+        method=method,
+    )
+    assert bool(res.converged[0])
+    assert np.array_equal(np.asarray(res.error)[0], e)
+
+
+def test_converged_implies_syndrome_match_batch():
+    rng = np.random.default_rng(42)
+    code = hgp(rep_code(5), rep_code(5))  # d5 surface code
+    h = code.hz
+    g = build_tanner_graph(h)
+    errs = (rng.random((64, code.N)) < 0.03).astype(np.uint8)
+    synds = errs @ h.T % 2
+    res = bp_decode(
+        g, jnp.asarray(synds), llr_from_probs(np.full(code.N, 0.03)), max_iter=30
+    )
+    conv = np.asarray(res.converged)
+    dec = np.asarray(res.error)
+    assert conv.mean() > 0.5  # most low-weight shots converge
+    resid_synd = dec @ h.T % 2
+    assert np.array_equal(resid_synd[conv], synds[conv])
+
+
+def test_decode_deterministic():
+    h = rep_code(9)
+    g = build_tanner_graph(h)
+    synd = np.zeros((4, 8), dtype=np.uint8)
+    synd[:, 2] = 1
+    r1 = bp_decode(g, jnp.asarray(synd), llr_from_probs(np.full(9, 0.01)), max_iter=15)
+    r2 = bp_decode(g, jnp.asarray(synd), llr_from_probs(np.full(9, 0.01)), max_iter=15)
+    assert np.array_equal(np.asarray(r1.error), np.asarray(r2.error))
+    # identical shots decode identically within the batch
+    assert np.array_equal(np.asarray(r1.error)[0], np.asarray(r1.error)[3])
+
+
+def test_nonuniform_channel_probs_break_ties():
+    # two-bit check with syndrome 1: the more error-prone bit should be flipped
+    h = np.array([[1, 1]], dtype=np.uint8)
+    g = build_tanner_graph(h)
+    res = bp_decode(
+        g,
+        jnp.asarray([[1]], dtype=jnp.uint8),
+        llr_from_probs(np.array([0.01, 0.2])),
+        max_iter=10,
+    )
+    assert np.array_equal(np.asarray(res.error)[0], [0, 1])
