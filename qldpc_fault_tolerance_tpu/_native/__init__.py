@@ -1,0 +1,74 @@
+"""Native (C++) host kernels: OSD post-processing and GF(2) elimination.
+
+Built on first use with g++ into a shared library next to the sources; loaded
+via ctypes (no pybind11 dependency).  ``load_native()`` returns None if the
+toolchain is unavailable, in which case callers fall back to the numpy
+implementations in decoders/osd.py.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "osd.cpp")
+_LIB = os.path.join(_HERE, "libqldpc_native.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build() -> bool:
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        _SRC,
+        "-o",
+        _LIB,
+    ]
+    try:
+        res = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+        if res.returncode != 0:
+            import warnings
+
+            warnings.warn(f"native build failed:\n{res.stderr[-2000:]}")
+            return False
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def load_native():
+    """Return the loaded ctypes library, building it if necessary (or None)."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            if not _build():
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError:
+            return None
+        c_u8p = ctypes.POINTER(ctypes.c_uint8)
+        c_dp = ctypes.POINTER(ctypes.c_double)
+        lib.qldpc_osd_decode_batch.argtypes = [
+            c_u8p, ctypes.c_int, ctypes.c_int,       # H, m, n
+            c_u8p, c_dp, ctypes.c_int,               # syndromes, posterior_llr, batch
+            c_dp, ctypes.c_int, ctypes.c_int,        # channel_cost, method, osd_order
+            ctypes.c_int, c_u8p,                     # nthreads, out
+        ]
+        lib.qldpc_osd_decode_batch.restype = ctypes.c_int
+        lib.qldpc_gf2_rank.argtypes = [c_u8p, ctypes.c_int, ctypes.c_int]
+        lib.qldpc_gf2_rank.restype = ctypes.c_int
+        _lib = lib
+        return _lib

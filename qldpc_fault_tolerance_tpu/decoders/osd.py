@@ -1,0 +1,163 @@
+"""Ordered-statistics decoding (host post-processing stage of BP+OSD).
+
+``bposd.bposd_decoder`` semantics (reference src/Decoders.py:26-41): run BP;
+if BP's hard decision already satisfies the syndrome, return it; otherwise run
+OSD seeded by BP's soft output and return the most probable consistent error
+("osdw" weighting).  Here BP runs batched on TPU (ops/bp.py) and only the
+non-converged shots are gathered back to host for OSD — GF(2) elimination is
+inherently sequential, so it lives in C++ (_native/osd.cpp) with a numpy
+fallback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .._native import load_native
+from ..codes import gf2
+
+__all__ = ["osd_decode_batch", "osd_postprocess"]
+
+_METHODS = {"osd_0": 0, "osd0": 0, "osd_e": 1, "osd_cs": 2, "exhaustive": 1}
+
+
+def _channel_cost(channel_probs: np.ndarray) -> np.ndarray:
+    p = np.clip(np.asarray(channel_probs, dtype=np.float64), 1e-12, 1 - 1e-7)
+    return np.maximum(np.log((1 - p) / p), 1e-12)
+
+
+def osd_decode_batch(
+    h: np.ndarray,
+    syndromes: np.ndarray,
+    posterior_llrs: np.ndarray,
+    channel_probs: np.ndarray,
+    *,
+    osd_method: str = "osd_e",
+    osd_order: int = 10,
+    nthreads: int = 0,
+) -> np.ndarray:
+    """OSD-decode a batch of syndromes. Returns (B, n) uint8 errors."""
+    h = gf2.to_gf2(h)
+    m, n = h.shape
+    syndromes = np.ascontiguousarray(np.atleast_2d(syndromes).astype(np.uint8))
+    b = syndromes.shape[0]
+    if b == 0:
+        return np.zeros((0, n), dtype=np.uint8)
+    llrs = np.ascontiguousarray(
+        np.broadcast_to(np.asarray(posterior_llrs, np.float64), (b, n))
+    )
+    cost = np.ascontiguousarray(_channel_cost(channel_probs))
+    if cost.ndim == 0:
+        cost = np.full(n, float(cost))
+    method = _METHODS[osd_method]
+
+    lib = load_native()
+    if lib is not None:
+        out = np.zeros((b, n), dtype=np.uint8)
+        import ctypes
+
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        dp = ctypes.POINTER(ctypes.c_double)
+        rc = lib.qldpc_osd_decode_batch(
+            h.ctypes.data_as(u8p), m, n,
+            syndromes.ctypes.data_as(u8p),
+            llrs.ctypes.data_as(dp), b,
+            cost.ctypes.data_as(dp), method, int(osd_order),
+            int(nthreads), out.ctypes.data_as(u8p),
+        )
+        if rc == 0:
+            return out
+    return _osd_numpy(h, syndromes, llrs, cost, method, int(osd_order))
+
+
+def _osd_numpy(h, syndromes, llrs, cost, method, osd_order):
+    """Reference numpy implementation (fallback + test oracle for the C++)."""
+    m, n = h.shape
+    out = np.zeros((syndromes.shape[0], n), dtype=np.uint8)
+    for bi in range(syndromes.shape[0]):
+        order = np.argsort(llrs[bi], kind="stable")
+        hp = h[:, order].copy()
+        u = syndromes[bi].copy()
+        # full RREF with syndrome carried
+        pivots, free = [], []
+        r = 0
+        for col in range(n):
+            if r >= m:
+                free.append(col)
+                continue
+            sub = np.nonzero(hp[r:, col])[0]
+            if sub.size == 0:
+                free.append(col)
+                continue
+            piv = r + sub[0]
+            if piv != r:
+                hp[[r, piv]] = hp[[piv, r]]
+                u[[r, piv]] = u[[piv, r]]
+            rows = np.nonzero(hp[:, col])[0]
+            for i in rows:
+                if i != r:
+                    hp[i] ^= hp[r]
+                    u[i] ^= u[r]
+            pivots.append(col)
+            r += 1
+        pivots = np.array(pivots, dtype=int)
+        free = np.array(free, dtype=int)
+        perm_cost = cost[order]
+
+        def solve(t_bits):
+            e_s = u[: len(pivots)].copy()
+            for fj in t_bits:
+                e_s ^= hp[: len(pivots), free[fj]]
+            c = perm_cost[pivots] @ e_s + sum(perm_cost[free[fj]] for fj in t_bits)
+            return e_s, c
+
+        best_es, best_c = solve([])
+        best_t: list[int] = []
+        cands: list[list[int]] = []
+        if method == 1:
+            w = min(osd_order, len(free), 20)
+            for pat in range(1, 1 << w):
+                cands.append([b for b in range(w) if (pat >> b) & 1])
+        elif method == 2:
+            cands.extend([[b] for b in range(len(free))])
+            w = min(osd_order, len(free))
+            cands.extend([[a, b] for a in range(w) for b in range(a + 1, w)])
+        for t in cands:
+            e_s, c = solve(t)
+            if c < best_c:
+                best_es, best_c, best_t = e_s, c, t
+        e_perm = np.zeros(n, dtype=np.uint8)
+        e_perm[pivots] = best_es
+        for fj in best_t:
+            e_perm[free[fj]] = 1
+        out[bi, order] = e_perm
+    return out
+
+
+def osd_postprocess(
+    h,
+    syndromes,
+    bp_errors,
+    bp_converged,
+    posterior_llrs,
+    channel_probs,
+    *,
+    osd_method: str = "osd_e",
+    osd_order: int = 10,
+) -> np.ndarray:
+    """Combine BP output with OSD on the non-converged shots (bposd semantics)."""
+    bp_errors = np.asarray(bp_errors, dtype=np.uint8)
+    conv = np.asarray(bp_converged, dtype=bool)
+    if conv.all():
+        return bp_errors
+    idx = np.nonzero(~conv)[0]
+    fixed = osd_decode_batch(
+        h,
+        np.asarray(syndromes)[idx],
+        np.asarray(posterior_llrs)[idx],
+        channel_probs,
+        osd_method=osd_method,
+        osd_order=osd_order,
+    )
+    out = bp_errors.copy()
+    out[idx] = fixed
+    return out

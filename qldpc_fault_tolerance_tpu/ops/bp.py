@@ -221,3 +221,57 @@ def bp_decode(
         posterior_llr=out["llr"],
         iterations=out["iters"],
     )
+
+
+@functools.partial(jax.jit, static_argnames=("max_restarts",))
+def first_min_bp_decode(
+    graph: TannerGraph,
+    syndromes,
+    channel_llr,
+    *,
+    max_restarts: int,
+    ms_scaling_factor=0.9,
+):
+    """Sequential-restart 1-iteration BP (reference FirstMinBPDecoder,
+    src/Decoders.py:49-74): repeatedly run single-iteration min-sum from fresh
+    messages, accumulating the correction while the syndrome weight is
+    non-increasing, for at most ``max_restarts`` accepted restarts.
+
+    Batched as a ``lax.scan`` over restart steps with a per-shot active mask.
+    Returns (correction (B,n) uint8, final syndrome weight (B,) int32).
+    """
+    syndromes = jnp.asarray(syndromes)
+    if syndromes.ndim == 1:
+        syndromes = syndromes[None]
+    b = syndromes.shape[0]
+    n = graph.var_nbr.shape[0]
+    llr0 = jnp.broadcast_to(jnp.asarray(channel_llr, jnp.float32), (b, n))
+    scale = jnp.asarray(ms_scaling_factor, jnp.float32)
+
+    def one_iter_decode(synd):
+        synd_sign = 1.0 - 2.0 * synd.astype(jnp.float32)
+        v2c = llr0[:, graph.chk_nbr]
+        c2v_chk = _check_update_minsum(v2c, synd_sign, graph, scale)
+        c2v_var = jnp.where(graph.var_mask, c2v_chk[:, graph.var_nbr, graph.var_nbr_slot], 0.0)
+        total = llr0 + jnp.sum(c2v_var, axis=-1)
+        return (total < 0).astype(jnp.uint8)
+
+    def step(carry, _):
+        cur_synd, corr, active = carry
+        err = one_iter_decode(cur_synd)
+        new_synd = gf2_matmul(err, graph.h_t) ^ cur_synd
+        accept = active & (
+            jnp.sum(new_synd, axis=-1).astype(jnp.int32)
+            <= jnp.sum(cur_synd, axis=-1).astype(jnp.int32)
+        )
+        corr = jnp.where(accept[:, None], corr ^ err, corr)
+        cur_synd = jnp.where(accept[:, None], new_synd, cur_synd)
+        return (cur_synd, corr, accept), None
+
+    init = (
+        syndromes.astype(jnp.uint8),
+        jnp.zeros((b, n), jnp.uint8),
+        jnp.ones((b,), bool),
+    )
+    (final_synd, corr, _), _ = jax.lax.scan(step, init, None, length=max_restarts)
+    return corr, jnp.sum(final_synd, axis=-1).astype(jnp.int32)

@@ -1,0 +1,107 @@
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu._native import load_native
+from qldpc_fault_tolerance_tpu.codes import gf2, hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders.osd import _osd_numpy, osd_decode_batch
+
+
+def test_native_builds():
+    assert load_native() is not None, "C++ native lib failed to build"
+
+
+def test_native_gf2_rank_matches_numpy():
+    lib = load_native()
+    if lib is None:
+        pytest.skip("no native lib")
+    import ctypes
+
+    rng = np.random.default_rng(3)
+    for _ in range(5):
+        h = (rng.random((17, 29)) < 0.25).astype(np.uint8)
+        r = lib.qldpc_gf2_rank(
+            np.ascontiguousarray(h).ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            17,
+            29,
+        )
+        assert r == gf2.rank(h)
+
+
+def _random_case(rng, m=12, n=24, wt=3):
+    h = (rng.random((m, n)) < 0.25).astype(np.uint8)
+    h[:, rng.integers(0, n)] |= 0  # keep arbitrary
+    e = np.zeros(n, dtype=np.uint8)
+    e[rng.choice(n, size=wt, replace=False)] = 1
+    s = h @ e % 2
+    return h, e, s
+
+
+@pytest.mark.parametrize("method", ["osd_0", "osd_e", "osd_cs"])
+def test_osd_satisfies_syndrome(method):
+    rng = np.random.default_rng(11)
+    h, e, s = _random_case(rng)
+    p = np.full(24, 0.05)
+    llr = np.log((1 - p) / p) * (1 - 2 * e)  # soft info pointing at the true error
+    dec = osd_decode_batch(h, s[None], llr[None], p, osd_method=method, osd_order=6)
+    assert np.array_equal(dec[0] @ h.T % 2 if False else h @ dec[0] % 2, s)
+
+
+def test_osd_zero_syndrome_returns_zero():
+    rng = np.random.default_rng(5)
+    h = (rng.random((8, 16)) < 0.3).astype(np.uint8)
+    p = np.full(16, 0.01)
+    llr = np.log((1 - p) / p) * np.ones(16)
+    dec = osd_decode_batch(h, np.zeros((1, 8), np.uint8), llr[None], p)
+    assert not dec.any()
+
+
+def test_osd_finds_min_weight_on_repetition_code():
+    # rep code: syndrome from single flip in the middle; min-weight solution is that flip
+    h = rep_code(9)
+    e = np.zeros(9, np.uint8)
+    e[4] = 1
+    s = h @ e % 2
+    p = np.full(9, 0.05)
+    llr = np.full(9, np.log((1 - 0.05) / 0.05))  # uninformative (all "no error")
+    dec = osd_decode_batch(h, s[None], llr[None], p, osd_method="osd_e", osd_order=6)
+    assert np.array_equal(dec[0], e)
+
+
+def test_cpp_matches_numpy_oracle():
+    if load_native() is None:
+        pytest.skip("no native lib")
+    rng = np.random.default_rng(17)
+    for trial in range(10):
+        h, e, s = _random_case(rng, m=10, n=20, wt=2)
+        p = rng.uniform(0.01, 0.2, size=20)
+        llr = rng.normal(size=20)
+        cost = np.maximum(np.log((1 - p) / p), 1e-12)
+        for method in (0, 1, 2):
+            a = _osd_numpy(h, s[None].astype(np.uint8), llr[None], cost, method, 5)
+            b = osd_decode_batch(
+                h, s[None], llr[None], p,
+                osd_method={0: "osd_0", 1: "osd_e", 2: "osd_cs"}[method],
+                osd_order=5,
+            )
+            # both must satisfy the syndrome and have equal cost (tie-breaking may differ)
+            assert np.array_equal(h @ a[0] % 2, s)
+            assert np.array_equal(h @ b[0] % 2, s)
+            ca, cb = cost @ a[0], cost @ b[0]
+            assert abs(ca - cb) < 1e-9, f"trial {trial} method {method}: {ca} vs {cb}"
+
+
+def test_osd_order_improves_or_matches():
+    # higher order can only lower (or keep) the solution cost
+    rng = np.random.default_rng(23)
+    code = hgp(rep_code(4), rep_code(4))
+    h = code.hz
+    n = code.N
+    e = np.zeros(n, np.uint8)
+    e[[1, 5]] = 1
+    s = h @ e % 2
+    p = np.full(n, 0.05)
+    llr = np.full(n, 1.0)
+    cost = np.maximum(np.log((1 - p) / p), 1e-12)
+    d0 = osd_decode_batch(h, s[None], llr[None], p, osd_method="osd_0")
+    d10 = osd_decode_batch(h, s[None], llr[None], p, osd_method="osd_e", osd_order=10)
+    assert cost @ d10[0] <= cost @ d0[0] + 1e-9
