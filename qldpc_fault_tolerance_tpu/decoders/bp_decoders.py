@@ -73,7 +73,18 @@ class BPDecoder:
         self.ms_scaling_factor = float(ms_scaling_factor)
         self.llr0 = bp.llr_from_probs(self.channel_probs)
 
+    needs_host_postprocess = False
+
     # --- device-side (for composition inside jitted simulators) ---
+    def decode_batch_device(self, syndromes):
+        """Uniform device interface: returns (corrections (B,n) uint8, aux dict)."""
+        res = self.bp_batch_device(syndromes)
+        return res.error, {"converged": res.converged, "posterior_llr": res.posterior_llr}
+
+    def host_postprocess(self, syndromes, corrections, aux):
+        """No-op for plain BP (bposd applies OSD only on BP failure)."""
+        return corrections
+
     def bp_batch_device(self, syndromes) -> bp.BPResult:
         return bp.bp_decode(
             self.graph,
@@ -101,11 +112,21 @@ class BPOSD_Decoder(BPDecoder):
     C++ on host only for the shots whose BP output misses the syndrome.
     """
 
+    needs_host_postprocess = True
+
     def __init__(self, h, channel_probs, max_iter, bp_method="minimum_sum",
                  ms_scaling_factor=0.625, osd_method="osd_e", osd_order=10):
         super().__init__(h, channel_probs, max_iter, bp_method, ms_scaling_factor)
         self.osd_method = str(osd_method)
         self.osd_order = int(osd_order)
+
+    def host_postprocess(self, syndromes, corrections, aux):
+        return self.osd_host(
+            np.asarray(syndromes),
+            np.asarray(corrections),
+            np.asarray(aux["converged"]),
+            np.asarray(aux["posterior_llr"]),
+        )
 
     def decode_batch(self, syndromes) -> np.ndarray:
         syndromes = np.atleast_2d(np.asarray(syndromes))
@@ -138,6 +159,21 @@ class FirstMinBPDecoder:
         self.max_iter = max(1, int(max_iter))
         self.ms_scaling_factor = float(ms_scaling_factor)
         self.llr0 = bp.llr_from_probs(self.channel_probs)
+
+    needs_host_postprocess = False
+
+    def decode_batch_device(self, syndromes):
+        corr, w = bp.first_min_bp_decode(
+            self.graph,
+            syndromes,
+            self.llr0,
+            max_restarts=self.max_iter,
+            ms_scaling_factor=self.ms_scaling_factor,
+        )
+        return corr, {"final_weight": w}
+
+    def host_postprocess(self, syndromes, corrections, aux):
+        return corrections
 
     def decode_batch(self, syndromes) -> np.ndarray:
         corr, _ = bp.first_min_bp_decode(

@@ -1,0 +1,3 @@
+from .samplers import bit_flips, depolarizing_xz
+
+__all__ = ["bit_flips", "depolarizing_xz"]

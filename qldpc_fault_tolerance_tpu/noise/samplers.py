@@ -1,0 +1,39 @@
+"""PRNG-keyed noise samplers (pure JAX, vmappable).
+
+Replaces the reference's per-qubit Python ``random.random()`` loops
+(src/Simulators.py:89-115, 215-255).  Keyed sampling fixes the reference's
+fork-RNG hazard (identical Mersenne-Twister streams in forked workers,
+src/Simulators.py:101 + SURVEY §2.3): every shot derives an independent
+stream from a fold-in of the shot index.
+
+Convention: ``pauli_error_probs = [px, py, pz]`` with the reference's binning
+order — u < pz -> Z; pz <= u < pz+px -> X; pz+px <= u < pz+px+py -> Y
+(src/Simulators.py:102-113).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["depolarizing_xz", "bit_flips"]
+
+
+def depolarizing_xz(key, shape, pauli_error_probs):
+    """Sample X/Z error components for independent single-qubit Pauli noise.
+
+    shape: output shape, e.g. (batch, n).  Returns (error_x, error_z) uint8.
+    """
+    px, py, pz = (jnp.asarray(p, jnp.float32) for p in pauli_error_probs)
+    u = jax.random.uniform(key, shape, dtype=jnp.float32)
+    is_z = u < pz
+    is_x = (u >= pz) & (u < pz + px)
+    is_y = (u >= pz + px) & (u < pz + px + py)
+    error_x = (is_x | is_y).astype(jnp.uint8)
+    error_z = (is_z | is_y).astype(jnp.uint8)
+    return error_x, error_z
+
+
+def bit_flips(key, shape, p):
+    """i.i.d. Bernoulli(p) flips (syndrome-measurement errors etc.)."""
+    u = jax.random.uniform(key, shape, dtype=jnp.float32)
+    return (u < jnp.asarray(p, jnp.float32)).astype(jnp.uint8)

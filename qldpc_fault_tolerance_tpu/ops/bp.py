@@ -123,6 +123,16 @@ def _check_update_minsum(v2c, synd_sign, graph, scale):
     return jnp.where(mask, scale * excl_sign * excl_min, 0.0)
 
 
+def _varying_zeros(ref, shape, dtype):
+    """Zeros of ``shape``/``dtype`` carrying the same manual-axis "varying"
+    status as ``ref`` — needed so loop-carry inits match body outputs when the
+    kernel runs inside shard_map (shots sharded across a mesh)."""
+    tag = ref.reshape(-1)[0]
+    if dtype == jnp.bool_:
+        return jnp.zeros(shape, dtype) | (tag.astype(jnp.int32) < -1)
+    return jnp.zeros(shape, dtype) + (tag.astype(jnp.int32) * 0).astype(dtype)
+
+
 def _check_update_prodsum(v2c, synd_sign, graph, scale):
     """Product-sum (tanh rule) update in a numerically-guarded form."""
     del scale
@@ -185,13 +195,16 @@ def bp_decode(
     def hard_decision(total):
         return (total < 0).astype(jnp.uint8)
 
+    # carry inits derive a zero from the (possibly mesh-sharded) syndromes so
+    # their varying-axis tags match the body outputs under shard_map
+    zf = _varying_zeros(syndromes, (b, 1), jnp.float32)
     init = dict(
         it=jnp.zeros((), jnp.int32),
-        v2c=llr0[:, graph.chk_nbr],                        # init messages = channel LLRs
-        err=jnp.zeros((b, n), jnp.uint8),
-        llr=llr0,
-        done=jnp.zeros((b,), bool),
-        iters=jnp.full((b,), max_iter, jnp.int32),
+        v2c=llr0[:, graph.chk_nbr] + zf[..., None],        # init messages = channel LLRs
+        err=_varying_zeros(syndromes, (b, n), jnp.uint8),
+        llr=llr0 + zf,
+        done=_varying_zeros(syndromes, (b,), jnp.bool_),
+        iters=jnp.full((b,), max_iter, jnp.int32) + _varying_zeros(syndromes, (b,), jnp.int32),
     )
 
     def cond(carry):
@@ -270,8 +283,8 @@ def first_min_bp_decode(
 
     init = (
         syndromes.astype(jnp.uint8),
-        jnp.zeros((b, n), jnp.uint8),
-        jnp.ones((b,), bool),
+        _varying_zeros(syndromes, (b, n), jnp.uint8),
+        ~_varying_zeros(syndromes, (b,), jnp.bool_),
     )
     (final_synd, corr, _), _ = jax.lax.scan(step, init, None, length=max_restarts)
     return corr, jnp.sum(final_synd, axis=-1).astype(jnp.int32)

@@ -1,0 +1,3 @@
+from .shots import SHOT_AXIS, sharded_failure_count, shot_mesh, split_keys_for_mesh
+
+__all__ = ["SHOT_AXIS", "sharded_failure_count", "shot_mesh", "split_keys_for_mesh"]

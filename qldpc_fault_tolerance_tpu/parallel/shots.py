@@ -1,0 +1,58 @@
+"""Device-mesh sharding of the Monte-Carlo shot axis.
+
+The reference's only parallelism is a fork/queue process pool over shots
+(parmap, src/Simulators.py:45-61) with mp.Queue as the "communication
+backend".  The TPU-native mapping: shots are a batch axis inside one chip
+(vmap-style batching in the kernels) and shard across chips over ICI via
+``shard_map`` on a 1-D ``Mesh``; the only collective is a ``psum`` of failure
+counts.  Multi-host sweeps additionally split the (code, p, cycles) grid by
+``jax.process_index()`` (see sweep/family.py) so only scalar results cross
+DCN.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+__all__ = ["shot_mesh", "sharded_failure_count", "split_keys_for_mesh"]
+
+SHOT_AXIS = "shots"
+
+
+def shot_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or given) devices with a 'shots' axis."""
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    return Mesh(devices, (SHOT_AXIS,))
+
+
+def split_keys_for_mesh(key, mesh: Mesh):
+    """One PRNG key per mesh device, stacked on the shot axis."""
+    n = mesh.devices.size
+    return jax.random.split(key, n)
+
+
+def sharded_failure_count(device_fn, mesh: Mesh, per_device_batch: int):
+    """Build a jitted function (keys (n_dev,) -> total failures scalar).
+
+    ``device_fn(key, batch_size) -> (B,) bool/int failure flags`` must be pure
+    device code (no host callbacks).  Each mesh device runs its own batch from
+    its own key; counts are psum-reduced over ICI.
+    """
+
+    @jax.jit
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(SHOT_AXIS),),
+        out_specs=P(),
+    )
+    def run(keys):
+        fail = device_fn(keys[0], per_device_batch)
+        local = jnp.sum(fail.astype(jnp.int32))
+        return jax.lax.psum(local, SHOT_AXIS)
+
+    return run

@@ -1,0 +1,77 @@
+"""Shared Monte-Carlo machinery: WER statistics and batching.
+
+The estimator contracts follow the reference exactly:
+  * code-capacity WER: 1-(1-P_L)^(1/K) with binomial error bar
+    (src/Simulators.py:170-188)
+  * per-qubit-per-cycle WER inversion requiring odd cycle counts
+    (src/Simulators.py:334-362)
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+__all__ = ["wer_single_shot", "wer_per_cycle", "ShotBatcher", "SimResult"]
+
+
+def wer_single_shot(error_count: int, num_run: int, K: int):
+    """WER + error bar for single-shot decoding (src/Simulators.py:174-188)."""
+    logical_error_rate = error_count / num_run
+    logical_error_rate_eb = np.sqrt(
+        (1 - logical_error_rate) * logical_error_rate / num_run
+    )
+    word_error_rate = 1.0 - (1 - logical_error_rate) ** (1 / K)
+    word_error_rate_eb = (
+        logical_error_rate_eb * ((1 - logical_error_rate_eb) ** (1 / K - 1)) / K
+    )
+    return word_error_rate, word_error_rate_eb
+
+
+def wer_per_cycle(error_count: int, num_samples: int, K: int, num_cycles: int):
+    """Per-qubit-per-cycle WER inversion (src/Simulators.py:353-361).
+
+    Requires odd num_cycles so the inversion is well-defined.
+    """
+    assert int(num_cycles) % 2 == 1, (
+        "the number of cycles has to be odd for an invertible wer mapping"
+    )
+    logical_error_rate = error_count / num_samples
+    per_qubit = 1.0 - (1 - logical_error_rate) ** (1 / K)
+    if per_qubit <= 0.5:
+        wer = (1.0 - (1 - 2 * per_qubit) ** (1 / num_cycles)) / 2
+    else:
+        wer = (1.0 + (-1 + 2 * per_qubit) ** (1 / num_cycles)) / 2
+    return wer, None
+
+
+@dataclasses.dataclass
+class SimResult:
+    """Structured result record (replaces the reference's bare prints)."""
+
+    failures: int
+    num_samples: int
+    wer: float
+    wer_eb: float | None
+    extra: dict = dataclasses.field(default_factory=dict)
+
+
+class ShotBatcher:
+    """Splits a shot budget into device-sized batches of a fixed compiled size.
+
+    Fixed batch shapes keep XLA from recompiling; the trailing partial batch is
+    run at full size and the surplus shots are simply counted in (they are
+    i.i.d., so extra samples only tighten the estimate — num_samples reflects
+    what actually ran).
+    """
+
+    def __init__(self, num_shots: int, batch_size: int):
+        self.batch_size = int(batch_size)
+        self.num_batches = max(1, -(-int(num_shots) // self.batch_size))
+
+    @property
+    def total(self) -> int:
+        return self.num_batches * self.batch_size
+
+    def __iter__(self):
+        return iter(range(self.num_batches))

@@ -1,0 +1,156 @@
+"""Code-capacity (data-noise) Monte-Carlo engine.
+
+Replaces reference ``CodeSimulator_DataError`` (src/Simulators.py:75-188).
+The per-shot pipeline — depolarizing sample, syndrome SpMV, BP decode of both
+sectors, residual stabilizer/logical checks — is one jitted batch on device;
+only decoders that need OSD post-processing (BPOSD) route the minority of
+BP-failed shots through the host between the decode and check stages.
+
+Parallelism: the reference's process-pool-over-shots (parmap,
+src/Simulators.py:45-61) becomes a batch axis on device; multi-chip scaling
+shards the same batch across a mesh (parallel/shots.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..noise import depolarizing_xz
+from ..ops.linalg import gf2_matmul
+from .common import ShotBatcher, wer_single_shot
+
+__all__ = ["CodeSimulator_DataError"]
+
+
+class CodeSimulator_DataError:
+    """Same constructor/WordErrorRate surface as the reference class, batched.
+
+    Extra knobs: ``seed`` (base PRNG key) and ``batch_size`` (shots per device
+    dispatch).
+    """
+
+    def __init__(self, code=None, decoder_x=None, decoder_z=None,
+                 pauli_error_probs=(0.01, 0.01, 0.01), eval_logical_type="Total",
+                 seed: int = 0, batch_size: int = 2048, mesh=None):
+        assert eval_logical_type in ["X", "Z", "Total"]
+        self.code = code
+        self.decoder_z, self.decoder_x = decoder_z, decoder_x
+        self.N = code.N
+        self.K = code.K
+        self.channel_probs = list(pauli_error_probs)
+        self.eval_logical_type = eval_logical_type
+        self.min_logical_weight = self.N
+        self.batch_size = int(batch_size)
+        self._base_key = jax.random.PRNGKey(seed)
+        self._mesh = mesh
+
+        self._hx_t = jnp.asarray(code.hx.T)
+        self._hz_t = jnp.asarray(code.hz.T)
+        self._lx_t = jnp.asarray(code.lx.T)
+        self._lz_t = jnp.asarray(code.lz.T)
+        self._needs_host = (
+            decoder_x.needs_host_postprocess or decoder_z.needs_host_postprocess
+        )
+
+    # ------------------------------------------------------------------
+    # device stages
+    # ------------------------------------------------------------------
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _sample_and_bp(self, key, batch_size: int):
+        probs = tuple(self.channel_probs)
+        error_x, error_z = depolarizing_xz(key, (batch_size, self.N), probs)
+        synd_z = gf2_matmul(error_z, self._hx_t)   # src/Simulators.py:127
+        synd_x = gf2_matmul(error_x, self._hz_t)   # src/Simulators.py:131
+        cor_z, aux_z = self.decoder_z.decode_batch_device(synd_z)
+        cor_x, aux_x = self.decoder_x.decode_batch_device(synd_x)
+        return error_x, error_z, synd_x, synd_z, cor_x, cor_z, aux_x, aux_z
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _check_failures(self, error_x, error_z, cor_x, cor_z):
+        """Residual stabilizer/logical checks (src/Simulators.py:135-168)."""
+        residual_x = error_x ^ cor_x
+        residual_z = error_z ^ cor_z
+        x_stab = gf2_matmul(residual_x, self._hz_t).any(axis=-1)
+        x_log = gf2_matmul(residual_x, self._lz_t).any(axis=-1)
+        z_stab = gf2_matmul(residual_z, self._hx_t).any(axis=-1)
+        z_log = gf2_matmul(residual_z, self._lx_t).any(axis=-1)
+        x_failure = x_stab | x_log
+        z_failure = z_stab | z_log
+        if self.eval_logical_type == "X":
+            fail = x_failure
+        elif self.eval_logical_type == "Z":
+            fail = z_failure
+        else:
+            fail = x_failure | z_failure
+        # min residual weight among logical failures (min_logical_weight track)
+        wx = jnp.where(x_log, residual_x.sum(axis=-1), self.N)
+        wz = jnp.where(z_log, residual_z.sum(axis=-1), self.N)
+        return fail, jnp.minimum(wx.min(), wz.min())
+
+    # ------------------------------------------------------------------
+    def device_failures(self, key, batch_size: int):
+        """Pure-device per-shot failure flags — the unit that shards across a
+        mesh (only valid when no host OSD stage is required)."""
+        ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp(key, batch_size)
+        fail, _ = self._check_failures(ex, ez, cx, cz)
+        return fail
+
+    def _sharded_runner(self):
+        from ..parallel import sharded_failure_count
+
+        if getattr(self, "_sharded", None) is None:
+            assert not self._needs_host, (
+                "mesh sharding requires pure-device decoders (plain BP); "
+                "BPOSD's host stage is per-chip only"
+            )
+            self._sharded = sharded_failure_count(
+                self.device_failures, self._mesh, self.batch_size
+            )
+        return self._sharded
+
+    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
+        """Run one batch; returns per-shot failure flags (host bool array)."""
+        bs = batch_size or self.batch_size
+        ex, ez, sx, sz, cx, cz, ax, az = self._sample_and_bp(key, bs)
+        if self._needs_host:
+            cx = jnp.asarray(
+                self.decoder_x.host_postprocess(np.asarray(sx), np.asarray(cx),
+                                                jax.device_get(ax))
+            )
+            cz = jnp.asarray(
+                self.decoder_z.host_postprocess(np.asarray(sz), np.asarray(cz),
+                                                jax.device_get(az))
+            )
+        fail, min_w = self._check_failures(ex, ez, cx, cz)
+        self.min_logical_weight = min(self.min_logical_weight, int(min_w))
+        return np.asarray(fail)
+
+    def _single_run(self):
+        """Reference-compatible single-shot entry (src/Simulators.py:117-168)."""
+        self._base_key, sub = jax.random.split(self._base_key)
+        return int(self.run_batch(sub, 1)[0])
+
+    def WordErrorRate(self, num_run: int, key=None):
+        """WER over ``num_run`` shots (src/Simulators.py:170-188 contract)."""
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        if self._mesh is not None and not self._needs_host:
+            from ..parallel import split_keys_for_mesh
+
+            n_dev = self._mesh.devices.size
+            run = self._sharded_runner()
+            batcher = ShotBatcher(num_run, self.batch_size * n_dev)
+            error_count = 0
+            for i in batcher:
+                keys = split_keys_for_mesh(jax.random.fold_in(key, i), self._mesh)
+                error_count += int(run(keys))
+            return wer_single_shot(error_count, batcher.total, self.K)
+        batcher = ShotBatcher(num_run, self.batch_size)
+        error_count = 0
+        for i in batcher:
+            fail = self.run_batch(jax.random.fold_in(key, i))
+            error_count += int(fail.sum())
+        return wer_single_shot(error_count, batcher.total, self.K)

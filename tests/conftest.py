@@ -2,15 +2,27 @@
 
 Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic is
 exercised without TPU hardware (the driver separately compile-checks the TPU
-path).  Must run before anything imports jax.
+path).  The environment's sitecustomize eagerly initializes the TPU ('axon')
+backend before pytest starts, so env vars alone are not enough — we force the
+platform through jax.config and drop any already-initialized backends.
 """
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    import jax._src.xla_bridge as _xb
+
+    _xb._clear_backends()
+except Exception:  # pragma: no cover - best effort; env may already be clean
+    pass
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:

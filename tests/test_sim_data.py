@@ -1,0 +1,100 @@
+import numpy as np
+import pytest
+
+import jax
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
+from qldpc_fault_tolerance_tpu.sim.common import wer_per_cycle, wer_single_shot
+from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+
+
+def _surface(d=3):
+    return hgp(rep_code(d), rep_code(d))
+
+
+def _make_sim(code, p, dec_cls=BPOSD_Decoder, **kw):
+    dec_x = dec_cls(code.hz, np.full(code.N, p), max_iter=20)
+    dec_z = dec_cls(code.hx, np.full(code.N, p), max_iter=20)
+    probs = [p / 3, p / 3, p / 3]
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z, pauli_error_probs=probs, **kw
+    )
+
+
+def test_zero_noise_never_fails():
+    code = _surface(3)
+    sim = _make_sim(code, 1e-9, batch_size=64)
+    wer, eb = sim.WordErrorRate(64)
+    assert wer == 0.0
+
+
+def test_heavy_noise_mostly_fails():
+    code = _surface(3)
+    sim = _make_sim(code, 0.75, batch_size=128)
+    fail = sim.run_batch(jax.random.PRNGKey(0), 128)
+    assert fail.mean() > 0.5
+
+
+def test_wer_decreases_with_p():
+    code = _surface(3)
+    wers = []
+    for p in (0.15, 0.03):
+        sim = _make_sim(code, p, batch_size=256, seed=1)
+        wer, _ = sim.WordErrorRate(512)
+        wers.append(wer)
+    assert wers[1] < wers[0]
+
+
+def test_surface_d3_failure_scaling():
+    """d=3 surface code with OSD: single errors always corrected, so the
+    failure probability must be O(p^2) — check it is well below the physical
+    rate at small p."""
+    code = _surface(3)
+    p = 0.01
+    sim = _make_sim(code, p, batch_size=1024, seed=2)
+    fails = sim.run_batch(jax.random.PRNGKey(2), 1024)
+    assert fails.mean() < 5 * p  # p^2-suppressed; generous stat bound
+
+
+def test_eval_logical_type_consistency():
+    code = _surface(3)
+    p = 0.08
+    key = jax.random.PRNGKey(5)
+    rates = {}
+    for t in ("X", "Z", "Total"):
+        sim = _make_sim(code, p, batch_size=512)
+        sim.eval_logical_type = t
+        rates[t] = sim.run_batch(key, 512).mean()
+    assert rates["Total"] >= max(rates["X"], rates["Z"]) - 1e-9
+
+
+def test_plain_bp_stays_on_device():
+    code = _surface(3)
+    sim = _make_sim(code, 0.05, dec_cls=BPDecoder, batch_size=128)
+    assert not sim._needs_host
+    fail = sim.run_batch(jax.random.PRNGKey(1), 128)
+    assert fail.shape == (128,)
+
+
+def test_wer_math_matches_reference_formulas():
+    # src/Simulators.py:174-188
+    wer, eb = wer_single_shot(10, 1000, K=17)
+    pl = 10 / 1000
+    assert np.isclose(wer, 1 - (1 - pl) ** (1 / 17))
+    pl_eb = np.sqrt((1 - pl) * pl / 1000)
+    assert np.isclose(eb, pl_eb * ((1 - pl_eb) ** (1 / 17 - 1)) / 17)
+    # src/Simulators.py:353-361
+    w, _ = wer_per_cycle(100, 1000, K=4, num_cycles=5)
+    per_qubit = 1 - (1 - 0.1) ** (1 / 4)
+    assert np.isclose(w, (1 - (1 - 2 * per_qubit) ** (1 / 5)) / 2)
+    with pytest.raises(AssertionError):
+        wer_per_cycle(1, 10, K=2, num_cycles=4)  # even cycles rejected
+
+
+def test_reproducible_with_same_key():
+    code = _surface(3)
+    sim = _make_sim(code, 0.06, batch_size=256)
+    f1 = sim.run_batch(jax.random.PRNGKey(9), 256)
+    f2 = sim.run_batch(jax.random.PRNGKey(9), 256)
+    assert np.array_equal(f1, f2)
