@@ -233,17 +233,31 @@ class ST_BP_Decoder_syndrome:
             ms_scaling_factor,
         )
 
+    needs_host_postprocess = False
+
+    def decode_batch_device(self, detector_histories):
+        """Device path: (B, num_rep, m) detector histories -> (B, n) folded
+        data corrections (XOR of per-slice data-error estimates,
+        src/Decoders.py:215-223)."""
+        arr = detector_histories
+        b = arr.shape[0]
+        synd = arr.reshape(b, self.num_rep * self.num_checks)
+        corr, aux = self._bp.decode_batch_device(synd)
+        blk = self.num_qubits + self.num_checks
+        data = corr.reshape(b, self.num_rep, blk)[:, :, : self.num_qubits]
+        folded = (jnp.sum(data.astype(jnp.int32), axis=1) % 2).astype(jnp.uint8)
+        return folded, aux
+
+    def host_postprocess(self, syndromes, corrections, aux):
+        return corrections
+
     def decode_batch(self, detector_histories) -> np.ndarray:
         """detector_histories: (B, num_rep, m) -> (B, n) folded data corrections."""
         arr = np.asarray(detector_histories)
         if arr.ndim == 2:
             arr = arr[None]
-        b = arr.shape[0]
-        synd = arr.reshape(b, self.num_rep * self.num_checks)
-        err_hist = self._bp.decode_batch(synd)
-        blk = self.num_qubits + self.num_checks
-        data = err_hist.reshape(b, self.num_rep, blk)[:, :, : self.num_qubits]
-        return (data.sum(axis=1) % 2).astype(np.uint8)
+        folded, _ = self.decode_batch_device(jnp.asarray(arr))
+        return np.asarray(folded)
 
     def decode(self, detector_history):
         return self.decode_batch(np.asarray(detector_history)[None])[0]

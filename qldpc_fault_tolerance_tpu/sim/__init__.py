@@ -1,0 +1,14 @@
+from .common import ShotBatcher, SimResult, wer_per_cycle, wer_single_shot
+from .data_error import CodeSimulator_DataError
+from .phenom import CodeSimulator_Phenon
+from .phenom_spacetime import CodeSimulator_Phenon_SpaceTime
+
+__all__ = [
+    "ShotBatcher",
+    "SimResult",
+    "wer_per_cycle",
+    "wer_single_shot",
+    "CodeSimulator_DataError",
+    "CodeSimulator_Phenon",
+    "CodeSimulator_Phenon_SpaceTime",
+]

@@ -1,0 +1,194 @@
+"""Phenomenological-noise Monte-Carlo engine.
+
+Replaces reference ``CodeSimulator_Phenon`` (src/Simulators.py:194-383): data
+depolarizing errors plus syndrome-measurement bit flips over many QEC rounds,
+each noisy round decoded against the extended matrix [H | I] with decoder 1,
+followed by one perfect round decoded with decoder 2 on the bare H.
+
+TPU structure: rounds are a ``lax.scan`` with the carried residual data error
+as state; the shot batch rides the leading axis through the whole scan.  The
+final decode runs outside the scan so a BPOSD decoder 2 can apply its host
+OSD stage to the minority of BP failures.  Decoder 1 must be pure device code
+(BP / FirstMin — the notebook configurations) for the scan path; a per-round
+host fallback covers host-postprocess decoders.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..noise import bit_flips, depolarizing_xz
+from ..ops.linalg import gf2_matmul
+from .common import ShotBatcher, wer_per_cycle, wer_single_shot
+
+__all__ = ["CodeSimulator_Phenon"]
+
+
+class CodeSimulator_Phenon:
+    """Reference-compatible constructor/WordErrorRate surface, batched on TPU."""
+
+    def __init__(self, code=None, decoder1_x=None, decoder1_z=None,
+                 decoder2_x=None, decoder2_z=None,
+                 pauli_error_probs=(0.01, 0.01, 0.01), q=0,
+                 eval_logical_type="Total", seed: int = 0,
+                 batch_size: int = 1024):
+        assert eval_logical_type in ["X", "Z", "Total"]
+        self.code = code
+        self.hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+        self.hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+        self.decoder1_z, self.decoder1_x = decoder1_z, decoder1_x
+        self.decoder2_z, self.decoder2_x = decoder2_z, decoder2_x
+        self.N = code.N
+        self.K = code.K
+        self.channel_probs = list(pauli_error_probs)
+        self.synd_prob = q
+        self.eval_logical_type = eval_logical_type
+        self.min_logical_weight = self.N
+        self.batch_size = int(batch_size)
+        self._base_key = jax.random.PRNGKey(seed)
+
+        self._mx = code.hx.shape[0]
+        self._mz = code.hz.shape[0]
+        self._hx_ext_t = jnp.asarray(self.hx_ext.T)
+        self._hz_ext_t = jnp.asarray(self.hz_ext.T)
+        self._hx_t = jnp.asarray(code.hx.T)
+        self._hz_t = jnp.asarray(code.hz.T)
+        self._lx_t = jnp.asarray(code.lx.T)
+        self._lz_t = jnp.asarray(code.lz.T)
+        self._dec1_on_device = not (
+            decoder1_x.needs_host_postprocess or decoder1_z.needs_host_postprocess
+        )
+
+    # ------------------------------------------------------------------
+    def _sample_ext(self, key, batch_size):
+        """One round of extended errors (src/Simulators.py:215-255):
+        depolarizing on the N data coords + Bernoulli(q) syndrome flips."""
+        kd, kx, kz = jax.random.split(key, 3)
+        ex, ez = depolarizing_xz(kd, (batch_size, self.N), tuple(self.channel_probs))
+        sx = bit_flips(kx, (batch_size, self._mz), self.synd_prob)
+        sz = bit_flips(kz, (batch_size, self._mx), self.synd_prob)
+        ex_ext = jnp.concatenate([ex, sx], axis=1)   # hz_ext acts on x errors
+        ez_ext = jnp.concatenate([ez, sz], axis=1)   # hx_ext acts on z errors
+        return ex_ext, ez_ext
+
+    def _round_step(self, carry, key, batch_size):
+        """One noisy QEC round (src/Simulators.py:265-281): only the data part
+        of the previous residual carries over; syndrome coords are fresh."""
+        data_x, data_z = carry  # (B, N)
+        ex_ext, ez_ext = self._sample_ext(key, batch_size)
+        cur_x = ex_ext.at[:, : self.N].set(ex_ext[:, : self.N] ^ data_x)
+        cur_z = ez_ext.at[:, : self.N].set(ez_ext[:, : self.N] ^ data_z)
+        synd_z = gf2_matmul(cur_z, self._hx_ext_t)
+        synd_x = gf2_matmul(cur_x, self._hz_ext_t)
+        dz, _ = self.decoder1_z.decode_batch_device(synd_z)
+        dx, _ = self.decoder1_x.decode_batch_device(synd_x)
+        cur_x = cur_x ^ dx
+        cur_z = cur_z ^ dz
+        return (cur_x[:, : self.N], cur_z[:, : self.N]), None
+
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size", "num_rounds"))
+    def _noisy_rounds_device(self, key, batch_size: int, num_rounds: int):
+        keys = jax.random.split(key, max(num_rounds - 1, 1))[: max(num_rounds - 1, 0)]
+        init = (
+            jnp.zeros((batch_size, self.N), jnp.uint8),
+            jnp.zeros((batch_size, self.N), jnp.uint8),
+        )
+        if num_rounds <= 1:
+            return init
+        step = functools.partial(self._round_step, batch_size=batch_size)
+        (data_x, data_z), _ = jax.lax.scan(lambda c, k: step(c, k), init, keys)
+        return data_x, data_z
+
+    def _noisy_rounds_host(self, key, batch_size, num_rounds):
+        """Fallback when decoder 1 needs host post-processing each round."""
+        data_x = jnp.zeros((batch_size, self.N), jnp.uint8)
+        data_z = jnp.zeros((batch_size, self.N), jnp.uint8)
+        for i in range(num_rounds - 1):
+            k = jax.random.fold_in(key, i)
+            ex_ext, ez_ext = self._sample_ext(k, batch_size)
+            cur_x = ex_ext.at[:, : self.N].set(ex_ext[:, : self.N] ^ data_x)
+            cur_z = ez_ext.at[:, : self.N].set(ez_ext[:, : self.N] ^ data_z)
+            synd_z = gf2_matmul(cur_z, self._hx_ext_t)
+            synd_x = gf2_matmul(cur_x, self._hz_ext_t)
+            cz, az = self.decoder1_z.decode_batch_device(synd_z)
+            cx, ax = self.decoder1_x.decode_batch_device(synd_x)
+            cx = jnp.asarray(self.decoder1_x.host_postprocess(
+                np.asarray(synd_x), np.asarray(cx), jax.device_get(ax)))
+            cz = jnp.asarray(self.decoder1_z.host_postprocess(
+                np.asarray(synd_z), np.asarray(cz), jax.device_get(az)))
+            data_x = (cur_x ^ cx)[:, : self.N]
+            data_z = (cur_z ^ cz)[:, : self.N]
+        return data_x, data_z
+
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _final_round_sample(self, key, data_x, data_z, batch_size: int):
+        """Final fresh error + bare-H syndromes (src/Simulators.py:283-297)."""
+        ex_ext, ez_ext = self._sample_ext(key, batch_size)
+        cur_x = data_x ^ ex_ext[:, : self.N]
+        cur_z = data_z ^ ez_ext[:, : self.N]
+        synd_z = gf2_matmul(cur_z, self._hx_t)
+        synd_x = gf2_matmul(cur_x, self._hz_t)
+        dz, az = self.decoder2_z.decode_batch_device(synd_z)
+        dx, ax = self.decoder2_x.decode_batch_device(synd_x)
+        return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _check_failures(self, cur_x, cur_z, dec_x, dec_z):
+        """Residual checks (src/Simulators.py:299-332).  Note the reference
+        asymmetry: X uses if/if (stabilizer OR logical), Z uses if/elif —
+        outcome-equivalent for the failure flag, so both are OR here."""
+        residual_x = cur_x ^ dec_x
+        residual_z = cur_z ^ dec_z
+        x_fail = (gf2_matmul(residual_x, self._hz_t).any(axis=-1)
+                  | gf2_matmul(residual_x, self._lz_t).any(axis=-1))
+        z_fail = (gf2_matmul(residual_z, self._hx_t).any(axis=-1)
+                  | gf2_matmul(residual_z, self._lx_t).any(axis=-1))
+        if self.eval_logical_type == "X":
+            return x_fail
+        if self.eval_logical_type == "Z":
+            return z_fail
+        return x_fail | z_fail
+
+    # ------------------------------------------------------------------
+    def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
+        bs = batch_size or self.batch_size
+        k_rounds, k_final = jax.random.split(key)
+        if self._dec1_on_device:
+            data_x, data_z = self._noisy_rounds_device(k_rounds, bs, num_rounds)
+        else:
+            data_x, data_z = self._noisy_rounds_host(k_rounds, bs, num_rounds)
+        cur_x, cur_z, sx, sz, dx, dz, ax, az = self._final_round_sample(
+            k_final, data_x, data_z, bs
+        )
+        if self.decoder2_x.needs_host_postprocess or self.decoder2_z.needs_host_postprocess:
+            dx = jnp.asarray(self.decoder2_x.host_postprocess(
+                np.asarray(sx), np.asarray(dx), jax.device_get(ax)))
+            dz = jnp.asarray(self.decoder2_z.host_postprocess(
+                np.asarray(sz), np.asarray(dz), jax.device_get(az)))
+        return np.asarray(self._check_failures(cur_x, cur_z, dx, dz))
+
+    def _single_run(self, num_rounds):
+        self._base_key, sub = jax.random.split(self._base_key)
+        return int(self.run_batch(sub, num_rounds, 1)[0])
+
+    def _count_failures(self, num_rounds, num_samples, key=None):
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        count = 0
+        for i in batcher:
+            count += int(self.run_batch(jax.random.fold_in(key, i), num_rounds).sum())
+        return count, batcher.total
+
+    def WordErrorRate(self, num_rounds: int, num_samples: int, key=None):
+        """Per-qubit-per-cycle WER (src/Simulators.py:334-362)."""
+        count, total = self._count_failures(num_rounds, num_samples, key)
+        return wer_per_cycle(count, total, self.K, num_rounds)
+
+    def WordErrorProbability(self, num_rounds: int, num_samples: int, key=None):
+        """End-of-run word error probability (src/Simulators.py:365-383)."""
+        count, total = self._count_failures(num_rounds, num_samples, key)
+        return wer_single_shot(count, total, self.K)

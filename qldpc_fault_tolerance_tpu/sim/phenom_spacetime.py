@@ -1,0 +1,167 @@
+"""Phenomenological space-time Monte-Carlo engine.
+
+Replaces reference ``CodeSimulator_Phenon_SpaceTime``
+(src/Simulators_SpaceTime.py:382-548): each noisy "round" holds ``num_rep``
+sub-rounds whose syndromes are stacked into a window and decoded jointly by
+the space-time BP decoder over the block-bidiagonal matrix; a final perfect
+round uses decoder 2 on the bare H.
+
+Preserved reference quirk (documented in SURVEY §2.4): the Z detector history
+is the XOR of consecutive syndrome slices, but the X history is passed raw
+(src/Simulators_SpaceTime.py:471-479).
+
+TPU structure: inner sub-rounds and outer rounds are nested ``lax.scan``s;
+the window decode is one BP call on the space-time Tanner graph.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..noise import bit_flips, depolarizing_xz
+from ..ops.linalg import gf2_matmul
+from .common import ShotBatcher, wer_per_cycle
+
+__all__ = ["CodeSimulator_Phenon_SpaceTime"]
+
+
+class CodeSimulator_Phenon_SpaceTime:
+    def __init__(self, code=None, decoder1_x=None, decoder1_z=None,
+                 decoder2_x=None, decoder2_z=None,
+                 pauli_error_probs=(0.01, 0.01, 0.01), q=0,
+                 eval_logical_type="Total", num_rep: int = 1, seed: int = 0,
+                 batch_size: int = 512):
+        assert eval_logical_type in ["X", "Z", "Total"]
+        self.code = code
+        self.hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+        self.hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+        self.decoder1_z, self.decoder1_x = decoder1_z, decoder1_x
+        self.decoder2_z, self.decoder2_x = decoder2_z, decoder2_x
+        self.N = code.N
+        self.K = code.K
+        self.channel_probs = list(pauli_error_probs)
+        self.synd_prob = q
+        self.eval_logical_type = eval_logical_type
+        self.num_rep = int(num_rep)
+        self.min_logical_weight = self.N
+        self.batch_size = int(batch_size)
+        self._base_key = jax.random.PRNGKey(seed)
+
+        self._mx = code.hx.shape[0]
+        self._mz = code.hz.shape[0]
+        self._hx_ext_t = jnp.asarray(self.hx_ext.T)
+        self._hz_ext_t = jnp.asarray(self.hz_ext.T)
+        self._hx_t = jnp.asarray(code.hx.T)
+        self._hz_t = jnp.asarray(code.hz.T)
+        self._lx_t = jnp.asarray(code.lx.T)
+        self._lz_t = jnp.asarray(code.lz.T)
+
+    def _sample_ext(self, key, batch_size):
+        kd, kx, kz = jax.random.split(key, 3)
+        ex, ez = depolarizing_xz(kd, (batch_size, self.N), tuple(self.channel_probs))
+        sx = bit_flips(kx, (batch_size, self._mz), self.synd_prob)
+        sz = bit_flips(kz, (batch_size, self._mx), self.synd_prob)
+        return jnp.concatenate([ex, sx], axis=1), jnp.concatenate([ez, sz], axis=1)
+
+    def _sub_round(self, carry, key, batch_size):
+        """One sub-round: new errors, syndrome snapshot, carry the data part
+        (src/Simulators_SpaceTime.py:458-469)."""
+        data_x, data_z = carry
+        ex_ext, ez_ext = self._sample_ext(key, batch_size)
+        cur_x = ex_ext.at[:, : self.N].set(ex_ext[:, : self.N] ^ data_x)
+        cur_z = ez_ext.at[:, : self.N].set(ez_ext[:, : self.N] ^ data_z)
+        synd_z = gf2_matmul(cur_z, self._hx_ext_t)
+        synd_x = gf2_matmul(cur_x, self._hz_ext_t)
+        return (cur_x[:, : self.N], cur_z[:, : self.N]), (synd_z, synd_x)
+
+    def _round_step(self, carry, key, batch_size):
+        """One window: num_rep sub-rounds, then a joint space-time decode
+        (src/Simulators_SpaceTime.py:454-481)."""
+        keys = jax.random.split(key, self.num_rep)
+        sub = functools.partial(self._sub_round, batch_size=batch_size)
+        carry, (hist_z, hist_x) = jax.lax.scan(lambda c, k: sub(c, k), carry, keys)
+        # (num_rep, B, m) -> (B, num_rep, m)
+        hist_z = jnp.swapaxes(hist_z, 0, 1)
+        hist_x = jnp.swapaxes(hist_x, 0, 1)
+        # difference consecutive Z slices; X left raw (reference quirk)
+        det_z = jnp.concatenate(
+            [hist_z[:, :1], hist_z[:, 1:] ^ hist_z[:, :-1]], axis=1
+        )
+        det_x = hist_x
+        cor_z, _ = self.decoder1_z.decode_batch_device(det_z)
+        cor_x, _ = self.decoder1_x.decode_batch_device(det_x)
+        data_x, data_z = carry
+        return (data_x ^ cor_x, data_z ^ cor_z), None
+
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size", "num_rounds"))
+    def _noisy_rounds_device(self, key, batch_size: int, num_rounds: int):
+        init = (
+            jnp.zeros((batch_size, self.N), jnp.uint8),
+            jnp.zeros((batch_size, self.N), jnp.uint8),
+        )
+        if num_rounds <= 1:
+            return init
+        keys = jax.random.split(key, num_rounds - 1)
+        step = functools.partial(self._round_step, batch_size=batch_size)
+        return jax.lax.scan(lambda c, k: step(c, k), init, keys)[0]
+
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _final_round(self, key, data_x, data_z, batch_size: int):
+        """Final perfect round (src/Simulators_SpaceTime.py:483-494)."""
+        ex_ext, ez_ext = self._sample_ext(key, batch_size)
+        cur_x = data_x ^ ex_ext[:, : self.N]
+        cur_z = data_z ^ ez_ext[:, : self.N]
+        synd_z = gf2_matmul(cur_z, self._hx_t)
+        synd_x = gf2_matmul(cur_x, self._hz_t)
+        dz, az = self.decoder2_z.decode_batch_device(synd_z)
+        dx, ax = self.decoder2_x.decode_batch_device(synd_x)
+        return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _check_failures(self, cur_x, cur_z, dec_x, dec_z):
+        residual_x = cur_x ^ dec_x
+        residual_z = cur_z ^ dec_z
+        x_fail = (gf2_matmul(residual_x, self._hz_t).any(axis=-1)
+                  | gf2_matmul(residual_x, self._lz_t).any(axis=-1))
+        z_fail = (gf2_matmul(residual_z, self._hx_t).any(axis=-1)
+                  | gf2_matmul(residual_z, self._lx_t).any(axis=-1))
+        if self.eval_logical_type == "X":
+            return x_fail
+        if self.eval_logical_type == "Z":
+            return z_fail
+        return x_fail | z_fail
+
+    # ------------------------------------------------------------------
+    def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
+        bs = batch_size or self.batch_size
+        k_rounds, k_final = jax.random.split(key)
+        data_x, data_z = self._noisy_rounds_device(k_rounds, bs, num_rounds)
+        cur_x, cur_z, sx, sz, dx, dz, ax, az = self._final_round(
+            k_final, data_x, data_z, bs
+        )
+        if self.decoder2_x.needs_host_postprocess or self.decoder2_z.needs_host_postprocess:
+            dx = jnp.asarray(self.decoder2_x.host_postprocess(
+                np.asarray(sx), np.asarray(dx), jax.device_get(ax)))
+            dz = jnp.asarray(self.decoder2_z.host_postprocess(
+                np.asarray(sz), np.asarray(dz), jax.device_get(az)))
+        return np.asarray(self._check_failures(cur_x, cur_z, dx, dz))
+
+    def _single_run(self, num_rounds):
+        self._base_key, sub = jax.random.split(self._base_key)
+        return int(self.run_batch(sub, num_rounds, 1)[0])
+
+    def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
+        """src/Simulators_SpaceTime.py:531-548: cycles are grouped into
+        windows of num_rep; total cycle count must come out odd."""
+        num_rounds = int((num_cycles - 1) / self.num_rep + 1)
+        total_num_cycles = (num_rounds - 1) * self.num_rep + 1
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        count = 0
+        for i in batcher:
+            count += int(self.run_batch(jax.random.fold_in(key, i), num_rounds).sum())
+        return wer_per_cycle(count, batcher.total, self.K, total_num_cycles)

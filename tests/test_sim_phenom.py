@@ -1,0 +1,105 @@
+import numpy as np
+import pytest
+
+import jax
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import (
+    BPDecoder,
+    BPOSD_Decoder,
+    ST_BP_Decoder_syndrome,
+)
+from qldpc_fault_tolerance_tpu.sim import (
+    CodeSimulator_Phenon,
+    CodeSimulator_Phenon_SpaceTime,
+)
+
+
+def _surface(d=3):
+    return hgp(rep_code(d), rep_code(d))
+
+
+def _phenom_sim(code, p, q, **kw):
+    hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+    hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+    probs_ext_z = np.concatenate([np.full(code.N, p), np.full(code.hx.shape[0], q)])
+    probs_ext_x = np.concatenate([np.full(code.N, p), np.full(code.hz.shape[0], q)])
+    dec1_z = BPDecoder(hx_ext, probs_ext_z, max_iter=15)
+    dec1_x = BPDecoder(hz_ext, probs_ext_x, max_iter=15)
+    dec2_z = BPOSD_Decoder(code.hx, np.full(code.N, p), max_iter=15, osd_order=4)
+    dec2_x = BPOSD_Decoder(code.hz, np.full(code.N, p), max_iter=15, osd_order=4)
+    return CodeSimulator_Phenon(
+        code=code, decoder1_x=dec1_x, decoder1_z=dec1_z,
+        decoder2_x=dec2_x, decoder2_z=dec2_z,
+        pauli_error_probs=[p / 3, p / 3, p / 3], q=q, **kw
+    )
+
+
+def test_zero_noise_no_failures():
+    sim = _phenom_sim(_surface(3), 1e-9, 0.0, batch_size=32)
+    fails = sim.run_batch(jax.random.PRNGKey(0), num_rounds=3, batch_size=32)
+    assert fails.sum() == 0
+
+
+def test_failure_rate_grows_with_rounds():
+    code = _surface(3)
+    p, q = 0.04, 0.04
+    sim = _phenom_sim(code, p, q, batch_size=256)
+    f1 = sim.run_batch(jax.random.PRNGKey(1), num_rounds=1, batch_size=256).mean()
+    f7 = sim.run_batch(jax.random.PRNGKey(1), num_rounds=7, batch_size=256).mean()
+    assert f7 >= f1
+
+
+def test_wer_requires_odd_cycles():
+    sim = _phenom_sim(_surface(3), 0.02, 0.02, batch_size=16)
+    with pytest.raises(AssertionError):
+        sim.WordErrorRate(num_rounds=4, num_samples=16)
+
+
+def test_word_error_probability_in_range():
+    sim = _phenom_sim(_surface(3), 0.03, 0.03, batch_size=128)
+    wep, eb = sim.WordErrorProbability(num_rounds=3, num_samples=128)
+    assert 0 <= wep <= 1
+    assert eb is not None
+
+
+def _st_sim(code, p, q, num_rep, **kw):
+    dec1_z = ST_BP_Decoder_syndrome(code.hx, p_data=p, p_synd=q, max_iter=30,
+                                    num_rep=num_rep)
+    dec1_x = ST_BP_Decoder_syndrome(code.hz, p_data=p, p_synd=q, max_iter=30,
+                                    num_rep=num_rep)
+    dec2_z = BPOSD_Decoder(code.hx, np.full(code.N, p), max_iter=15, osd_order=4)
+    dec2_x = BPOSD_Decoder(code.hz, np.full(code.N, p), max_iter=15, osd_order=4)
+    return CodeSimulator_Phenon_SpaceTime(
+        code=code, decoder1_x=dec1_x, decoder1_z=dec1_z,
+        decoder2_x=dec2_x, decoder2_z=dec2_z,
+        pauli_error_probs=[p / 3, p / 3, p / 3], q=q, num_rep=num_rep, **kw
+    )
+
+
+def test_st_zero_noise_no_failures():
+    sim = _st_sim(_surface(3), 1e-9, 0.0, num_rep=2, batch_size=32)
+    fails = sim.run_batch(jax.random.PRNGKey(0), num_rounds=3, batch_size=32)
+    assert fails.sum() == 0
+
+
+def test_st_rep1_statistically_matches_plain_phenom():
+    """With num_rep=1 the space-time matrix is exactly [H|I], so the ST engine
+    must reproduce the plain phenomenological engine's statistics."""
+    code = _surface(3)
+    p = q = 0.05
+    n_shots = 768
+    sim_st = _st_sim(code, p, q, num_rep=1, batch_size=n_shots, seed=3)
+    sim_pl = _phenom_sim(code, p, q, batch_size=n_shots, seed=4)
+    f_st = sim_st.run_batch(jax.random.PRNGKey(5), num_rounds=5).mean()
+    f_pl = sim_pl.run_batch(jax.random.PRNGKey(6), num_rounds=5).mean()
+    # binomial 3-sigma band around each other
+    sigma = np.sqrt(max(f_pl * (1 - f_pl), 1e-4) / n_shots)
+    assert abs(f_st - f_pl) < 6 * sigma + 0.05, (f_st, f_pl)
+
+
+def test_st_wer_cycle_accounting():
+    sim = _st_sim(_surface(3), 0.02, 0.02, num_rep=3, batch_size=64)
+    # num_cycles=13 -> num_rounds=5, total cycles=13 (odd) — demo config shape
+    wer, _ = sim.WordErrorRate(num_cycles=13, num_samples=64)
+    assert 0 <= wer <= 1
