@@ -1,0 +1,50 @@
+"""Circuit layer: IR, CX scheduling, noise plugin, TPU detector sampler, DEM.
+
+Replaces the reference's stim dependency (circuit IR + detector sampling +
+detector-error-model derivation, src/Simulators.py:386-671,
+src/Simulators_SpaceTime.py:672-1077) and its host-side schedulers
+(src/CircuitScheduling.py) with a self-contained TPU-native stack:
+
+  scheduling    host-side CX schedule generation (coloration / random)
+  ir            stabilizer-circuit IR with stim-compatible text round-trip
+  error_plugin  circuit-text noise rewrites (AddCXError & friends)
+  sampler       vectorized Pauli-frame detector sampler (jit/vmap, lax.scan
+                over REPEAT blocks)
+  dem           detector-error-model derivation + fault-hypergraph extraction
+"""
+from .scheduling import ColorationCircuit, RandomCircuit, validate_schedule
+from .ir import Circuit, target_rec
+from .error_plugin import (
+    AddCXError,
+    AddCZError,
+    AddMeasurementError,
+    AddResetError,
+    AddIdlingError,
+    AddSingleQubitErrorBeforeRound,
+)
+from .sampler import FrameSampler
+from .dem import (
+    DetectorErrorModel,
+    detector_error_model,
+    GenFaultHyperGraph,
+    GenCorrecHyperGraph,
+)
+
+__all__ = [
+    "ColorationCircuit",
+    "RandomCircuit",
+    "validate_schedule",
+    "Circuit",
+    "target_rec",
+    "AddCXError",
+    "AddCZError",
+    "AddMeasurementError",
+    "AddResetError",
+    "AddIdlingError",
+    "AddSingleQubitErrorBeforeRound",
+    "FrameSampler",
+    "DetectorErrorModel",
+    "detector_error_model",
+    "GenFaultHyperGraph",
+    "GenCorrecHyperGraph",
+]

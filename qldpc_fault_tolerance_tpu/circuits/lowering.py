@@ -1,0 +1,335 @@
+"""Lowering of the circuit IR to fused, vectorizable primitive ops.
+
+The sampler (device, random) and the detector-error-model builder (host,
+deterministic) share this compiled form, so fault propagation and sampling
+agree by construction.
+
+Compilation steps:
+  1. walk the IR, resolving DETECTOR / OBSERVABLE_INCLUDE record lookbacks to
+     absolute measurement-record columns (REPEAT blocks contribute contiguous
+     record ranges);
+  2. lower gates/noise to primitive ops with explicit target index arrays and
+     *absolute* record columns on measurement ops (so op order no longer
+     encodes record order);
+  3. fuse ops: an op may migrate backward past ops whose qubit support is
+     disjoint from its own and merge into an earlier op with the same kind and
+     args — disjoint-support ops commute, so this is semantics-preserving.
+     CX/CZ additionally refuse a merge that would put one qubit on both the
+     control and target side (shared controls or shared targets are fine:
+     the fused update uses XOR-accumulating scatters).  This collapses the
+     reference's CX / DEPOLARIZE2 interleave (AddCXError emits one noise line
+     per gate line) into one gate op + one noise op per scheduling layer.
+
+Zero-probability noise ops are dropped (the notebooks routinely pass
+p_i = p_state_p = 0, src demo cell 2).
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from .ir import Circuit, Instruction, MEASUREMENT_NAMES, RecTarget, RepeatBlock
+
+__all__ = ["Op", "Segment", "CompiledCircuit", "compile_circuit"]
+
+
+@dataclasses.dataclass
+class Op:
+    """One fused primitive op.
+
+    kind:
+      'cx'/'cz'    a, b: control/target index arrays
+      'h'          a: qubit indices (x/z swap)
+      'reset'      a: qubit indices (frame cleared; covers R and RX)
+      'measure'    a: qubit indices; basis 'z' (M/MR: record x-frame) or
+                   'x' (MX: record z-frame); rec: absolute record columns;
+                   reset_after: MR; collapse: randomize conjugate frame (M/MX)
+      'dep1'       a, p: single-qubit depolarizing (X/Y/Z each p/3)
+      'dep2'       a, b, p: two-qubit depolarizing (15 components, p/15 each)
+      'perr'       a, p, fx, fz: Pauli error (X_ERROR: fx; Z_ERROR: fz;
+                   Y_ERROR: both)
+    """
+
+    kind: str
+    a: np.ndarray
+    b: np.ndarray | None = None
+    p: float = 0.0
+    basis: str = "z"
+    rec: np.ndarray | None = None
+    reset_after: bool = False
+    collapse: bool = False
+    fx: bool = False
+    fz: bool = False
+    noise_id: int = -1
+
+    @property
+    def is_random(self) -> bool:
+        return self.kind in ("dep1", "dep2", "perr") or (
+            self.kind == "measure" and self.collapse and not self.reset_after
+        )
+
+    def support(self) -> frozenset:
+        s = set(self.a.tolist())
+        if self.b is not None:
+            s |= set(self.b.tolist())
+        return frozenset(s)
+
+
+@dataclasses.dataclass
+class Segment:
+    """A run of ops executed once ('block') or scanned ('repeat')."""
+
+    kind: str  # 'block' | 'repeat'
+    ops: list[Op]
+    repeat_count: int = 1
+    meas_per_iter: int = 0  # record width contributed by one iteration
+    rec_offset: int = 0  # absolute record column of this segment's first bit
+
+
+@dataclasses.dataclass
+class CompiledCircuit:
+    num_qubits: int
+    num_measurements: int
+    num_detectors: int
+    num_observables: int
+    segments: list[Segment]
+    # detector d = XOR of record columns det_cols[d]; same for observables
+    det_cols: list[list[int]]
+    obs_cols: list[list[int]]
+    # text-emission metadata for the DEM: ('shift',) and ('det', det_index,
+    # args) events in circuit order, only for detectors carrying args
+    coord_events: list[tuple]
+
+    def flattened_ops(self):
+        """Ops with repeat segments unrolled; measurement record columns
+        shifted per iteration.  Yields (op, unrolled_index)."""
+        i = 0
+        for seg in self.segments:
+            for it in range(seg.repeat_count if seg.kind == "repeat" else 1):
+                for op in seg.ops:
+                    if op.kind == "measure" and seg.kind == "repeat":
+                        op = dataclasses.replace(
+                            op, rec=op.rec + seg.rec_offset + it * seg.meas_per_iter
+                        )
+                    elif op.kind == "measure":
+                        op = dataclasses.replace(op, rec=op.rec + seg.rec_offset)
+                    yield op, i
+                    i += 1
+
+
+def _mergeable(into: Op, op: Op) -> bool:
+    if into.kind != op.kind:
+        return False
+    if into.kind in ("dep1", "dep2", "perr"):
+        return into.p == op.p and into.fx == op.fx and into.fz == op.fz
+    if into.kind in ("cx", "cz"):
+        # one side may repeat, but no qubit may sit on both sides of the
+        # fused op (that would reorder a read-after-write)
+        a = set(into.a.tolist()) | set(op.a.tolist())
+        b = set(into.b.tolist()) | set(op.b.tolist())
+        return not (a & b)
+    if into.kind in ("h", "reset"):
+        return not (into.support() & op.support())
+    if into.kind == "measure":
+        return (
+            into.basis == op.basis
+            and into.reset_after == op.reset_after
+            and into.collapse == op.collapse
+            and not (into.support() & op.support())
+        )
+    return False
+
+
+def _merge(into: Op, op: Op) -> Op:
+    a = np.concatenate([into.a, op.a])
+    b = None if into.b is None else np.concatenate([into.b, op.b])
+    rec = None if into.rec is None else np.concatenate([into.rec, op.rec])
+    return dataclasses.replace(into, a=a, b=b, rec=rec)
+
+
+def _fuse(ops: list[Op]) -> list[Op]:
+    fused: list[Op] = []
+    supports: list[frozenset] = []
+    for op in ops:
+        sup = op.support()
+        merged = False
+        # migrate backward past disjoint ops; merge into a compatible one
+        for j in range(len(fused) - 1, -1, -1):
+            if _mergeable(fused[j], op):
+                fused[j] = _merge(fused[j], op)
+                supports[j] = supports[j] | sup
+                merged = True
+                break
+            if supports[j] & sup:
+                break
+        if not merged:
+            fused.append(op)
+            supports.append(sup)
+    return fused
+
+
+def _lower_instruction(ins: Instruction, rec_base: int):
+    """Lower one IR instruction to zero or one proto-op.  rec_base is the
+    measurement count before this instruction (for record columns relative to
+    the enclosing segment)."""
+    name = ins.name
+    q = np.asarray([t for t in ins.targets if not isinstance(t, RecTarget)], dtype=np.int32)
+    if name == "TICK" or name in ("DETECTOR", "OBSERVABLE_INCLUDE", "SHIFT_COORDS"):
+        return None
+    if name in ("R", "RX"):
+        return Op("reset", q)
+    if name == "H":
+        return Op("h", q)
+    if name in ("CX", "CZ"):
+        return Op(name.lower(), q[0::2], q[1::2])
+    if name in ("M", "MR", "MX"):
+        rec = np.arange(rec_base, rec_base + len(q), dtype=np.int32)
+        return Op(
+            "measure", q, basis="x" if name == "MX" else "z", rec=rec,
+            reset_after=(name == "MR"), collapse=(name != "MR"),
+        )
+    if name in ("X_ERROR", "Y_ERROR", "Z_ERROR", "DEPOLARIZE1", "DEPOLARIZE2"):
+        p = float(ins.args[0]) if ins.args else 0.0
+        if p == 0.0 or len(q) == 0:
+            return None
+        if name == "DEPOLARIZE1":
+            return Op("dep1", q, p=p)
+        if name == "DEPOLARIZE2":
+            return Op("dep2", q[0::2], q[1::2], p=p)
+        return Op(
+            "perr", q, p=p,
+            fx=name in ("X_ERROR", "Y_ERROR"), fz=name in ("Z_ERROR", "Y_ERROR"),
+        )
+    raise ValueError(f"cannot lower instruction {name}")
+
+
+def compile_circuit(circuit: Circuit) -> CompiledCircuit:
+    nq = circuit.num_qubits
+
+    # ---- pass 1: resolve record columns for detectors/observables, collect
+    # coordinate events, and lower to per-segment proto-op lists
+    det_cols: list[list[int]] = []
+    obs_cols_by_idx: dict[int, list[int]] = {}
+    coord_events: list[tuple] = []
+    segments: list[Segment] = []
+    meas_count = 0
+    det_count = 0
+
+    def walk(items, ops_out: list[Op], seg_rec_base: int):
+        nonlocal meas_count, det_count
+        for item in items:
+            if isinstance(item, RepeatBlock):
+                raise ValueError("nested REPEAT blocks are not supported")
+            ins = item
+            if ins.name == "DETECTOR":
+                det_cols.append(
+                    sorted(meas_count + t.offset for t in ins.targets)
+                )
+                if ins.args:
+                    coord_events.append(("det", det_count, ins.args))
+                det_count += 1
+                continue
+            if ins.name == "OBSERVABLE_INCLUDE":
+                idx = int(ins.args[0]) if ins.args else 0
+                obs_cols_by_idx.setdefault(idx, []).extend(
+                    meas_count + t.offset for t in ins.targets
+                )
+                continue
+            if ins.name == "SHIFT_COORDS":
+                coord_events.append(("shift", tuple(ins.args)))
+                continue
+            op = _lower_instruction(ins, meas_count - seg_rec_base)
+            if ins.name in MEASUREMENT_NAMES:
+                meas_count += sum(
+                    1 for t in ins.targets if not isinstance(t, RecTarget)
+                )
+            if op is not None:
+                ops_out.append(op)
+
+    pending: list[Op] = []
+    pending_rec_offset = 0
+
+    def flush_pending():
+        nonlocal pending
+        if pending:
+            segments.append(
+                Segment("block", _fuse(pending), rec_offset=pending_rec_offset)
+            )
+        pending = []
+
+    for item in circuit.items:
+        if isinstance(item, RepeatBlock):
+            body = item.body
+            if any(isinstance(x, RepeatBlock) for x in body.items):
+                # only the outermost repeat is scanned; inner repeats (e.g.
+                # the (num_rep-1)-fold sub-round block of the space-time
+                # circuit) are unrolled into the scanned body
+                flat = Circuit()
+                flat.items = list(body.flattened())
+                body = flat
+            body_meas = body.num_measurements
+            body_dets = body.num_detectors
+            flush_pending()
+            seg_ops: list[Op] = []
+            rec_offset = meas_count
+            # resolve detector lookbacks against iteration 0; later
+            # iterations' columns follow by a uniform +it*body_meas shift
+            # (valid for lookbacks into the current or any earlier iteration,
+            # e.g. the reference's difference detectors)
+            start_meas = meas_count
+            start_det = det_count
+            body_coord_start = len(coord_events)
+            walk(body.items, seg_ops, start_meas)
+            first_iter_det = det_cols[start_det:det_count]
+            first_iter_coords = coord_events[body_coord_start:]
+            for it in range(1, item.repeat_count):
+                shift = it * body_meas
+                for cols in first_iter_det:
+                    det_cols.append([c + shift for c in cols])
+                for ev in first_iter_coords:
+                    if ev[0] == "det":
+                        coord_events.append(
+                            ("det", ev[1] + it * body_dets, ev[2])
+                        )
+                    else:
+                        coord_events.append(ev)
+            det_count = start_det + item.repeat_count * body_dets
+            meas_count = start_meas + item.repeat_count * body_meas
+            segments.append(
+                Segment(
+                    "repeat", _fuse(seg_ops), repeat_count=item.repeat_count,
+                    meas_per_iter=body_meas, rec_offset=rec_offset,
+                )
+            )
+        else:
+            if not pending:
+                pending_rec_offset = meas_count
+            walk([item], pending, pending_rec_offset)
+    flush_pending()
+
+    # measurement ops inside 'block' segments carry columns relative to the
+    # segment; inside 'repeat' segments relative to the iteration (both are
+    # shifted by Segment.rec_offset / iteration stride at execution time)
+
+    # ---- assign noise ids
+    nid = 0
+    for seg in segments:
+        for op in seg.ops:
+            if op.is_random or op.kind == "measure":
+                op.noise_id = nid
+                nid += 1
+
+    num_obs = (max(obs_cols_by_idx) + 1) if obs_cols_by_idx else 0
+    obs_cols = [sorted(obs_cols_by_idx.get(i, [])) for i in range(num_obs)]
+
+    return CompiledCircuit(
+        num_qubits=nq,
+        num_measurements=meas_count,
+        num_detectors=det_count,
+        num_observables=num_obs,
+        segments=segments,
+        det_cols=det_cols,
+        obs_cols=obs_cols,
+        coord_events=coord_events,
+    )

@@ -1,0 +1,289 @@
+"""Tests for the circuit layer: scheduling, IR round-trip, noise plugin,
+Pauli-frame sampler, and DEM derivation."""
+import numpy as np
+import jax
+import pytest
+
+from qldpc_fault_tolerance_tpu.circuits import (
+    AddCXError,
+    Circuit,
+    ColorationCircuit,
+    FrameSampler,
+    GenCorrecHyperGraph,
+    GenFaultHyperGraph,
+    RandomCircuit,
+    detector_error_model,
+    target_rec,
+    validate_schedule,
+)
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+
+
+@pytest.fixture(scope="module")
+def surface3():
+    return hgp(rep_code(3), rep_code(3))
+
+
+# ------------------------------------------------------------- scheduling
+def test_coloration_schedule_valid(surface3):
+    for H in (surface3.hx, surface3.hz):
+        sched = ColorationCircuit(H)
+        validate_schedule(H, sched, require_disjoint_qubits=True)
+
+
+def test_coloration_depth_bounded(surface3):
+    H = surface3.hx
+    sched = ColorationCircuit(H)
+    delta = int(max(H.sum(1).max(), H.sum(0).max()))
+    assert len(sched) <= delta + 2  # padded-graph degree
+
+
+def test_random_schedule_valid(surface3):
+    H = surface3.hz
+    sched = RandomCircuit(H)
+    # random schedules may reuse a qubit within a timestep
+    validate_schedule(H, sched, require_disjoint_qubits=False)
+    assert len(sched) == int(H.sum(1).max())
+
+
+def test_random_schedule_deterministic(surface3):
+    a = RandomCircuit(surface3.hx)
+    b = RandomCircuit(surface3.hx)
+    assert a == b
+
+
+# --------------------------------------------------------------------- IR
+def test_ir_text_round_trip():
+    c = Circuit()
+    c.append("RX", [0, 1, 2])
+    c.append("H", [3])
+    c.append("CX", [3, 0])
+    c.append("DEPOLARIZE2", [3, 0], 0.01)
+    c.append("MR", [3])
+    c.append("DETECTOR", [target_rec(-1)], (0,))
+    c.append("SHIFT_COORDS", [], (1,))
+    c.append("MX", [0, 1, 2])
+    c.append("OBSERVABLE_INCLUDE", [target_rec(-3), target_rec(-2)], (0,))
+    text = str(c)
+    assert Circuit(text) == c
+
+
+def test_ir_repeat_block():
+    body = Circuit().append("MR", [0])
+    c = Circuit().append("R", [0]) + 5 * body
+    assert "REPEAT 5 {" in str(c)
+    assert c.num_measurements == 5
+    assert Circuit(str(c)) == c
+
+
+def test_ir_counts():
+    c = Circuit()
+    c.append("MR", [0, 1])
+    c.append("DETECTOR", [target_rec(-2)])
+    c.append("DETECTOR", [target_rec(-1)])
+    c.append("OBSERVABLE_INCLUDE", [target_rec(-1)], (2,))
+    assert c.num_measurements == 2
+    assert c.num_detectors == 2
+    assert c.num_observables == 3
+    assert c.num_qubits == 2
+
+
+# ------------------------------------------------------------ error plugin
+def test_add_cx_error():
+    c = Circuit()
+    c.append("CX", [0, 1])
+    c.append("CX", [2, 3])
+    noisy = AddCXError(c, "DEPOLARIZE2(0.25)")
+    text = str(noisy)
+    assert text.count("DEPOLARIZE2(0.25) 0 1") == 1
+    assert text.count("DEPOLARIZE2(0.25) 2 3") == 1
+    # error follows its gate
+    assert text.index("CX 0 1") < text.index("DEPOLARIZE2(0.25) 0 1")
+
+
+# ---------------------------------------------------------------- sampler
+def _rep3_two_rounds(p_data: float) -> Circuit:
+    """3-qubit repetition code, two Z-check extraction rounds with an
+    X_ERROR(p) on the middle data qubit between them."""
+    c = Circuit()
+    c.append("R", [0, 1, 2, 3, 4])
+    for ctrl, tgt in [(0, 3), (1, 3), (1, 4), (2, 4)]:
+        c.append("CX", [ctrl, tgt])
+    c.append("MR", [3, 4])
+    c.append("DETECTOR", [target_rec(-2)])
+    c.append("DETECTOR", [target_rec(-1)])
+    c.append("X_ERROR", [1], p_data)
+    for ctrl, tgt in [(0, 3), (1, 3), (1, 4), (2, 4)]:
+        c.append("CX", [ctrl, tgt])
+    c.append("MR", [3, 4])
+    c.append("DETECTOR", [target_rec(-2), target_rec(-4)])
+    c.append("DETECTOR", [target_rec(-1), target_rec(-3)])
+    c.append("M", [0, 1, 2])
+    c.append("OBSERVABLE_INCLUDE", [target_rec(-2)], (0,))
+    return c
+
+
+def test_sampler_noiseless_deterministic():
+    c = _rep3_two_rounds(0.0)
+    s = FrameSampler(c)
+    dets, obs = s.sample(jax.random.PRNGKey(0), 64)
+    assert not np.asarray(dets).any()
+    assert not np.asarray(obs).any()
+
+
+def test_sampler_single_fault_statistics():
+    p = 0.3
+    s = FrameSampler(_rep3_two_rounds(p))
+    n = 20000
+    dets, obs = s.sample(jax.random.PRNGKey(1), n)
+    dets = np.asarray(dets)
+    # first-round detectors never fire; both second-round difference
+    # detectors fire exactly when the X error occurred
+    assert not dets[:, :2].any()
+    rate = dets[:, 2].mean()
+    assert abs(rate - p) < 4 * np.sqrt(p * (1 - p) / n)
+    assert np.array_equal(dets[:, 2], dets[:, 3])
+    # the data error flips the final measurement of qubit 1 = observable 0
+    assert np.array_equal(np.asarray(obs)[:, 0], dets[:, 2])
+
+
+def test_sampler_repeat_block_matches_unrolled():
+    """A REPEAT-compiled circuit must sample the same *distribution* as its
+    unrolled form; with p=0/1 noise it must match exactly."""
+    body = Circuit()
+    body.append("X_ERROR", [0], 1.0)
+    body.append("MR", [0])
+    body.append("DETECTOR", [target_rec(-1)])
+    rep = Circuit().append("R", [0]) + 4 * body
+    s = FrameSampler(rep)
+    dets, _ = s.sample(jax.random.PRNGKey(0), 8)
+    # X before every MR: every detector fires every shot
+    assert np.asarray(dets).all()
+
+
+def test_sampler_mr_resets_frame():
+    c = Circuit()
+    c.append("R", [0])
+    c.append("X_ERROR", [0], 1.0)
+    c.append("MR", [0])
+    c.append("DETECTOR", [target_rec(-1)])
+    c.append("MR", [0])
+    c.append("DETECTOR", [target_rec(-1)])
+    s = FrameSampler(c)
+    dets, _ = s.sample(jax.random.PRNGKey(0), 4)
+    dets = np.asarray(dets)
+    assert dets[:, 0].all()  # error seen once
+    assert not dets[:, 1].any()  # MR reset the frame
+
+
+def test_sampler_depolarize2_propagation():
+    """DEPOLARIZE2(1.0) after CX: ancilla X-flip component rate = 8/15."""
+    c = Circuit()
+    c.append("R", [0, 1])
+    c.append("CX", [0, 1])
+    c.append("DEPOLARIZE2", [0, 1], 1.0)
+    c.append("MR", [1])
+    c.append("DETECTOR", [target_rec(-1)])
+    s = FrameSampler(c)
+    n = 30000
+    dets, _ = s.sample(jax.random.PRNGKey(2), n)
+    rate = np.asarray(dets)[:, 0].mean()
+    # components flipping x of qubit 1: second Pauli in {X,Y}: 8 of 15
+    assert abs(rate - 8 / 15) < 4 * np.sqrt((8 / 15) * (7 / 15) / n)
+
+
+# -------------------------------------------------------------------- DEM
+def test_dem_single_fault():
+    c = _rep3_two_rounds(0.125)
+    dem = detector_error_model(c)
+    assert len(dem.errors) == 1
+    p, dets, obs = dem.errors[0]
+    assert abs(p - 0.125) < 1e-12
+    assert dets == (2, 3)
+    assert obs == (0,)
+
+
+def test_dem_merges_identical_symptoms():
+    c = Circuit()
+    c.append("R", [0])
+    c.append("X_ERROR", [0], 0.1)
+    c.append("X_ERROR", [0], 0.2)
+    c.append("MR", [0])
+    c.append("DETECTOR", [target_rec(-1)])
+    dem = detector_error_model(c)
+    assert len(dem.errors) == 1
+    # XOR-combination: 0.1*0.8 + 0.2*0.9
+    assert abs(dem.errors[0][0] - 0.26) < 1e-12
+
+
+def test_dem_marginals_match_sampler():
+    """Detector marginals from the sampler must match the DEM prediction
+    P(det) = (1 - prod(1-2p_i)) / 2 over the errors touching it."""
+    p = 0.05
+    c = Circuit()
+    c.append("R", [0, 1, 2, 3, 4])
+    for ctrl, tgt in [(0, 3), (1, 3), (1, 4), (2, 4)]:
+        c.append("CX", [ctrl, tgt])
+        c.append("DEPOLARIZE2", [ctrl, tgt], p)
+    c.append("MR", [3, 4])
+    c.append("DETECTOR", [target_rec(-2)])
+    c.append("DETECTOR", [target_rec(-1)])
+    dem = detector_error_model(c)
+    pred = np.zeros(2)
+    for d in range(2):
+        prod = 1.0
+        for q, dets, _ in dem.errors:
+            if d in dets:
+                prod *= 1 - 2 * q
+        pred[d] = (1 - prod) / 2
+
+    s = FrameSampler(c)
+    n = 40000
+    dets, _ = s.sample(jax.random.PRNGKey(3), n)
+    rates = np.asarray(dets).mean(axis=0)
+    for d in range(2):
+        assert abs(rates[d] - pred[d]) < 5 * np.sqrt(pred[d] * (1 - pred[d]) / n)
+
+
+def test_dem_text_and_hypergraph_round_trip():
+    """DEM text layout must drive the (window, final) layer extraction."""
+    m = 2  # checks
+    c = Circuit()
+    c.append("R", [0, 1, 2, 3, 4])
+    # window: 2 sub-rounds of extraction with data noise, coordinate shift
+    # before the window detectors (reference rep1 layout)
+    c.append("SHIFT_COORDS", [], (1,))
+    for rep in range(2):
+        c.append("X_ERROR", [1], 0.1)
+        for ctrl, tgt in [(0, 3), (1, 3), (1, 4), (2, 4)]:
+            c.append("CX", [ctrl, tgt])
+        c.append("MR", [3, 4])
+        if rep == 0:
+            c.append("DETECTOR", [target_rec(-2)], (0,))
+            c.append("DETECTOR", [target_rec(-1)], (0,))
+        else:
+            c.append("DETECTOR", [target_rec(-2), target_rec(-4)], (0,))
+            c.append("DETECTOR", [target_rec(-1), target_rec(-3)], (0,))
+    # final layer
+    c.append("SHIFT_COORDS", [], (1,))
+    c.append("M", [0, 1, 2])
+    c.append("DETECTOR", [target_rec(-3), target_rec(-2)], (0,))
+    c.append("DETECTOR", [target_rec(-2), target_rec(-1)], (0,))
+    c.append("OBSERVABLE_INCLUDE", [target_rec(-3)], (0,))
+
+    dem = detector_error_model(c)
+    text = str(dem)
+    assert "shift_detectors(1) 0" in text
+    H_list, L_list, ps_list = GenFaultHyperGraph(
+        text, num_rounds=1, num_rep=2, num_logicals=1
+    )
+    # first layer holds the 2-sub-round window (4 detectors), last the final 2
+    assert H_list[0].shape[0] == 2 * m
+    assert H_list[1].shape[0] == m
+    assert L_list[0].shape[0] == 1
+    assert len(ps_list[0]) == H_list[0].shape[1]
+    h_cor = GenCorrecHyperGraph(
+        text, num_rounds=1, num_rep=2, num_checks=m, num_logicals=1
+    )
+    assert h_cor.shape[0] == m
+    assert h_cor.shape[1] == H_list[0].shape[1]
