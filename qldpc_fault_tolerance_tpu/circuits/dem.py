@@ -133,6 +133,17 @@ def _propagate_chunk(ops, faults, nq, num_meas):
             if op.reset_after:
                 fx[:, op.a] = 0
                 fz[:, op.a] = 0
+            else:
+                # projective collapse: a fault component that (anti)commutes
+                # trivially with the measured observable acts trivially on the
+                # post-measurement state — clear the conjugate plane (the
+                # sampler randomizes it instead, which matches in distribution
+                # whenever detectors are noiseless-deterministic; DEM
+                # derivation, like stim's, requires that determinism)
+                if op.basis == "x":
+                    fx[:, op.a] = 0
+                else:
+                    fz[:, op.a] = 0
         # noise ops: nothing to do deterministically
     return rec
 

@@ -71,6 +71,13 @@ class RepeatBlock:
         return f"REPEAT {self.repeat_count} {{\n{inner}\n}}"
 
 
+def fmt_float(a: float) -> str:
+    """Public fixed-point float formatter for building instruction strings
+    (e.g. ``f"DEPOLARIZE2({fmt_float(p)})"``) — never scientific notation, so
+    tiny probabilities survive the text round-trip."""
+    return _fmt_arg(a)
+
+
 def _fmt_arg(a: float) -> str:
     """Fixed-point float formatting: the reference DEM/noise parsers match
     ``\\d+\\.\\d+`` (src/Simulators_SpaceTime.py:575), so never emit scientific

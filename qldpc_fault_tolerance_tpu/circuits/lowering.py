@@ -280,13 +280,21 @@ def compile_circuit(circuit: Circuit) -> CompiledCircuit:
             start_meas = meas_count
             start_det = det_count
             body_coord_start = len(coord_events)
+            obs_lens_before = {k: len(v) for k, v in obs_cols_by_idx.items()}
             walk(body.items, seg_ops, start_meas)
             first_iter_det = det_cols[start_det:det_count]
             first_iter_coords = coord_events[body_coord_start:]
+            first_iter_obs = {
+                k: v[obs_lens_before.get(k, 0):]
+                for k, v in obs_cols_by_idx.items()
+                if len(v) > obs_lens_before.get(k, 0)
+            }
             for it in range(1, item.repeat_count):
                 shift = it * body_meas
                 for cols in first_iter_det:
                     det_cols.append([c + shift for c in cols])
+                for k, cols in first_iter_obs.items():
+                    obs_cols_by_idx[k].extend(c + shift for c in cols)
                 for ev in first_iter_coords:
                     if ev[0] == "det":
                         coord_events.append(

@@ -2,6 +2,8 @@ from .common import ShotBatcher, SimResult, wer_per_cycle, wer_single_shot
 from .data_error import CodeSimulator_DataError
 from .phenom import CodeSimulator_Phenon
 from .phenom_spacetime import CodeSimulator_Phenon_SpaceTime
+from .circuit import CodeSimulator_Circuit, build_memory_circuit
+from .circuit_spacetime import CodeSimulator_Circuit_SpaceTime
 
 __all__ = [
     "ShotBatcher",
@@ -11,4 +13,7 @@ __all__ = [
     "CodeSimulator_DataError",
     "CodeSimulator_Phenon",
     "CodeSimulator_Phenon_SpaceTime",
+    "CodeSimulator_Circuit",
+    "CodeSimulator_Circuit_SpaceTime",
+    "build_memory_circuit",
 ]

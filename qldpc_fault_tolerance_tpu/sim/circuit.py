@@ -1,0 +1,317 @@
+"""Circuit-level Monte-Carlo engine (plain, per-round decoding).
+
+Replaces reference ``CodeSimulator_Circuit`` (src/Simulators.py:386-671):
+synthesizes the full stabilizer-extraction circuit (init layer, first
+measurement layer with detectors on the X ancillas, repeated layers with
+difference detectors, final transversal MX layer with reconstructed-syndrome
+detectors and one OBSERVABLE per lx row), injects CX depolarizing noise with
+the text-rewrite plugin, samples detectors with the TPU Pauli-frame sampler,
+and decodes each round sequentially with residual-syndrome feed-forward.
+
+TPU structure: detector sampling is one fused program (lax.scan over the
+repeated measurement layer); the per-round decode loop is a ``lax.scan`` over
+the syndrome history with the (correction, residual syndrome) carry — the BP
+decode inside the scan is the batched device kernel, so the whole noisy-round
+history decodes without leaving the chip.  Only the final decode (usually
+BP+OSD) routes BP-failed shots through the host OSD.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..circuits import AddCXError, Circuit, ColorationCircuit, FrameSampler, \
+    RandomCircuit, target_rec
+from ..ops.linalg import gf2_matmul
+from .common import ShotBatcher, wer_per_cycle
+
+__all__ = ["CodeSimulator_Circuit", "build_memory_circuit"]
+
+
+def build_memory_circuit(code, num_cycles: int, error_params: dict,
+                         scheduling_X, scheduling_Z,
+                         spacetime: bool = False, num_rep: int = 1,
+                         num_rounds: int = 1,
+                         final_ancilla_compare: bool | None = None) -> Circuit:
+    """Synthesize the X-basis memory-experiment circuit.
+
+    ``spacetime=False`` reproduces the plain layout
+    (src/Simulators.py:438-609): init + first-measurement layer +
+    (num_cycles-2) repeated difference-detector layers + final MX layer whose
+    detectors reconstruct the X syndrome from the data measurements XOR the
+    last ancilla measurement.
+
+    ``spacetime=True`` reproduces the space-time layout
+    (src/Simulators_SpaceTime.py:737-941): init resets ancillas too, each of
+    ``num_rounds`` windows holds ``num_rep`` measurement sub-rounds (first
+    with raw detectors behind a SHIFT_COORDS marker, the rest with difference
+    detectors).
+
+    ``final_ancilla_compare`` controls whether the final MX detectors also
+    XOR in the last ancilla measurement.  Defaults: True for the plain layout
+    (src/Simulators.py:574-583), False for the space-time main circuit
+    (src/Simulators_SpaceTime.py:889-899, the window boundary feed-forward
+    accounts for it); the space-time *fault* circuit passes True explicitly
+    (circuit_final_meas_f, src/Simulators_SpaceTime.py:908-920).
+    """
+    if final_ancilla_compare is None:
+        final_ancilla_compare = not spacetime
+    hx, hz, lx = code.hx, code.hz, code.lx
+    n = hx.shape[1]
+    n_z, n_x = hz.shape[0], hx.shape[0]
+    data = list(range(n))
+    z_anc = list(range(n, n + n_z))
+    x_anc = list(range(n + n_z, n + n_z + n_x))
+    p_i = error_params["p_i"]
+    p_sp = error_params["p_state_p"]
+    p_m = error_params["p_m"]
+
+    def cx_layers(c: Circuit, scheduling, x_type: bool, idle_all: bool):
+        """One CX sub-circuit per scheduling timestep.  X-type checks use
+        ancilla→data CX, Z-type data→ancilla (src/Simulators.py:470-502).
+        ``idle_all`` switches between the plain engine's idling-on-unchecked-
+        data noise and the space-time engine's idling-on-all-qubits noise
+        (src/Simulators_SpaceTime.py:772-806)."""
+        anc = x_anc if x_type else z_anc
+        for step in scheduling:
+            if idle_all:
+                c.append("DEPOLARIZE1", data + anc,
+                         error_params["p_idling_gate"])
+            idling = set(data)
+            for j, q in step.items():
+                if x_type:
+                    c.append("CX", [anc[j], q])
+                else:
+                    c.append("CX", [q, anc[j]])
+                idling.discard(q)
+            if not idle_all:
+                c.append("DEPOLARIZE1", sorted(idling), p_i)
+            c.append("TICK")
+
+    def meas_layer(c: Circuit, reset_x_anc: bool, reset_z_anc: bool):
+        """One full stabilizer-measurement layer up to and including the MR
+        (detectors are appended by the caller)."""
+        if reset_x_anc:
+            c.append("R", x_anc)
+        c.append("H", x_anc)
+        c.append("DEPOLARIZE1", x_anc, p_sp)
+        c.append("DEPOLARIZE1", data, p_i)
+        c.append("TICK")
+        cx_layers(c, scheduling_X, x_type=True, idle_all=spacetime)
+        if reset_z_anc:
+            c.append("R", z_anc)
+        c.append("DEPOLARIZE1", z_anc, p_sp)
+        c.append("DEPOLARIZE1", data, p_i)
+        c.append("TICK")
+        cx_layers(c, scheduling_Z, x_type=False, idle_all=spacetime)
+        c.append("H", x_anc)
+        c.append("DEPOLARIZE1", x_anc, p_m)
+        c.append("DEPOLARIZE1", data, p_i)
+        c.append("MR", z_anc + x_anc)
+
+    def raw_detectors(c: Circuit, coord: bool):
+        for i in range(n_x):
+            c.append("DETECTOR", [target_rec(-n_x + i)], (0,) if coord else None)
+
+    def diff_detectors(c: Circuit, coord: bool):
+        for i in range(n_x):
+            c.append(
+                "DETECTOR",
+                [target_rec(-n_x + i), target_rec(-n_x + i - n_z - n_x)],
+                (0,) if coord else None,
+            )
+
+    init = Circuit()
+    init.append("RX", data)
+    if spacetime:
+        init.append("R", x_anc + z_anc)
+
+    if spacetime:
+        rep1 = Circuit()
+        meas_layer(rep1, reset_x_anc=False, reset_z_anc=False)
+        rep1.append("SHIFT_COORDS", [], (1,))
+        raw_detectors(rep1, coord=True)
+        rep1.append("TICK")
+        rep2 = Circuit()
+        meas_layer(rep2, reset_x_anc=False, reset_z_anc=False)
+        diff_detectors(rep2, coord=True)
+        rep2.append("TICK")
+        window = rep1 + (num_rep - 1) * rep2
+        body = num_rounds * window
+    else:
+        first = Circuit()
+        meas_layer(first, reset_x_anc=True, reset_z_anc=True)
+        raw_detectors(first, coord=False)
+        first.append("TICK")
+        rep = Circuit()
+        meas_layer(rep, reset_x_anc=False, reset_z_anc=False)
+        diff_detectors(rep, coord=False)
+        rep.append("TICK")
+        body = first + (num_cycles - 2) * rep
+
+    final = Circuit()
+    final.append("DEPOLARIZE1", data, p_m)
+    final.append("MX", data)
+    if spacetime:
+        final.append("SHIFT_COORDS", [], (1,))
+    for i in range(n_x):
+        recs = [target_rec(-n + q) for q in np.flatnonzero(hx[i]).tolist()]
+        if final_ancilla_compare:
+            recs.append(target_rec(-n_x + i - n))
+        final.append("DETECTOR", recs, (0,) if spacetime else None)
+    for i in range(lx.shape[0]):
+        final.append(
+            "OBSERVABLE_INCLUDE",
+            [target_rec(-n + q) for q in np.flatnonzero(lx[i]).tolist()],
+            (i,),
+        )
+
+    circuit = init + body + final
+    from ..circuits.ir import fmt_float
+
+    return AddCXError(circuit, f"DEPOLARIZE2({fmt_float(error_params['p_CX'])})")
+
+
+def _swap_xz_inplace(code):
+    """The reference swaps hx<->hz / lx<->lz on the *shared* code object when
+    eval_logical_type='X' (src/Simulators.py:390-402) — calling twice
+    un-swaps.  Preserved verbatim for observable-behavior parity."""
+    code.hx, code.hz = code.hz, code.hx
+    code.lx, code.lz = code.lz, code.lx
+
+
+class CodeSimulator_Circuit:
+    """Same constructor surface as the reference class (src/Simulators.py:386-435),
+    plus ``seed`` / ``batch_size``."""
+
+    def __init__(self, code=None, decoder1_z=None, decoder1_x=None,
+                 decoder2_z=None, decoder2_x=None, p=0, num_cycles=1,
+                 error_params=None, eval_logical_type="Z",
+                 circuit_type="coloration", rand_scheduling_seed=0,
+                 seed: int = 0, batch_size: int = 256):
+        if eval_logical_type == "X":
+            _swap_xz_inplace(code)
+            decoder1_z = decoder1_x
+            decoder2_z = decoder2_x
+
+        self.eval_code = code
+        self.hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=code.hx.dtype)])
+        self.hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=code.hz.dtype)])
+        self.decoder1_z = decoder1_z
+        self.decoder2_z = decoder2_z
+        self.N = code.N
+        self.K = code.K
+        self.pz = p
+        self.synd_prob = p
+        self.min_logical_weight = self.N
+        self.num_cycles = int(num_cycles)
+        self.error_params = error_params
+        self.batch_size = int(batch_size)
+        self._base_key = jax.random.PRNGKey(seed)
+
+        if circuit_type == "random":
+            self.scheduling_X = RandomCircuit(code.hx)
+            self.scheduling_Z = RandomCircuit(code.hz)
+        elif circuit_type == "coloration":
+            self.scheduling_X = ColorationCircuit(code.hx)
+            self.scheduling_Z = ColorationCircuit(code.hz)
+        else:
+            raise ValueError(f"unknown circuit_type {circuit_type!r}")
+
+        self.circuit: Circuit | None = None
+        self._sampler: FrameSampler | None = None
+        self._m = code.hx.shape[0]
+        self._hx_t = jnp.asarray(code.hx.T)
+        self._lx_t = jnp.asarray(code.lx.T)
+
+    # ------------------------------------------------------------------
+    def _generate_circuit(self):
+        """src/Simulators.py:438-609."""
+        self.circuit = build_memory_circuit(
+            self.eval_code, self.num_cycles, self.error_params,
+            self.scheduling_X, self.scheduling_Z, spacetime=False,
+        )
+        self._sampler = FrameSampler(self.circuit)
+
+    def _ensure_circuit(self):
+        if self._sampler is None:
+            self._generate_circuit()
+
+    # ------------------------------------------------------------------
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _sample_and_decode_rounds(self, key, batch_size: int):
+        """Sample detectors and run the sequential per-round decode
+        (src/Simulators.py:612-632) as a lax.scan; returns everything the
+        final (host-assisted) decode stage needs."""
+        dets, obs = self._sampler.sample(key, batch_size)
+        hist = dets.reshape(batch_size, self.num_cycles, self._m)
+
+        def round_step(carry, synd_j):
+            correction, residual = carry
+            corrected = synd_j ^ residual
+            new_cor, _ = self.decoder1_z.decode_batch_device(corrected)
+            data_cor = new_cor[:, : self.N]
+            correction = correction ^ data_cor
+            residual = corrected ^ gf2_matmul(data_cor, self._hx_t)
+            return (correction, residual), None
+
+        init = (
+            jnp.zeros((batch_size, self.N), jnp.uint8),
+            jnp.zeros((batch_size, self._m), jnp.uint8),
+        )
+        (correction, residual), _ = jax.lax.scan(
+            round_step, init, jnp.moveaxis(hist[:, :-1], 1, 0)
+        )
+        corrected_final = hist[:, -1] ^ residual
+        final_cor, final_aux = self.decoder2_z.decode_batch_device(corrected_final)
+        return obs, correction, corrected_final, final_cor, final_aux
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _check_failures(self, obs, correction, corrected_final, final_cor):
+        """src/Simulators.py:634-641."""
+        total = correction ^ final_cor
+        residual_syn = corrected_final ^ gf2_matmul(final_cor, self._hx_t)
+        logical_cor = gf2_matmul(total, self._lx_t)
+        residual_log = obs ^ logical_cor
+        return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
+
+    # ------------------------------------------------------------------
+    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
+        self._ensure_circuit()
+        assert not self.decoder1_z.needs_host_postprocess, (
+            "decoder1 runs inside the per-round scan on device; its host OSD "
+            "stage would be silently skipped — use a plain BP decoder for the "
+            "in-loop decodes (the reference does the same, "
+            "src/Simulators.py:780-811)"
+        )
+        bs = batch_size or self.batch_size
+        obs, correction, corrected_final, final_cor, aux = \
+            self._sample_and_decode_rounds(key, bs)
+        if self.decoder2_z.needs_host_postprocess:
+            final_cor = jnp.asarray(
+                self.decoder2_z.host_postprocess(
+                    np.asarray(corrected_final), np.asarray(final_cor),
+                    jax.device_get(aux),
+                )
+            )
+        return np.asarray(
+            self._check_failures(obs, correction, corrected_final, final_cor)
+        )
+
+    def _single_run(self):
+        self._base_key, sub = jax.random.split(self._base_key)
+        return int(self.run_batch(sub, 1)[0])
+
+    def WordErrorRate(self, num_samples: int, key=None):
+        """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
+        self._ensure_circuit()
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        count = 0
+        for i in batcher:
+            count += int(self.run_batch(jax.random.fold_in(key, i)).sum())
+        return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)

@@ -1,0 +1,237 @@
+"""Circuit-level space-time Monte-Carlo engine (sliding-window decoding).
+
+Replaces reference ``CodeSimulator_Circuit_SpaceTime``
+(src/Simulators_SpaceTime.py:672-1077), the flagship path of the reference
+(SpaceTimeDecodingDemo.ipynb): the main memory circuit holds ``num_rounds``
+windows of ``num_rep`` measurement sub-rounds; a one-window ``fault_circuit``
+is built only to derive the detector error model, from which come the decoding
+graphs (h1/L1/ps1 for windows, h2/L2/ps2 for the final layer) and the
+space-correction matrix ``h1_space_cor`` that feeds each window's correction
+forward into the next window's first detector slice.
+
+TPU structure: detector sampling is one fused program (lax.scan over the
+repeated window); the sliding-window decode is a ``lax.scan`` over windows
+with the (accumulated space correction, accumulated logical correction)
+carry; the window BP decode runs on device, only the final BP+OSD decode
+routes BP-failed shots through the host.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..circuits import (
+    AddCXError,
+    ColorationCircuit,
+    FrameSampler,
+    GenCorrecHyperGraph,
+    GenFaultHyperGraph,
+    RandomCircuit,
+    detector_error_model,
+)
+from ..ops.linalg import gf2_matmul
+from .circuit import _swap_xz_inplace, build_memory_circuit
+from .common import ShotBatcher, wer_per_cycle
+
+__all__ = ["CodeSimulator_Circuit_SpaceTime"]
+
+
+class CodeSimulator_Circuit_SpaceTime:
+    """Same constructor surface as the reference class
+    (src/Simulators_SpaceTime.py:672-735), plus ``seed`` / ``batch_size``.
+    As in the reference, the window/final decoders may be assigned after
+    construction (once the decoding graphs exist) — assign them before the
+    first decode call."""
+
+    def __init__(self, code=None, decoder1_z=None, decoder1_x=None,
+                 decoder2_z=None, decoder2_x=None, p=0, num_cycles=1,
+                 num_rep=1, error_params=None, eval_logical_type="Z",
+                 circuit_type="coloration", rand_scheduling_seed=0,
+                 seed: int = 0, batch_size: int = 256):
+        if eval_logical_type == "X":
+            _swap_xz_inplace(code)
+            decoder1_z = decoder1_x
+            decoder2_z = decoder2_x
+
+        self.eval_code = code
+        self.hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=code.hx.dtype)])
+        self.hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=code.hz.dtype)])
+        self.decoder1_z = decoder1_z
+        self.decoder2_z = decoder2_z
+        self.N = code.N
+        self.K = code.K
+        self.pz = p
+        self.synd_prob = p
+        self.min_logical_weight = self.N
+        self.num_cycles = int(num_cycles)
+        self.num_rep = int(num_rep)
+        self.num_rounds = int((self.num_cycles - 1) / self.num_rep)
+        assert abs((self.num_cycles - 1) / self.num_rep - self.num_rounds) <= 1e-2, (
+            "num_cycles - 1 must be a multiple of num_rep"
+        )
+        self.error_params = error_params
+        self.batch_size = int(batch_size)
+        self._base_key = jax.random.PRNGKey(seed)
+
+        if circuit_type == "random":
+            self.scheduling_X = RandomCircuit(code.hx)
+            self.scheduling_Z = RandomCircuit(code.hz)
+        elif circuit_type == "coloration":
+            self.scheduling_X = ColorationCircuit(code.hx)
+            self.scheduling_Z = ColorationCircuit(code.hz)
+        else:
+            raise ValueError(f"unknown circuit_type {circuit_type!r}")
+
+        self.num_logicals = code.lx.shape[0]
+        self.num_checks = code.hx.shape[0]
+
+        self.circuit = None
+        self.fault_circuit = None
+        self.detector_sampler: FrameSampler | None = None
+        self.circuit_graph: dict | None = None
+        self.h1_space_cor: np.ndarray | None = None
+
+    # ------------------------------------------------------------------
+    def _generate_circuit(self):
+        """Main + one-window fault circuit (src/Simulators_SpaceTime.py:737-941)."""
+        self.circuit = build_memory_circuit(
+            self.eval_code, self.num_cycles, self.error_params,
+            self.scheduling_X, self.scheduling_Z, spacetime=True,
+            num_rep=self.num_rep, num_rounds=self.num_rounds,
+        )
+        # fault circuit: one window, final detectors additionally compare
+        # against the last ancilla measurement (circuit_final_meas_f,
+        # src/Simulators_SpaceTime.py:908-926)
+        self.fault_circuit = build_memory_circuit(
+            self.eval_code, self.num_rep + 1, self.error_params,
+            self.scheduling_X, self.scheduling_Z, spacetime=True,
+            num_rep=self.num_rep, num_rounds=1, final_ancilla_compare=True,
+        )
+        self.detector_sampler = FrameSampler(self.circuit)
+
+    def _generate_circuit_graph(self):
+        """DEM -> decoding graphs (src/Simulators_SpaceTime.py:943-967)."""
+        dem_text = str(detector_error_model(self.fault_circuit, flatten_loops=True))
+        H_list, L_list, ps_list = GenFaultHyperGraph(
+            dem_text, num_rounds=self.num_rounds, num_rep=self.num_rep,
+            num_logicals=self.num_logicals,
+        )
+        self.circuit_graph = {
+            "h1": H_list[0], "L1": L_list[0], "channel_ps1": ps_list[0],
+            "h2": H_list[-1], "L2": L_list[-1], "channel_ps2": ps_list[-1],
+        }
+        self.h1_space_cor = GenCorrecHyperGraph(
+            dem_text, num_rounds=self.num_rounds, num_rep=self.num_rep,
+            num_checks=self.num_checks, num_logicals=self.num_logicals,
+        )
+
+    def _ensure_ready(self):
+        if self.detector_sampler is None:
+            self._generate_circuit()
+        if self.circuit_graph is None:
+            self._generate_circuit_graph()
+
+    # ------------------------------------------------------------------
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _sample_and_decode_windows(self, key, batch_size: int):
+        """Sliding-window decode (src/Simulators_SpaceTime.py:969-1006) as a
+        scan; returns what the final host-assisted decode needs."""
+        m = self.num_checks
+        dets, obs = self.detector_sampler.sample(key, batch_size)
+        hist = dets.reshape(batch_size, self.num_cycles, m)
+        windows = hist[:, : self.num_rounds * self.num_rep].reshape(
+            batch_size, self.num_rounds, self.num_rep * m
+        )
+        final_syn_raw = hist[:, -1]
+
+        h1_space_cor_t = jnp.asarray(self.h1_space_cor.T.astype(np.uint8))
+        L1_t = jnp.asarray(self.circuit_graph["L1"].T.astype(np.uint8))
+
+        def window_step(carry, syn_j):
+            total_space, total_log = carry
+            syn = syn_j.at[:, :m].set(syn_j[:, :m] ^ total_space)
+            cor, _ = self.decoder1_z.decode_batch_device(syn)
+            total_space = total_space ^ gf2_matmul(cor, h1_space_cor_t)
+            total_log = total_log ^ gf2_matmul(cor, L1_t)
+            return (total_space, total_log), None
+
+        init = (
+            jnp.zeros((batch_size, m), jnp.uint8),
+            jnp.zeros((batch_size, self.num_logicals), jnp.uint8),
+        )
+        (total_space, total_log), _ = jax.lax.scan(
+            window_step, init, jnp.moveaxis(windows, 1, 0)
+        )
+        final_syn = final_syn_raw ^ total_space
+        final_cor, final_aux = self.decoder2_z.decode_batch_device(final_syn)
+        return obs, total_log, final_syn, final_cor, final_aux
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _check_failures(self, obs, total_log, final_syn, final_cor):
+        """src/Simulators_SpaceTime.py:1004-1017."""
+        h2_t = jnp.asarray(self.circuit_graph["h2"].T.astype(np.uint8))
+        L2_t = jnp.asarray(self.circuit_graph["L2"].T.astype(np.uint8))
+        total_log = total_log ^ gf2_matmul(final_cor, L2_t)
+        residual_syn = final_syn ^ gf2_matmul(final_cor, h2_t)
+        residual_log = obs ^ total_log
+        return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
+
+    # ------------------------------------------------------------------
+    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
+        self._ensure_ready()
+        assert not self.decoder1_z.needs_host_postprocess, (
+            "the window decoder runs inside the sliding-window scan on "
+            "device; its host OSD stage would be silently skipped — use a "
+            "plain BP window decoder (the reference does the same, "
+            "src/Simulators_SpaceTime.py:994-1002)"
+        )
+        bs = batch_size or self.batch_size
+        obs, total_log, final_syn, final_cor, aux = \
+            self._sample_and_decode_windows(key, bs)
+        if self.decoder2_z.needs_host_postprocess:
+            final_cor = jnp.asarray(
+                self.decoder2_z.host_postprocess(
+                    np.asarray(final_syn), np.asarray(final_cor),
+                    jax.device_get(aux),
+                )
+            )
+        return np.asarray(
+            self._check_failures(obs, total_log, final_syn, final_cor)
+        )
+
+    def _single_run(self):
+        self._base_key, sub = jax.random.split(self._base_key)
+        return int(self.run_batch(sub, 1)[0])
+
+    def WordErrorRate(self, num_samples: int, key=None):
+        """src/Simulators_SpaceTime.py:1031-1049."""
+        self._ensure_ready()
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        count = 0
+        for i in batcher:
+            count += int(self.run_batch(jax.random.fold_in(key, i)).sum())
+        return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+
+    def WordErrorRate_TargetFailure(self, target_failures: int, batch_size: int,
+                                    max_batches: int, key=None):
+        """Adaptive sampling: stop once enough failures accumulate
+        (src/Simulators_SpaceTime.py:1051-1077).  Returns (wer, total_samples)."""
+        self._ensure_ready()
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        total_samples, total_failures = 0, 0
+        for i in range(int(max_batches)):
+            fails = self.run_batch(jax.random.fold_in(key, i), int(batch_size))
+            total_failures += int(fails.sum())
+            total_samples += int(batch_size)
+            if total_failures >= target_failures:
+                break
+        wer, _ = wer_per_cycle(
+            total_failures, total_samples, self.K, self.num_cycles
+        )
+        return wer, total_samples

@@ -287,3 +287,61 @@ def test_dem_text_and_hypergraph_round_trip():
     )
     assert h_cor.shape[0] == m
     assert h_cor.shape[1] == H_list[0].shape[1]
+
+
+# --------------------------------------------- code-review regression tests
+def test_observable_inside_repeat_block():
+    """OBSERVABLE_INCLUDE inside a REPEAT block must accumulate record
+    columns from every iteration, not just the first."""
+    body = Circuit()
+    body.append("X_ERROR", [0], 1.0)
+    body.append("MR", [0])
+    body.append("OBSERVABLE_INCLUDE", [target_rec(-1)], (0,))
+    c = Circuit().append("R", [0]) + 3 * body
+    from qldpc_fault_tolerance_tpu.circuits.lowering import compile_circuit
+
+    compiled = compile_circuit(c)
+    assert compiled.obs_cols == [[0, 1, 2]]
+    s = FrameSampler(c)
+    _, obs = s.sample(jax.random.PRNGKey(0), 4)
+    # X before every MR flips every measurement: XOR of 3 ones = 1
+    assert np.asarray(obs)[:, 0].all()
+
+
+def test_add_measurement_error_adjacent_lines():
+    """Adjacent M lines must each get their error (conscious fix of the
+    reference's newline-consuming regexes, SURVEY §2.4)."""
+    from qldpc_fault_tolerance_tpu.circuits import AddMeasurementError
+
+    c = Circuit()
+    c.append("M", [0])
+    c.append("M", [1])
+    text = str(AddMeasurementError(c, 0.125))
+    assert text.count("X_ERROR(0.125)") == 2
+
+
+def test_tiny_probability_survives_text_round_trip():
+    from qldpc_fault_tolerance_tpu.circuits.ir import fmt_float
+
+    assert float(fmt_float(1e-7)) == pytest.approx(1e-7)
+    c = Circuit()
+    c.append("CX", [0, 1])
+    noisy = AddCXError(c, f"DEPOLARIZE2({fmt_float(1e-7)})")
+    from qldpc_fault_tolerance_tpu.circuits.lowering import compile_circuit
+
+    ops = [op for op, _ in compile_circuit(noisy).flattened_ops()]
+    assert any(op.kind == "dep2" and op.p > 0 for op in ops)
+
+
+def test_dem_measurement_collapse_conjugate_plane():
+    """A Z fault consumed by a Z-basis measurement must not propagate
+    further in the DEM (projective collapse clears the conjugate plane)."""
+    c = Circuit()
+    c.append("R", [0])
+    c.append("Z_ERROR", [0], 0.25)
+    c.append("M", [0])
+    c.append("H", [0])
+    c.append("M", [0])
+    c.append("DETECTOR", [target_rec(-1)])
+    dem = detector_error_model(c)
+    assert dem.errors == []
