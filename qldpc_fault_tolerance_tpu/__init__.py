@@ -25,3 +25,13 @@ __version__ = "0.1.0"
 from . import codes
 
 __all__ = ["codes", "__version__"]
+
+
+def __getattr__(name):
+    # heavier subpackages (jit compilation, scipy) load lazily
+    if name in ("ops", "noise", "decoders", "circuits", "sim", "parallel",
+                "sweep", "compat", "utils"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

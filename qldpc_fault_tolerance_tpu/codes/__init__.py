@@ -1,5 +1,14 @@
 from . import gf2
 from .css import CssCode, css_logicals
+from .codegen import (
+    GeneRandGraphsLargeGirthFinal,
+    GetClassicalCodeParams,
+    QuantumExpanderFromCheckMat,
+    improve_girth,
+    min_cycle_edges,
+    random_biregular_tanner,
+    tanner_girth,
+)
 from .hgp import hgp, rep_code, ring_code, classical_code_distance
 from .loaders import (
     load_code,
@@ -13,6 +22,13 @@ from .loaders import (
 
 __all__ = [
     "gf2",
+    "GeneRandGraphsLargeGirthFinal",
+    "GetClassicalCodeParams",
+    "QuantumExpanderFromCheckMat",
+    "improve_girth",
+    "min_cycle_edges",
+    "random_biregular_tanner",
+    "tanner_girth",
     "CssCode",
     "css_logicals",
     "hgp",

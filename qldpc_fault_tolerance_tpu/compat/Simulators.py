@@ -1,0 +1,23 @@
+"""Reference ``src/Simulators.py`` API, backed by the TPU engines."""
+from ..codes.loaders import load_object, save_object
+from ..sim import (
+    CodeSimulator_Circuit,
+    CodeSimulator_DataError,
+    CodeSimulator_Phenon,
+)
+from ..sweep import (
+    CodeFamily,
+    CriticalExponentFit,
+    DistanceEst,
+    EmpericalFit,
+    FitDistance,
+    ThresholdEst_extrapolation,
+)
+from ._parmap import fun, parmap
+
+__all__ = [
+    "fun", "parmap", "save_object", "load_object",
+    "CodeSimulator_DataError", "CodeSimulator_Phenon", "CodeSimulator_Circuit",
+    "CriticalExponentFit", "EmpericalFit", "FitDistance", "DistanceEst",
+    "ThresholdEst_extrapolation", "CodeFamily",
+]

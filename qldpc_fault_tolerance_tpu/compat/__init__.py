@@ -1,0 +1,93 @@
+"""Drop-in shims for the reference module names.
+
+``install()`` registers the reference's module names in ``sys.modules`` so
+notebook code written against the reference repo runs unmodified against the
+TPU framework:
+
+    import qldpc_fault_tolerance_tpu.compat as compat
+    compat.install()
+    from Simulators import CodeFamily            # reference src/Simulators.py
+    from Decoders import BPOSD_Decoder_Class     # reference src/Decoders.py
+
+When the real ``ldpc`` / ``bposd`` packages are absent (they are not part of
+this framework's dependencies), lightweight stand-ins expose the handful of
+entry points the notebooks touch (``ldpc.codes.rep_code/ring_code``,
+``ldpc.mod2.rank``, ``ldpc.code_util.compute_code_distance``,
+``bposd.hgp.hgp``) backed by the native codes/ layer.
+"""
+from __future__ import annotations
+
+import sys
+import types
+
+__all__ = ["install"]
+
+_REFERENCE_MODULES = (
+    "Simulators",
+    "Simulators_SpaceTime",
+    "Decoders",
+    "Decoders_SpaceTime",
+    "ErrorPlugin",
+    "CircuitScheduling",
+    "QuantumExanderCodesGene",
+    "par2gen",
+)
+
+
+def install(include_third_party_stubs: bool = True) -> None:
+    import importlib
+
+    for name in _REFERENCE_MODULES:
+        mod = importlib.import_module(f".{name}", __name__)
+        sys.modules.setdefault(name, mod)
+
+    if include_third_party_stubs:
+        _install_ldpc_stub()
+        _install_bposd_stub()
+
+
+def _install_ldpc_stub() -> None:
+    try:
+        import ldpc  # noqa: F401
+        return
+    except ImportError:
+        pass
+    from ..codes import gf2, classical_code_distance, rep_code, ring_code
+
+    ldpc = types.ModuleType("ldpc")
+    codes_mod = types.ModuleType("ldpc.codes")
+    codes_mod.rep_code = rep_code
+    codes_mod.ring_code = ring_code
+    mod2 = types.ModuleType("ldpc.mod2")
+    mod2.rank = gf2.rank
+    mod2.nullspace = gf2.nullspace
+    mod2.row_basis = gf2.row_basis
+    code_util = types.ModuleType("ldpc.code_util")
+    code_util.compute_code_distance = classical_code_distance
+    ldpc.codes = codes_mod
+    ldpc.mod2 = mod2
+    ldpc.code_util = code_util
+    sys.modules["ldpc"] = ldpc
+    sys.modules["ldpc.codes"] = codes_mod
+    sys.modules["ldpc.mod2"] = mod2
+    sys.modules["ldpc.code_util"] = code_util
+
+
+def _install_bposd_stub() -> None:
+    try:
+        import bposd  # noqa: F401
+        return
+    except ImportError:
+        pass
+    from ..codes import CssCode, hgp
+
+    bposd = types.ModuleType("bposd")
+    hgp_mod = types.ModuleType("bposd.hgp")
+    hgp_mod.hgp = hgp
+    css_mod = types.ModuleType("bposd.css")
+    css_mod.css_code = CssCode
+    bposd.hgp = hgp_mod
+    bposd.css = css_mod
+    sys.modules["bposd"] = bposd
+    sys.modules["bposd.hgp"] = hgp_mod
+    sys.modules["bposd.css"] = css_mod

@@ -59,6 +59,11 @@ def build_memory_circuit(code, num_cycles: int, error_params: dict,
     """
     if final_ancilla_compare is None:
         final_ancilla_compare = not spacetime
+    if not spacetime and num_cycles < 2:
+        raise ValueError(
+            f"num_cycles must be >= 2 (one initial measurement layer plus the "
+            f"final readout layer); got {num_cycles}"
+        )
     hx, hz, lx = code.hx, code.hz, code.lx
     n = hx.shape[1]
     n_z, n_x = hz.shape[0], hx.shape[0]

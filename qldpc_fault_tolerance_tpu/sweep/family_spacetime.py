@@ -1,0 +1,214 @@
+"""CodeFamily_SpaceTime orchestration for the space-time decoding stack
+(reference src/Simulators_SpaceTime.py:1152-1362).
+
+Returns ragged ``(eval_wer_list, eval_p_adapt_list)`` lists (per code), since
+the adaptive p-grid pruning can evaluate different p-points per code.
+
+Conscious fixes vs the reference (SURVEY §2.4, documented):
+  * the reference's phenl branch names a nonexistent ``CodeSimulator_SpaceTime``
+    (latent NameError, src/Simulators_SpaceTime.py:1213); here it runs the
+    actual ``CodeSimulator_Phenon_SpaceTime``;
+  * the reference's ``EvalThreshold`` passes ``data_synd_noise_ratio`` into
+    the ``num_rep`` positional slot of EvalWER
+    (src/Simulators_SpaceTime.py:1318-1321); here ``num_rep`` is explicit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..decoders import DecoderClass
+from ..sim import (
+    CodeSimulator_Circuit_SpaceTime,
+    CodeSimulator_DataError,
+    CodeSimulator_Phenon_SpaceTime,
+)
+from .fits import DistanceEst, SustainableThresholdEst, ThresholdEst_extrapolation
+
+__all__ = ["CodeFamily_SpaceTime"]
+
+
+class CodeFamily_SpaceTime:
+    def __init__(self, code_list: list, decoder1_class: DecoderClass,
+                 decoder2_class: DecoderClass, batch_size: int = 512,
+                 seed: int = 0):
+        self.code_list = code_list
+        self.decoder1_class = decoder1_class
+        self.decoder2_class = decoder2_class
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+
+    # ------------------------------------------------------------------
+    def EvalWER(self, noise_model: str, eval_logical_type: str,
+                eval_p_list: list, num_samples: int, num_cycles=1, num_rep=1,
+                circuit_type="coloration", circuit_error_params=None,
+                if_plot=True, if_adaptive=False, adaptive_params=None):
+        """(ragged) per-code WER/p lists
+        (src/Simulators_SpaceTime.py:1158-1307)."""
+        assert noise_model in ["data", "phenl", "circuit"], (
+            "noise_model should be one of [data, phenl, circuit]"
+        )
+        assert eval_logical_type in ["X", "Z", "Total"], (
+            "eval_type should be one of [X, Y, Total]"
+        )
+        eval_wer_list = []
+        eval_p_adapt_list = []
+
+        for code in self.code_list:
+            if noise_model == "circuit" and if_adaptive:
+                WEREst = adaptive_params["WEREst"]
+                min_wer = adaptive_params["min_wer"]
+                p_list = [p for p in eval_p_list if WEREst(code.N, p) >= min_wer]
+            else:
+                p_list = list(eval_p_list)
+
+            wer_per_code = []
+            for eval_p in p_list:
+                if noise_model == "data":
+                    wer_per_code.append(
+                        self._data_wer(code, eval_p, eval_logical_type,
+                                       num_samples)
+                    )
+                elif noise_model == "phenl":
+                    wer_per_code.append(
+                        self._phenl_wer(code, eval_p, eval_logical_type,
+                                        num_samples, num_cycles, num_rep)
+                    )
+                else:
+                    wer_per_code.append(
+                        self._circuit_wer(
+                            code, eval_p, eval_logical_type, num_samples,
+                            num_cycles, num_rep, circuit_type,
+                            circuit_error_params,
+                        )
+                    )
+            eval_p_adapt_list.append(np.array(p_list))
+            eval_wer_list.append(np.array(wer_per_code))
+
+        return eval_wer_list, eval_p_adapt_list
+
+    # ------------------------------------------------------------------
+    def _data_wer(self, code, eval_p, eval_logical_type, num_samples):
+        """src/Simulators_SpaceTime.py:1165-1186 — note the decoder params
+        carry 'code_h'/'channel_probs' so circuit-style factory classes work
+        on the data branch too."""
+        p = eval_p * 3 / 2
+        decoder_x = self.decoder2_class.GetDecoder({
+            "code_h": code.hz, "h": code.hz, "p_data": eval_p,
+            "channel_probs": eval_p * np.ones(code.N),
+        })
+        decoder_z = self.decoder2_class.GetDecoder({
+            "code_h": code.hx, "h": code.hx, "p_data": eval_p,
+            "channel_probs": eval_p * np.ones(code.N),
+        })
+        sim = CodeSimulator_DataError(
+            code=code, decoder_x=decoder_x, decoder_z=decoder_z,
+            pauli_error_probs=[p / 3, p / 3, p / 3],
+            eval_logical_type=eval_logical_type,
+            batch_size=self.batch_size, seed=self.seed,
+        )
+        return sim.WordErrorRate(num_samples)[0]
+
+    def _phenl_wer(self, code, eval_p, eval_logical_type, num_samples,
+                   num_cycles, num_rep):
+        """src/Simulators_SpaceTime.py:1189-1217 (with the NameError fixed)."""
+        p = 3 / 2 * eval_p
+        q = eval_p
+        p_data = p * 2 / 3
+        dec1_x = self.decoder1_class.GetDecoder(
+            {"h": code.hz, "p_data": p_data, "p_syndrome": q, "num_rep": num_rep})
+        dec1_z = self.decoder1_class.GetDecoder(
+            {"h": code.hx, "p_data": p_data, "p_syndrome": q, "num_rep": num_rep})
+        dec2_x = self.decoder2_class.GetDecoder({"h": code.hz, "p_data": p_data})
+        dec2_z = self.decoder2_class.GetDecoder({"h": code.hx, "p_data": p_data})
+        sim = CodeSimulator_Phenon_SpaceTime(
+            code=code, decoder1_x=dec1_x, decoder1_z=dec1_z,
+            decoder2_x=dec2_x, decoder2_z=dec2_z,
+            pauli_error_probs=[p / 3, p / 3, p / 3], q=q,
+            eval_logical_type=eval_logical_type, num_rep=num_rep,
+            batch_size=self.batch_size, seed=self.seed,
+        )
+        return sim.WordErrorRate(num_cycles=num_cycles, num_samples=num_samples)[0]
+
+    def _circuit_wer(self, code, eval_p, eval_logical_type, num_samples,
+                     num_cycles, num_rep, circuit_type, circuit_error_params):
+        """src/Simulators_SpaceTime.py:1221-1262: simulator first, DEM-derived
+        decoding graphs, then decoders through the factory classes."""
+        p = eval_p
+        error_params = {
+            k: circuit_error_params[k] * p
+            for k in ("p_i", "p_state_p", "p_m", "p_CX", "p_idling_gate")
+        }
+        sim = CodeSimulator_Circuit_SpaceTime(
+            code=code, p=p, num_cycles=num_cycles, num_rep=num_rep,
+            error_params=error_params, eval_logical_type=eval_logical_type,
+            circuit_type=circuit_type, rand_scheduling_seed=1,
+            batch_size=self.batch_size, seed=self.seed,
+        )
+        sim._generate_circuit()
+        sim._generate_circuit_graph()
+        g = sim.circuit_graph
+        sim.decoder1_z = self.decoder1_class.GetDecoder({
+            "code_h": code.hx, "h": g["h1"], "channel_probs": g["channel_ps1"],
+        })
+        sim.decoder2_z = self.decoder2_class.GetDecoder({
+            "code_h": code.hx, "h": g["h2"], "channel_probs": g["channel_ps2"],
+        })
+        return sim.WordErrorRate(num_samples=num_samples)[0]
+
+    # ------------------------------------------------------------------
+    def EvalThreshold(self, noise_model: str, eval_logical_type: str,
+                      eval_method: str, est_threshold: float,
+                      num_samples: int, num_cycles=1, num_rep=1,
+                      circuit_type="coloration", circuit_error_params=None,
+                      if_plot=False):
+        """src/Simulators_SpaceTime.py:1311-1323 (explicit num_rep)."""
+        assert eval_method in ["extrapolation"]
+        eval_p_list = 10 ** (
+            np.linspace(np.log10(est_threshold * 0.4),
+                        np.log10(est_threshold * 0.8), 6)
+        )
+        wer_list, _ = self.EvalWER(
+            noise_model, eval_logical_type, eval_p_list, num_samples,
+            num_cycles, num_rep, circuit_type, circuit_error_params,
+            if_plot=False,
+        )
+        return ThresholdEst_extrapolation(eval_p_list, np.array(wer_list), if_plot)
+
+    def EvalSustainableThreshold(self, noise_model: str, eval_logical_type: str,
+                                 eval_method: str, est_threshold: float,
+                                 num_samples_per_cycle: int,
+                                 num_cycles_list: list, num_rep=1,
+                                 circuit_type="coloration",
+                                 circuit_error_params=None, if_plot=False):
+        """src/Simulators_SpaceTime.py:1326-1347."""
+        thresholds = [
+            self.EvalThreshold(
+                noise_model=noise_model, eval_logical_type=eval_logical_type,
+                eval_method=eval_method, est_threshold=est_threshold,
+                num_samples=int(num_samples_per_cycle / n), num_cycles=n,
+                num_rep=num_rep, circuit_type=circuit_type,
+                circuit_error_params=circuit_error_params, if_plot=if_plot,
+            )
+            for n in num_cycles_list
+        ]
+        return SustainableThresholdEst(num_cycles_list, thresholds,
+                                       if_plot=if_plot)
+
+    def EvalEffectiveDistances(self, noise_model: str, eval_logical_type: str,
+                               eval_method: str, est_threshold: float,
+                               num_samples: int, num_cycles=1, num_rep=1,
+                               circuit_type="coloration",
+                               circuit_error_params=None, if_plot=False):
+        """src/Simulators_SpaceTime.py:1350-1362 (circuit_error_params added,
+        see family.py)."""
+        assert eval_method in ["extrapolation"]
+        eval_p_list = 10 ** (
+            np.linspace(np.log10(est_threshold / 6),
+                        np.log10(est_threshold / 4), 5)
+        )
+        wer_list, _ = self.EvalWER(
+            noise_model, eval_logical_type, eval_p_list, num_samples,
+            num_cycles, num_rep, circuit_type, circuit_error_params,
+            if_plot=False,
+        )
+        return DistanceEst(eval_p_list, np.array(wer_list), if_plot)

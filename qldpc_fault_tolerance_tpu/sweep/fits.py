@@ -1,0 +1,110 @@
+"""Threshold / distance / sustainability fits (host-side scipy).
+
+Same estimators as the reference (src/Simulators.py:675-741, duplicated at
+src/Simulators_SpaceTime.py:1080-1146): per-code power-law fits
+``pl = A p^{d/2}`` give effective distances; a joint fit of
+``pl = A (p/pc)^{d/2}`` over the family extrapolates the crossing point
+``p_c``; thresholds vs cycle count fit the saturation model
+``p_th(N) = p_sus (1 - (1 - p0/p_sus) e^{-gamma N})``.
+"""
+from __future__ import annotations
+
+import numpy as np
+from scipy.optimize import curve_fit
+
+__all__ = [
+    "CriticalExponentFit",
+    "EmpericalFit",
+    "FitDistance",
+    "DistanceEst",
+    "ThresholdEst_extrapolation",
+    "FitSusThreshold",
+    "SustainableThresholdEst",
+]
+
+
+def CriticalExponentFit(xdata_tuple, pc, nu, A, B, C):
+    """Quadratic critical-scaling ansatz (src/Simulators.py:675-679; defined
+    by the reference but unused on its main paths)."""
+    p, d = xdata_tuple
+    x = (p - pc) * d ** (1 / nu)
+    return A + B * x + C * x**2
+
+
+def EmpericalFit(xdata_tuple, pc, A):
+    """pl = A (p/pc)^{d/2} (src/Simulators.py:681-684)."""
+    p, d = xdata_tuple
+    return A * (p / pc) ** (d / 2)
+
+
+def FitDistance(p, A, d):
+    """pl = A p^{d/2} (src/Simulators.py:686-688)."""
+    return A * p ** (d / 2)
+
+
+def DistanceEst(sweep_p_list, sweep_pl_total_list, if_plot=False):
+    """Per-code effective distance from the low-p slope
+    (src/Simulators.py:690-699)."""
+    del if_plot
+    sweep_d_list = []
+    for sweep_pl_list in sweep_pl_total_list:
+        popt, _ = curve_fit(
+            FitDistance, np.asarray(sweep_p_list, float),
+            np.asarray(sweep_pl_list, float) + 1e-10, p0=(0.01, 3),
+        )
+        sweep_d_list.append(popt[1])
+    return sweep_d_list
+
+
+def ThresholdEst_extrapolation(sweep_p_list, sweep_pl_total_list,
+                               if_plot=False, verbose=True):
+    """Joint family fit of pl = A (p/pc)^{d/2} with per-code d from
+    DistanceEst; returns p_c (src/Simulators.py:701-741)."""
+    sweep_p_list = list(np.asarray(sweep_p_list, float))
+    pl_arr = np.asarray(sweep_pl_total_list, float)
+    num_code, num_p = pl_arr.shape
+    d_per_code = DistanceEst(sweep_p_list, pl_arr)
+
+    ps = np.tile(sweep_p_list, num_code)
+    ds = np.repeat(d_per_code, num_p)
+    fit_X = np.vstack([ps, ds])
+    fit_Z = pl_arr.reshape(num_p * num_code)
+    popt, _ = curve_fit(EmpericalFit, fit_X, fit_Z, p0=(0.04, 0.1))
+    p_c, A = popt
+
+    if if_plot:
+        import matplotlib.pyplot as plt
+
+        plt.figure()
+        for i, d in enumerate(d_per_code):
+            fitted = [EmpericalFit((p, d), p_c, A) for p in sweep_p_list]
+            plt.plot(sweep_p_list, fitted, "-", c=f"C{i}")
+            plt.plot(sweep_p_list, pl_arr[i], "D", c=f"C{i}")
+        plt.xscale("log")
+        plt.yscale("log")
+        plt.xlabel("p")
+        plt.ylabel("WER")
+    if verbose:
+        print("p_c:", p_c)
+    return p_c
+
+
+def FitSusThreshold(N, p_sus, p_0, gamma):
+    """Sustainable-threshold saturation model (src/Simulators.py:936-938)."""
+    return p_sus * (1 - (1 - p_0 / p_sus) * np.exp(-gamma * N))
+
+
+def SustainableThresholdEst(num_cycles_list, threshold_list, if_plot=False):
+    """Fit p_sus from thresholds at increasing cycle counts
+    (src/Simulators.py:940-948)."""
+    popt, _ = curve_fit(
+        FitSusThreshold, np.asarray(num_cycles_list, float),
+        np.asarray(threshold_list, float), p0=(0.01, 0.05, 0.05),
+    )
+    if if_plot:
+        import matplotlib.pyplot as plt
+
+        plt.figure()
+        plt.plot(num_cycles_list, threshold_list, "D")
+        plt.plot(num_cycles_list, FitSusThreshold(np.asarray(num_cycles_list, float), *popt), "-")
+    return popt[0]

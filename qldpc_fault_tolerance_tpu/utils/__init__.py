@@ -1,0 +1,5 @@
+"""Utility modules: classical linear-block-code teaching tools (par2gen)."""
+from . import par2gen
+from .par2gen import GtoH, GtoP, HtoG, HtoP, LinearBlockCode
+
+__all__ = ["par2gen", "HtoG", "GtoH", "HtoP", "GtoP", "LinearBlockCode"]

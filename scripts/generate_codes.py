@@ -1,0 +1,104 @@
+"""Regenerate the hgp_34 quantum-expander code family.
+
+The reference ships only hgp_34_n225.pkl; the larger codes used throughout
+its notebooks (n625 / n1225 / n1600) are listed in .MISSING_LARGE_BLOBS and
+absent from the mount, so they are regenerated here as statistically
+equivalent codes (SURVEY §7 step 1): random (Δc=4, Δv=3)-biregular seed
+codes with girth raised by edge swaps (reference generator
+GeneRandGraphsLargeGirthFinal, src/QuantumExanderCodesGene.py:314-330), then
+hgp(H, H).
+
+Seeds are fixed and recorded in codes_lib_tpu/GENERATION.json so the family
+is reproducible bit-for-bit.
+
+Usage: PYTHONPATH=. python scripts/generate_codes.py [n625 n1225 n1600 n225]
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qldpc_fault_tolerance_tpu.codes import (  # noqa: E402
+    gf2,
+    hgp,
+    improve_girth,
+    random_biregular_tanner,
+    save_code,
+    tanner_girth,
+)
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                       "codes_lib_tpu")
+
+# (name, n0, target_girth, master_seed): (4,3)-biregular seeds, H is
+# (3 n0) x (4 n0); hgp(H,H) gives N = (4 n0)^2 + (3 n0)^2 = 25 n0^2.
+# Girth 8 ((3,4) graphs free of 4- and 6-cycles) is only reachable for the
+# larger seeds; after a few failed attempts the target steps down by 2.
+FAMILY = {
+    "n225": (3, 6, 225001),
+    "n625": (5, 8, 625001),
+    "n1225": (7, 8, 1225001),
+    "n1600": (8, 8, 1600001),
+}
+
+
+def generate_one(tag: str, n0: int, target_girth: int, master_seed: int):
+    t0 = time.time()
+    rng = np.random.default_rng(master_seed)
+    attempts = 0
+    while True:
+        attempts += 1
+        if attempts % 4 == 0 and target_girth > 6:
+            target_girth -= 2
+            print(f"{tag}: lowering girth target to {target_girth}")
+        H = random_biregular_tanner(n0, 4, 3, rng)
+        H, ok = improve_girth(H, target_girth, max_iter=6000, rng=rng)
+        if not ok:
+            continue
+        # full-row-rank seeds give K = (n-m)^2 with no transpose logicals,
+        # matching the published family dimensions ([[625,25]], [[1225,49]],
+        # [[1600,64]], SURVEY §6)
+        if gf2.rank(H) != H.shape[0]:
+            continue
+        break
+    code = hgp(H, H, compute_distance=False, name=f"hgp_34_{tag}")
+    path = os.path.join(OUT_DIR, f"hgp_34_{tag}.npz")
+    save_code(code, path)
+    seed_path = os.path.join(OUT_DIR, f"hgp_34_{tag}_seedH.npy")
+    np.save(seed_path, H)
+    meta = {
+        "tag": tag, "n0": n0, "delta_c": 4, "delta_v": 3,
+        "target_girth": target_girth, "master_seed": master_seed,
+        "attempts": attempts, "seed_girth": int(tanner_girth(H)),
+        "N": int(code.N), "K": int(code.K),
+        "elapsed_s": round(time.time() - t0, 1),
+    }
+    print(json.dumps(meta))
+    return meta
+
+
+def main():
+    os.makedirs(OUT_DIR, exist_ok=True)
+    tags = sys.argv[1:] or list(FAMILY)
+    metas = []
+    meta_path = os.path.join(OUT_DIR, "GENERATION.json")
+    if os.path.exists(meta_path):
+        with open(meta_path) as f:
+            metas = json.load(f)
+    done = {m["tag"] for m in metas}
+    for tag in tags:
+        if tag in done:
+            print(f"{tag}: already generated")
+            continue
+        n0, g, seed = FAMILY[tag]
+        metas.append(generate_one(tag, n0, g, seed))
+        with open(meta_path, "w") as f:
+            json.dump(metas, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

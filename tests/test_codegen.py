@@ -1,0 +1,69 @@
+"""Tests for random biregular code generation and girth optimization."""
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import (
+    GetClassicalCodeParams,
+    QuantumExpanderFromCheckMat,
+    improve_girth,
+    min_cycle_edges,
+    random_biregular_tanner,
+    tanner_girth,
+)
+
+
+def test_biregular_degrees():
+    H = random_biregular_tanner(5, 4, 3, rng=0)
+    assert H.shape == (15, 20)
+    assert (H.sum(1) == 4).all()
+    assert (H.sum(0) == 3).all()
+    assert H.max() == 1  # simple graph
+
+
+def test_girth_known_graphs():
+    # 4-cycle: two checks sharing two bits
+    H = np.array([[1, 1], [1, 1]], dtype=np.uint8)
+    assert tanner_girth(H) == 4
+    # tree: no cycle
+    H = np.array([[1, 1, 0], [0, 0, 1]], dtype=np.uint8)
+    assert tanner_girth(H) >= 1e6
+    # 6-cycle: 3 checks, 3 bits in a ring
+    H = np.array([[1, 1, 0], [0, 1, 1], [1, 0, 1]], dtype=np.uint8)
+    assert tanner_girth(H) == 6
+    g, edges = min_cycle_edges(H)
+    assert g == 6 and len(edges) == 6  # every edge on the hexagon
+
+
+def test_improve_girth_raises_girth():
+    rng = np.random.default_rng(42)
+    H = random_biregular_tanner(5, 4, 3, rng=rng)
+    g0 = tanner_girth(H)
+    H2, ok = improve_girth(H, target_girth=6, max_iter=4000, rng=rng)
+    assert ok
+    assert tanner_girth(H2) >= 6 >= g0
+    # degree sequence invariant
+    assert (H2.sum(1) == 4).all() and (H2.sum(0) == 3).all()
+
+
+def test_classical_code_params():
+    # [7,4,3] Hamming code
+    H = np.array([
+        [1, 0, 1, 0, 1, 0, 1],
+        [0, 1, 1, 0, 0, 1, 1],
+        [0, 0, 0, 1, 1, 1, 1],
+    ], dtype=np.uint8)
+    n, k, d, lam2 = GetClassicalCodeParams(H)
+    assert (n, k, d) == (7, 4, 3)
+    assert lam2 > 0
+
+
+def test_quantum_expander_construction():
+    rng = np.random.default_rng(7)
+    H = random_biregular_tanner(3, 4, 3, rng=rng)
+    H, _ = improve_girth(H, target_girth=6, max_iter=3000, rng=rng)
+    code = QuantumExpanderFromCheckMat(H, compute_distance=False)
+    m, n = H.shape
+    assert code.N == n * n + m * m
+    # CSS validity: hx hz^T = 0
+    assert not (code.hx @ code.hz.T % 2).any()
+    assert code.K == code.lx.shape[0] == code.lz.shape[0]

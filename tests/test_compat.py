@@ -1,0 +1,91 @@
+"""Tests: compat shims expose the reference API; par2gen utilities."""
+import numpy as np
+import pytest
+
+
+def test_compat_install_reference_modules():
+    import qldpc_fault_tolerance_tpu.compat as compat
+
+    compat.install()
+    from Simulators import CodeFamily, CodeSimulator_DataError, parmap  # noqa
+    from Simulators_SpaceTime import CodeSimulator_Circuit_SpaceTime  # noqa
+    from Decoders import BPOSD_Decoder_Class, GetSpaceTimeCheckMat  # noqa
+    from Decoders_SpaceTime import ST_BPOSD_Decoder_Circuit_Class  # noqa
+    from ErrorPlugin import AddCXError  # noqa
+    from CircuitScheduling import ColorationCircuit  # noqa
+    from QuantumExanderCodesGene import Girth, RandomaGraphs  # noqa
+    from par2gen import LinearBlockCode  # noqa
+
+    assert parmap(lambda x: x * 2, [3, 1, 2]) == [6, 2, 4]
+
+
+def test_compat_third_party_stubs():
+    import qldpc_fault_tolerance_tpu.compat as compat
+
+    compat.install()
+    from bposd.hgp import hgp  # noqa: the notebooks' import
+    from ldpc.codes import ring_code, rep_code
+    import ldpc.mod2 as mod2
+
+    q = hgp(rep_code(3), rep_code(3))
+    assert (q.N, q.K) == (13, 1)
+    assert mod2.rank(ring_code(4)) == 3
+
+
+def test_compat_girth_and_graphs():
+    import qldpc_fault_tolerance_tpu.compat as compat
+
+    compat.install()
+    from QuantumExanderCodesGene import Girth, RandomaGraphs, TannerGraphToCheckMat
+
+    H = RandomaGraphs(3, 4, 3)
+    assert TannerGraphToCheckMat(H) is not None
+    assert Girth(H) >= 4
+
+
+# ------------------------------------------------------------- par2gen
+HAMMING_P = np.array([[1, 1, 0], [0, 1, 1], [1, 1, 1], [1, 0, 1]])
+
+
+@pytest.fixture
+def hamming():
+    from qldpc_fault_tolerance_tpu.utils import LinearBlockCode
+
+    G = np.concatenate([HAMMING_P, np.eye(4, dtype=int)], axis=1)
+    return LinearBlockCode(G=G)
+
+
+def test_linear_block_code_params(hamming):
+    assert (hamming.n(), hamming.k()) == (7, 4)
+    assert hamming.dmin() == 3
+    assert hamming.t() == 1
+    assert hamming.errorDetectionCapability() == 2
+
+
+def test_linear_block_code_weight_distribution(hamming):
+    # [7,4,3] Hamming: A = [1,0,0,7,7,0,0,1]
+    assert list(hamming.A()) == [1, 0, 0, 7, 7, 0, 0, 1]
+    assert hamming.Ai(3) == 7
+
+
+def test_linear_block_code_h_g_round_trip(hamming):
+    from qldpc_fault_tolerance_tpu.utils import GtoH, HtoG
+
+    H = hamming.H()
+    assert not (H @ hamming.G().T % 2).any()  # H G^T = 0
+    assert np.array_equal(HtoG(GtoH(hamming.G())), hamming.G())
+
+
+def test_linear_block_code_syndrome_decode(hamming):
+    m = np.array([1, 0, 1, 1])
+    c = hamming.c(m)
+    r = c.copy()
+    r[4] ^= 1  # single error: within t=1
+    assert np.array_equal(hamming.syndromeDecode(r), c)
+
+
+def test_linear_block_code_probabilities(hamming):
+    # PU at p=0 is 0 and increases with p; Pe bounded
+    assert hamming.PU(0.0) == 0.0
+    assert 0 < hamming.PU(0.01) < hamming.PU(0.1)
+    assert 0 <= hamming.Pe(0.01) <= 1

@@ -1,0 +1,133 @@
+"""Tests for the sweep layer: fits on synthetic data, family orchestration."""
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import (
+    BPOSD_Decoder_Class,
+    BP_Decoder_Class,
+    ST_BP_Decoder_Circuit_Class,
+    ST_BPOSD_Decoder_Circuit_Class,
+)
+from qldpc_fault_tolerance_tpu.sweep import (
+    CodeFamily,
+    CodeFamily_SpaceTime,
+    DistanceEst,
+    FitSusThreshold,
+    SustainableThresholdEst,
+    ThresholdEst_extrapolation,
+)
+
+
+# ------------------------------------------------------------------- fits
+def test_distance_est_recovers_exponent():
+    p = np.array([0.002, 0.004, 0.008, 0.016])
+    pl = [0.5 * p ** (3 / 2), 0.2 * p ** (5 / 2)]  # d=3 and d=5 codes
+    d = DistanceEst(p, pl)
+    assert d[0] == pytest.approx(3, rel=1e-3)
+    assert d[1] == pytest.approx(5, rel=1e-3)
+
+
+def test_threshold_extrapolation_recovers_pc():
+    pc, A = 0.05, 0.3
+    p = 10 ** np.linspace(np.log10(pc * 0.4), np.log10(pc * 0.8), 6)
+    pl = np.array([A * (p / pc) ** (d / 2) for d in (3, 5, 7)])
+    est = ThresholdEst_extrapolation(p, pl, verbose=False)
+    assert est == pytest.approx(pc, rel=0.05)
+
+
+def test_sustainable_threshold_fit():
+    p_sus, p0, gamma = 0.02, 0.06, 0.3
+    cycles = np.array([5, 10, 15, 20, 25, 30])
+    th = FitSusThreshold(cycles, p_sus, p0, gamma)
+    est = SustainableThresholdEst(cycles, th)
+    assert est == pytest.approx(p_sus, rel=1e-3)
+
+
+# ----------------------------------------------------------- CodeFamily
+@pytest.fixture(scope="module")
+def family_codes():
+    return [hgp(rep_code(3), rep_code(3)), hgp(rep_code(5), rep_code(5))]
+
+
+def test_code_family_data_sweep(family_codes):
+    fam = CodeFamily(
+        family_codes,
+        decoder1_class=BP_Decoder_Class(10, "minimum_sum", 0.625),
+        decoder2_class=BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_e", 4),
+        batch_size=128, seed=1,
+    )
+    p_list = [0.02, 0.08]
+    wer = fam.EvalWER("data", "Total", p_list, num_samples=256, if_plot=False)
+    assert wer.shape == (2, 2)
+    assert (wer >= 0).all() and (wer <= 1).all()
+    # higher p must not give a lower WER for the small code
+    assert wer[0, 1] >= wer[0, 0]
+    # at low p the larger code beats the smaller one
+    assert wer[1, 0] <= wer[0, 0] + 0.02
+
+
+def test_code_family_phenl_smoke(family_codes):
+    fam = CodeFamily(
+        [family_codes[0]],
+        decoder1_class=BP_Decoder_Class(1, "minimum_sum", 0.625),
+        decoder2_class=BPOSD_Decoder_Class(3, "minimum_sum", 0.625, "osd_e", 4),
+        batch_size=64, seed=2,
+    )
+    wer = fam.EvalWER("phenl", "Total", [0.01], num_samples=128,
+                      num_cycles=3, if_plot=False)
+    assert wer.shape == (1, 1)
+    assert 0 <= wer[0, 0] <= 1
+
+
+def test_code_family_circuit_smoke(family_codes):
+    fam = CodeFamily(
+        [family_codes[0]],
+        decoder1_class=BP_Decoder_Class(1, "minimum_sum", 0.625),
+        decoder2_class=BPOSD_Decoder_Class(3, "minimum_sum", 0.625, "osd_e", 4),
+        batch_size=64, seed=3,
+    )
+    ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 1, "p_idling_gate": 0}
+    wer = fam.EvalWER("circuit", "Z", [0.004], num_samples=128, num_cycles=3,
+                      circuit_error_params=ep, if_plot=False)
+    assert wer.shape == (1, 1)
+    assert 0 <= wer[0, 0] <= 0.5
+
+
+# -------------------------------------------------- CodeFamily_SpaceTime
+def test_code_family_spacetime_circuit(family_codes):
+    fam = CodeFamily_SpaceTime(
+        [family_codes[0]],
+        decoder1_class=ST_BP_Decoder_Circuit_Class(1, "minimum_sum", 0.625),
+        decoder2_class=ST_BPOSD_Decoder_Circuit_Class(
+            1, "minimum_sum", 0.625, "osd_e", 4),
+        batch_size=64, seed=4,
+    )
+    ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 1, "p_idling_gate": 0}
+    wer_list, p_list = fam.EvalWER(
+        "circuit", "Z", [0.003], num_samples=128, num_cycles=7, num_rep=3,
+        circuit_error_params=ep, if_plot=False,
+    )
+    assert len(wer_list) == 1 and len(p_list) == 1
+    assert wer_list[0].shape == (1,)
+    assert 0 <= wer_list[0][0] <= 0.5
+
+
+def test_code_family_spacetime_adaptive_pruning(family_codes):
+    fam = CodeFamily_SpaceTime(
+        [family_codes[0]],
+        decoder1_class=ST_BP_Decoder_Circuit_Class(1, "minimum_sum", 0.625),
+        decoder2_class=ST_BPOSD_Decoder_Circuit_Class(
+            1, "minimum_sum", 0.625, "osd_e", 4),
+        batch_size=32, seed=5,
+    )
+    ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 1, "p_idling_gate": 0}
+    adaptive = {"WEREst": lambda N, p: p, "min_wer": 0.005}
+    wer_list, p_adapt = fam.EvalWER(
+        "circuit", "Z", [0.001, 0.01], num_samples=32, num_cycles=7,
+        num_rep=3, circuit_error_params=ep, if_plot=False,
+        if_adaptive=True, adaptive_params=adaptive,
+    )
+    # 0.001 pruned away by the predictor
+    assert list(p_adapt[0]) == [0.01]
+    assert wer_list[0].shape == (1,)
