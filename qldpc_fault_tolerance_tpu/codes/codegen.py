@@ -188,7 +188,7 @@ def GeneRandGraphsLargeGirthFinal(n0: int, Delta_c: int, Delta_v: int,
         H2, ok = improve_girth(H, target_girth, max_iter=swap_iters, rng=rng)
         if ok:
             out.append(H2)
-    else:
+    if len(out) < num:
         print("Max iter reached")
     return out
 

@@ -63,6 +63,6 @@ def GeneRandGraphsLargeGirth(n0, Delta_c, Delta_v, min_girth, min_distance,
         if tanner_girth(H) >= min_girth and \
                 classical_code_distance(H) >= min_distance:
             out.append(H)
-    else:
+    if len(out) < num:
         print("Max iter reached")
     return out

@@ -98,6 +98,16 @@ class CodeSimulator_DataError:
         fail, _ = self._check_failures(ex, ez, cx, cz)
         return fail
 
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _device_batch_stats(self, key, batch_size: int):
+        """One batch fully on device: (failure count, min logical weight).
+        No host transfer — callers accumulate these device scalars across
+        batches and read back once per sweep (the tunneled TPU pays ~100ms
+        latency per device->host transfer; per-batch syncs would dominate)."""
+        ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp(key, batch_size)
+        fail, min_w = self._check_failures(ex, ez, cx, cz)
+        return fail.sum(dtype=jnp.int32), min_w
+
     def _sharded_runner(self):
         from ..parallel import sharded_failure_count
 
@@ -111,15 +121,16 @@ class CodeSimulator_DataError:
             )
         return self._sharded
 
-    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
-        """Run one batch; returns per-shot failure flags (host bool array)."""
-        bs = batch_size or self.batch_size
-        ex, ez, sx, sz, cx, cz, ax, az = self._sample_and_bp(key, bs)
-        if self._needs_host:
+    def _drain_batch(self, batch_out) -> np.ndarray:
+        """Host-postprocess one _sample_and_bp output tuple and return the
+        per-shot failure flags; updates min_logical_weight."""
+        ex, ez, sx, sz, cx, cz, ax, az = batch_out
+        if self.decoder_x.needs_host_postprocess:
             cx = jnp.asarray(
                 self.decoder_x.host_postprocess(np.asarray(sx), np.asarray(cx),
                                                 jax.device_get(ax))
             )
+        if self.decoder_z.needs_host_postprocess:
             cz = jnp.asarray(
                 self.decoder_z.host_postprocess(np.asarray(sz), np.asarray(cz),
                                                 jax.device_get(az))
@@ -127,6 +138,11 @@ class CodeSimulator_DataError:
         fail, min_w = self._check_failures(ex, ez, cx, cz)
         self.min_logical_weight = min(self.min_logical_weight, int(min_w))
         return np.asarray(fail)
+
+    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
+        """Run one batch; returns per-shot failure flags (host bool array)."""
+        bs = batch_size or self.batch_size
+        return self._drain_batch(self._sample_and_bp(key, bs))
 
     def _single_run(self):
         """Reference-compatible single-shot entry (src/Simulators.py:117-168)."""
@@ -149,8 +165,31 @@ class CodeSimulator_DataError:
                 error_count += int(run(keys))
             return wer_single_shot(error_count, batcher.total, self.K)
         batcher = ShotBatcher(num_run, self.batch_size)
+        if not self._needs_host:
+            # all-device accumulation: every batch dispatch is async, the
+            # single int() at the end is the only device->host sync
+            total = jnp.zeros((), jnp.int32)
+            min_w = jnp.asarray(self.N, jnp.int32)
+            for i in batcher:
+                cnt, mw = self._device_batch_stats(
+                    jax.random.fold_in(key, i), self.batch_size
+                )
+                total = total + cnt
+                min_w = jnp.minimum(min_w, mw)
+            self.min_logical_weight = min(self.min_logical_weight, int(min_w))
+            return wer_single_shot(int(total), batcher.total, self.K)
+        # host-postprocess (OSD) path: keep a small window of batches in
+        # flight so device compute overlaps the host transfers without
+        # holding every batch's outputs in HBM at once
+        window: list = []
         error_count = 0
+        in_flight = 4
         for i in batcher:
-            fail = self.run_batch(jax.random.fold_in(key, i))
-            error_count += int(fail.sum())
+            window.append(
+                self._sample_and_bp(jax.random.fold_in(key, i), self.batch_size)
+            )
+            if len(window) >= in_flight:
+                error_count += int(self._drain_batch(window.pop(0)).sum())
+        while window:
+            error_count += int(self._drain_batch(window.pop(0)).sum())
         return wer_single_shot(error_count, batcher.total, self.K)

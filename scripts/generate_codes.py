@@ -49,6 +49,7 @@ FAMILY = {
 def generate_one(tag: str, n0: int, target_girth: int, master_seed: int):
     t0 = time.time()
     rng = np.random.default_rng(master_seed)
+    configured_girth = target_girth
     attempts = 0
     while True:
         attempts += 1
@@ -70,9 +71,14 @@ def generate_one(tag: str, n0: int, target_girth: int, master_seed: int):
     save_code(code, path)
     seed_path = os.path.join(OUT_DIR, f"hgp_34_{tag}_seedH.npy")
     np.save(seed_path, H)
+    # reproducibility contract: rerunning this script with the same FAMILY
+    # entry replays the identical RNG path (the girth step-down happens at
+    # fixed attempt counts); both the configured and the achieved target are
+    # recorded so the metadata alone cannot be mistaken for the replay recipe
     meta = {
         "tag": tag, "n0": n0, "delta_c": 4, "delta_v": 3,
-        "target_girth": target_girth, "master_seed": master_seed,
+        "configured_target_girth": configured_girth,
+        "achieved_target_girth": target_girth, "master_seed": master_seed,
         "attempts": attempts, "seed_girth": int(tanner_girth(H)),
         "N": int(code.N), "K": int(code.K),
         "elapsed_s": round(time.time() - t0, 1),

@@ -140,3 +140,26 @@ def test_nonuniform_channel_probs_break_ties():
         max_iter=10,
     )
     assert np.array_equal(np.asarray(res.error)[0], [0, 1])
+
+
+def test_two_phase_matches_plain_bp():
+    """bp_decode_two_phase must be bit-identical to bp_decode, including when
+    the overflow fallback triggers."""
+    import jax
+    import jax.numpy as jnp
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.ops import bp
+    from qldpc_fault_tolerance_tpu.ops.linalg import gf2_matmul
+
+    code = hgp(rep_code(5), rep_code(5))
+    graph = bp.build_tanner_graph(code.hx)
+    llr0 = bp.llr_from_probs(np.full(code.N, 0.05))
+    for p, cap in ((0.02, 16), (0.3, 4)):  # low p: compaction; high p: overflow
+        err = (jax.random.uniform(jax.random.PRNGKey(3), (128, code.N)) < p
+               ).astype(jnp.uint8)
+        synd = gf2_matmul(err, jnp.asarray(code.hx.T))
+        a = bp.bp_decode(graph, synd, llr0, max_iter=30)
+        b = bp.bp_decode_two_phase(graph, synd, llr0, max_iter=30,
+                                   head_iters=4, tail_capacity=cap)
+        assert np.array_equal(np.asarray(a.error), np.asarray(b.error))
+        assert np.array_equal(np.asarray(a.converged), np.asarray(b.converged))
