@@ -26,7 +26,7 @@ import numpy as np
 from ..circuits import AddCXError, Circuit, ColorationCircuit, FrameSampler, \
     RandomCircuit, target_rec
 from ..ops.linalg import gf2_matmul
-from .common import ShotBatcher, wer_per_cycle
+from .common import ShotBatcher, accumulate_counts, wer_per_cycle, windowed_count
 
 __all__ = ["CodeSimulator_Circuit", "build_memory_circuit"]
 
@@ -284,17 +284,9 @@ class CodeSimulator_Circuit:
         return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
 
     # ------------------------------------------------------------------
-    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
-        self._ensure_circuit()
-        assert not self.decoder1_z.needs_host_postprocess, (
-            "decoder1 runs inside the per-round scan on device; its host OSD "
-            "stage would be silently skipped — use a plain BP decoder for the "
-            "in-loop decodes (the reference does the same, "
-            "src/Simulators.py:780-811)"
-        )
-        bs = batch_size or self.batch_size
-        obs, correction, corrected_final, final_cor, aux = \
-            self._sample_and_decode_rounds(key, bs)
+    def _finish_batch(self, pending):
+        """Host postprocess (if any) + failure flags for one pending batch."""
+        obs, correction, corrected_final, final_cor, aux = pending
         if self.decoder2_z.needs_host_postprocess:
             final_cor = jnp.asarray(
                 self.decoder2_z.host_postprocess(
@@ -302,21 +294,51 @@ class CodeSimulator_Circuit:
                     jax.device_get(aux),
                 )
             )
+        return self._check_failures(obs, correction, corrected_final, final_cor)
+
+    def _assert_round_decoder_device(self):
+        assert not self.decoder1_z.needs_host_postprocess, (
+            "decoder1 runs inside the per-round scan on device; its host OSD "
+            "stage would be silently skipped — use a plain BP decoder for the "
+            "in-loop decodes (the reference does the same, "
+            "src/Simulators.py:780-811)"
+        )
+
+    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
+        self._ensure_circuit()
+        self._assert_round_decoder_device()
+        bs = batch_size or self.batch_size
         return np.asarray(
-            self._check_failures(obs, correction, corrected_final, final_cor)
+            self._finish_batch(self._sample_and_decode_rounds(key, bs))
         )
 
     def _single_run(self):
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, 1)[0])
 
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _device_batch_count(self, key, batch_size: int):
+        obs, correction, corrected_final, final_cor, _ = \
+            self._sample_and_decode_rounds(key, batch_size)
+        return self._check_failures(
+            obs, correction, corrected_final, final_cor
+        ).sum(dtype=jnp.int32)
+
     def WordErrorRate(self, num_samples: int, key=None):
         """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
         self._ensure_circuit()
+        self._assert_round_decoder_device()
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         batcher = ShotBatcher(num_samples, self.batch_size)
-        count = 0
-        for i in batcher:
-            count += int(self.run_batch(jax.random.fold_in(key, i)).sum())
+        keys = [jax.random.fold_in(key, i) for i in batcher]
+        if not self.decoder2_z.needs_host_postprocess:
+            count = accumulate_counts(
+                lambda k: self._device_batch_count(k, self.batch_size), keys
+            )
+            return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+        count = windowed_count(
+            lambda k: self._sample_and_decode_rounds(k, self.batch_size),
+            self._finish_batch, keys,
+        )
         return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)

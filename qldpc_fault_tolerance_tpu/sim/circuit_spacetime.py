@@ -34,7 +34,7 @@ from ..circuits import (
 )
 from ..ops.linalg import gf2_matmul
 from .circuit import _swap_xz_inplace, build_memory_circuit
-from .common import ShotBatcher, wer_per_cycle
+from .common import ShotBatcher, accumulate_counts, wer_per_cycle, windowed_count
 
 __all__ = ["CodeSimulator_Circuit_SpaceTime"]
 
@@ -180,17 +180,9 @@ class CodeSimulator_Circuit_SpaceTime:
         return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
 
     # ------------------------------------------------------------------
-    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
-        self._ensure_ready()
-        assert not self.decoder1_z.needs_host_postprocess, (
-            "the window decoder runs inside the sliding-window scan on "
-            "device; its host OSD stage would be silently skipped — use a "
-            "plain BP window decoder (the reference does the same, "
-            "src/Simulators_SpaceTime.py:994-1002)"
-        )
-        bs = batch_size or self.batch_size
-        obs, total_log, final_syn, final_cor, aux = \
-            self._sample_and_decode_windows(key, bs)
+    def _finish_batch(self, pending):
+        """Host postprocess (if any) + failure flags for one pending batch."""
+        obs, total_log, final_syn, final_cor, aux = pending
         if self.decoder2_z.needs_host_postprocess:
             final_cor = jnp.asarray(
                 self.decoder2_z.host_postprocess(
@@ -198,23 +190,53 @@ class CodeSimulator_Circuit_SpaceTime:
                     jax.device_get(aux),
                 )
             )
+        return self._check_failures(obs, total_log, final_syn, final_cor)
+
+    def _assert_window_decoder_device(self):
+        assert not self.decoder1_z.needs_host_postprocess, (
+            "the window decoder runs inside the sliding-window scan on "
+            "device; its host OSD stage would be silently skipped — use a "
+            "plain BP window decoder (the reference does the same, "
+            "src/Simulators_SpaceTime.py:994-1002)"
+        )
+
+    def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
+        self._ensure_ready()
+        self._assert_window_decoder_device()
+        bs = batch_size or self.batch_size
         return np.asarray(
-            self._check_failures(obs, total_log, final_syn, final_cor)
+            self._finish_batch(self._sample_and_decode_windows(key, bs))
         )
 
     def _single_run(self):
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, 1)[0])
 
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _device_batch_count(self, key, batch_size: int):
+        obs, total_log, final_syn, final_cor, _ = \
+            self._sample_and_decode_windows(key, batch_size)
+        return self._check_failures(
+            obs, total_log, final_syn, final_cor
+        ).sum(dtype=jnp.int32)
+
     def WordErrorRate(self, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:1031-1049."""
         self._ensure_ready()
+        self._assert_window_decoder_device()
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         batcher = ShotBatcher(num_samples, self.batch_size)
-        count = 0
-        for i in batcher:
-            count += int(self.run_batch(jax.random.fold_in(key, i)).sum())
+        keys = [jax.random.fold_in(key, i) for i in batcher]
+        if not self.decoder2_z.needs_host_postprocess:
+            count = accumulate_counts(
+                lambda k: self._device_batch_count(k, self.batch_size), keys
+            )
+            return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+        count = windowed_count(
+            lambda k: self._sample_and_decode_windows(k, self.batch_size),
+            self._finish_batch, keys,
+        )
         return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
 
     def WordErrorRate_TargetFailure(self, target_failures: int, batch_size: int,

@@ -12,7 +12,49 @@ import dataclasses
 
 import numpy as np
 
-__all__ = ["wer_single_shot", "wer_per_cycle", "ShotBatcher", "SimResult"]
+__all__ = [
+    "wer_single_shot",
+    "wer_per_cycle",
+    "ShotBatcher",
+    "SimResult",
+    "accumulate_device",
+    "accumulate_counts",
+    "windowed_count",
+]
+
+
+def accumulate_device(step_fn, keys, combine):
+    """Fold ``step_fn(key)`` outputs with ``combine`` entirely on device.
+
+    Every dispatch is asynchronous; the caller materializes the result once —
+    the tunneled TPU pays ~100ms latency per device->host transfer, so
+    per-batch syncs would dominate wall-clock (SURVEY §6 north-star
+    pipeline).  Returns None for an empty key list."""
+    acc = None
+    for k in keys:
+        out = step_fn(k)
+        acc = out if acc is None else combine(acc, out)
+    return acc
+
+
+def accumulate_counts(count_fn, keys) -> int:
+    """Sum device scalar counts over batches with ONE final host sync."""
+    total = accumulate_device(count_fn, keys, lambda a, b: a + b)
+    return 0 if total is None else int(total)
+
+
+def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
+    """Failure counting for host-assisted (OSD) paths: keep ``in_flight``
+    batches of device work pending so compute overlaps the host transfers,
+    without holding every batch's outputs in HBM at once."""
+    window, count = [], 0
+    for k in keys:
+        window.append(launch(k))
+        if len(window) >= in_flight:
+            count += int(np.asarray(finish(window.pop(0))).sum())
+    while window:
+        count += int(np.asarray(finish(window.pop(0))).sum())
+    return count
 
 
 def wer_single_shot(error_count: int, num_run: int, K: int):

@@ -20,7 +20,12 @@ import numpy as np
 
 from ..noise import depolarizing_xz
 from ..ops.linalg import gf2_matmul
-from .common import ShotBatcher, wer_single_shot
+from .common import (
+    ShotBatcher,
+    accumulate_device,
+    wer_single_shot,
+    windowed_count,
+)
 
 __all__ = ["CodeSimulator_DataError"]
 
@@ -165,31 +170,21 @@ class CodeSimulator_DataError:
                 error_count += int(run(keys))
             return wer_single_shot(error_count, batcher.total, self.K)
         batcher = ShotBatcher(num_run, self.batch_size)
+        keys = [jax.random.fold_in(key, i) for i in batcher]
         if not self._needs_host:
             # all-device accumulation: every batch dispatch is async, the
-            # single int() at the end is the only device->host sync
-            total = jnp.zeros((), jnp.int32)
-            min_w = jnp.asarray(self.N, jnp.int32)
-            for i in batcher:
-                cnt, mw = self._device_batch_stats(
-                    jax.random.fold_in(key, i), self.batch_size
-                )
-                total = total + cnt
-                min_w = jnp.minimum(min_w, mw)
+            # single materialization at the end is the only host sync
+            total, min_w = accumulate_device(
+                lambda k: self._device_batch_stats(k, self.batch_size),
+                keys,
+                lambda a, b: (a[0] + b[0], jnp.minimum(a[1], b[1])),
+            )
             self.min_logical_weight = min(self.min_logical_weight, int(min_w))
             return wer_single_shot(int(total), batcher.total, self.K)
-        # host-postprocess (OSD) path: keep a small window of batches in
-        # flight so device compute overlaps the host transfers without
-        # holding every batch's outputs in HBM at once
-        window: list = []
-        error_count = 0
-        in_flight = 4
-        for i in batcher:
-            window.append(
-                self._sample_and_bp(jax.random.fold_in(key, i), self.batch_size)
-            )
-            if len(window) >= in_flight:
-                error_count += int(self._drain_batch(window.pop(0)).sum())
-        while window:
-            error_count += int(self._drain_batch(window.pop(0)).sum())
+        # host-postprocess (OSD) path: bounded in-flight window so device
+        # compute overlaps the host transfers
+        error_count = windowed_count(
+            lambda k: self._sample_and_bp(k, self.batch_size),
+            self._drain_batch, keys,
+        )
         return wer_single_shot(error_count, batcher.total, self.K)

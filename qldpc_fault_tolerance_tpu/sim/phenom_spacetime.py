@@ -23,7 +23,7 @@ import numpy as np
 
 from ..noise import bit_flips, depolarizing_xz
 from ..ops.linalg import gf2_matmul
-from .common import ShotBatcher, wer_per_cycle
+from .common import ShotBatcher, accumulate_counts, wer_per_cycle, windowed_count
 
 __all__ = ["CodeSimulator_Phenon_SpaceTime"]
 
@@ -135,33 +135,71 @@ class CodeSimulator_Phenon_SpaceTime:
         return x_fail | z_fail
 
     # ------------------------------------------------------------------
-    def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
-        bs = batch_size or self.batch_size
+    def _launch_batch(self, key, num_rounds: int, batch_size: int):
+        """Device stage of one batch (async); returns the pending tuple."""
         k_rounds, k_final = jax.random.split(key)
-        data_x, data_z = self._noisy_rounds_device(k_rounds, bs, num_rounds)
-        cur_x, cur_z, sx, sz, dx, dz, ax, az = self._final_round(
-            k_final, data_x, data_z, bs
-        )
-        if self.decoder2_x.needs_host_postprocess or self.decoder2_z.needs_host_postprocess:
+        data_x, data_z = self._noisy_rounds_device(k_rounds, batch_size, num_rounds)
+        return self._final_round(k_final, data_x, data_z, batch_size)
+
+    def _finish_batch(self, pending):
+        """Host postprocess (if any) + failure flags for one pending batch."""
+        cur_x, cur_z, sx, sz, dx, dz, ax, az = pending
+        if self.decoder2_x.needs_host_postprocess:
             dx = jnp.asarray(self.decoder2_x.host_postprocess(
                 np.asarray(sx), np.asarray(dx), jax.device_get(ax)))
+        if self.decoder2_z.needs_host_postprocess:
             dz = jnp.asarray(self.decoder2_z.host_postprocess(
                 np.asarray(sz), np.asarray(dz), jax.device_get(az)))
-        return np.asarray(self._check_failures(cur_x, cur_z, dx, dz))
+        return self._check_failures(cur_x, cur_z, dx, dz)
+
+    def _assert_window_decoders_device(self):
+        assert not (self.decoder1_x.needs_host_postprocess
+                    or self.decoder1_z.needs_host_postprocess), (
+            "the space-time window decoders run inside the round scan on "
+            "device; their host OSD stage would be silently skipped — use "
+            "plain BP window decoders (the reference does the same, "
+            "src/Simulators_SpaceTime.py:471-481)"
+        )
+
+    def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
+        self._assert_window_decoders_device()
+        bs = batch_size or self.batch_size
+        return np.asarray(self._finish_batch(self._launch_batch(key, num_rounds, bs)))
 
     def _single_run(self, num_rounds):
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, num_rounds, 1)[0])
 
+    @functools.partial(jax.jit, static_argnames=("self", "num_rounds", "batch_size"))
+    def _device_batch_count(self, key, num_rounds: int, batch_size: int):
+        """Whole batch on device -> failure count scalar (no host sync)."""
+        k_rounds, k_final = jax.random.split(key)
+        data_x, data_z = self._noisy_rounds_device(k_rounds, batch_size, num_rounds)
+        cur_x, cur_z, _, _, dx, dz, _, _ = self._final_round(
+            k_final, data_x, data_z, batch_size
+        )
+        return self._check_failures(cur_x, cur_z, dx, dz).sum(dtype=jnp.int32)
+
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into
         windows of num_rep; total cycle count must come out odd."""
+        self._assert_window_decoders_device()
         num_rounds = int((num_cycles - 1) / self.num_rep + 1)
         total_num_cycles = (num_rounds - 1) * self.num_rep + 1
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         batcher = ShotBatcher(num_samples, self.batch_size)
-        count = 0
-        for i in batcher:
-            count += int(self.run_batch(jax.random.fold_in(key, i), num_rounds).sum())
+        keys = [jax.random.fold_in(key, i) for i in batcher]
+        dec2_host = (self.decoder2_x.needs_host_postprocess
+                     or self.decoder2_z.needs_host_postprocess)
+        if not dec2_host:
+            count = accumulate_counts(
+                lambda k: self._device_batch_count(k, num_rounds, self.batch_size),
+                keys,
+            )
+            return wer_per_cycle(count, batcher.total, self.K, total_num_cycles)
+        count = windowed_count(
+            lambda k: self._launch_batch(k, num_rounds, self.batch_size),
+            self._finish_batch, keys,
+        )
         return wer_per_cycle(count, batcher.total, self.K, total_num_cycles)
