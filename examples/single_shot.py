@@ -1,0 +1,62 @@
+"""Single-shot / sustainability study (mirrors the Single-Shot notebook).
+
+1. Code-capacity WER sweep of the hgp_34 family with BP+OSD (ckpt cell 4).
+2. Phenomenological WER vs cycle count with FirstMinBP + BPOSD final
+   (ckpt cell 9) — the flattening of WER/cycle with growing cycle count is
+   the single-shot property.
+
+Run: PYTHONPATH=. python examples/single_shot.py [--quick]
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+from qldpc_fault_tolerance_tpu.codes import load_code
+from qldpc_fault_tolerance_tpu.decoders import (
+    BPOSD_Decoder_Class,
+    FirstMinBP_Decoder_Class,
+)
+from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+from qldpc_fault_tolerance_tpu.utils import SweepCheckpoint, timings
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(quick: bool = True):
+    codes = [
+        load_code(os.path.join(HERE, "codes_lib_tpu", f"hgp_34_{t}.npz"))
+        for t in (["n225", "n625"] if quick else ["n225", "n625", "n1225", "n1600"])
+    ]
+    print("codes:", [(c.N, c.K) for c in codes])
+    samples = 2000 if quick else 10000
+
+    # --- 1. code-capacity WER sweep (BP+OSD) -----------------------------
+    family = CodeFamily(
+        codes,
+        decoder1_class=FirstMinBP_Decoder_Class(5, "minimum_sum", 0.9),
+        decoder2_class=BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_e", 10),
+        batch_size=2048,
+    )
+    p_list = [0.02, 0.04, 0.06, 0.08]
+    ckpt = SweepCheckpoint(os.path.join(HERE, "examples", ".single_shot.ckpt.jsonl"))
+    t0 = time.time()
+    wer = family.EvalWER("data", "Total", p_list, samples, if_plot=False,
+                         checkpoint=ckpt)
+    print(f"data-noise WER array ({time.time()-t0:.1f}s):")
+    for c, row in zip(codes, wer):
+        print(f"  [[{c.N},{c.K}]]: " + " ".join(f"{w:.2e}" for w in row))
+
+    # --- 2. phenomenological WER vs cycles (single-shot behavior) --------
+    t0 = time.time()
+    for cycles in ([5, 11] if quick else [5, 11, 17, 23, 29]):
+        wer = family.EvalWER("phenl", "Total", [0.02], samples // cycles,
+                             num_cycles=cycles, if_plot=False)
+        print(f"  phenl p=0.02 cycles={cycles:2d}: WER/cycle = {wer[0,0]:.3e}")
+    print(f"sustainability sweep: {time.time()-t0:.1f}s")
+    print("stage timings:", timings())
+
+
+if __name__ == "__main__":
+    main(quick="--full" not in sys.argv)

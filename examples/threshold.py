@@ -1,0 +1,62 @@
+"""Threshold estimation (mirrors the Threshold notebook).
+
+Phenomenological and circuit-level threshold fits for the hgp_34 family:
+decoder 1 = plain BP over the extended [H|I] matrix (N/30 iterations),
+decoder 2 = BP+OSD (N/10 iterations) — Threshold ckpt cells 2-4.
+
+Run: PYTHONPATH=. python examples/threshold.py [--full]
+"""
+import os
+import sys
+import time
+
+from qldpc_fault_tolerance_tpu.codes import load_code
+from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class, BP_Decoder_Class
+from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main(quick: bool = True):
+    tags = ["n225", "n625"] if quick else ["n225", "n625", "n1225"]
+    codes = [
+        load_code(os.path.join(HERE, "codes_lib_tpu", f"hgp_34_{t}.npz"))
+        for t in tags
+    ]
+    print("codes:", [(c.N, c.K) for c in codes])
+    samples = 2000 if quick else 12000
+
+    family = CodeFamily(
+        codes,
+        decoder1_class=BP_Decoder_Class(30, "minimum_sum", 0.625),
+        decoder2_class=BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_e", 10),
+        batch_size=2048,
+    )
+
+    # phenomenological threshold at a fixed cycle count (ckpt cell 12 ran
+    # cycles in {6..30}; published p_c at 6 cycles: 0.0900)
+    t0 = time.time()
+    pc = family.EvalThreshold(
+        "phenl", "Total", "extrapolation", est_threshold=0.07,
+        num_samples=samples, num_cycles=5, if_plot=False,
+    )
+    print(f"phenl threshold (5 cycles): p_c = {pc:.4f}  ({time.time()-t0:.1f}s)")
+
+    # circuit-level threshold (ckpt cell 29: analytic decoder priors
+    # p_data = 3*6*(8/15) p, p_synd = 7*(8/15) p; published p_c at 3 cycles:
+    # 0.0392)
+    circuit_error_params = {
+        "p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 1, "p_idling_gate": 0,
+    }
+    t0 = time.time()
+    pc = family.EvalThreshold(
+        "circuit", "Z", "extrapolation", est_threshold=0.01,
+        num_samples=samples, num_cycles=3,
+        data_synd_noise_ratio=3 * 6 * (8 / 15) / (7 * 8 / 15),
+        circuit_error_params=circuit_error_params, if_plot=False,
+    )
+    print(f"circuit threshold (3 cycles): p_c = {pc:.4f}  ({time.time()-t0:.1f}s)")
+
+
+if __name__ == "__main__":
+    main(quick="--full" not in sys.argv)

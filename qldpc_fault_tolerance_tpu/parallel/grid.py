@@ -1,0 +1,44 @@
+"""Multi-host sharding of the sweep grid.
+
+Shot batches shard across the chips of one host over ICI (shots.py).  Across
+hosts, the (code, p, cycles) *grid* is what scales: every JAX process owns a
+round-robin subset of cells, runs them on its local chips, and only the
+scalar per-cell results cross DCN in one allgather at the end — the TPU
+mapping of the reference's single-host process pool (SURVEY §2.3).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["process_cell_owner", "merge_cell_results"]
+
+
+def process_cell_owner(num_cells: int):
+    """Boolean mask of the cells this process owns (round-robin)."""
+    import jax
+
+    pi, pc = jax.process_index(), jax.process_count()
+    return np.asarray([(i % pc) == pi for i in range(num_cells)])
+
+
+def merge_cell_results(local_values: np.ndarray) -> np.ndarray:
+    """Combine per-cell results across processes.
+
+    ``local_values``: float array with this process's cells filled and every
+    remote cell NaN.  Returns the fully-populated array on every process
+    (single-process: identity).  Uses a max-reduce over the process axis —
+    NaN-safe because exactly one process owns each cell.
+    """
+    import jax
+
+    if jax.process_count() == 1:
+        return local_values
+    from jax.experimental import multihost_utils
+
+    stacked = multihost_utils.process_allgather(
+        np.nan_to_num(local_values, nan=-np.inf)
+    )
+    merged = np.max(stacked, axis=0)
+    if np.isneginf(merged).any():
+        raise RuntimeError("some sweep cells were computed by no process")
+    return merged

@@ -118,18 +118,51 @@ class CodeFamily:
     def EvalWER(self, noise_model: str, eval_logical_type: str,
                 eval_p_list: list, num_samples: int, num_cycles=1,
                 data_synd_noise_ratio=1, circuit_type="coloration",
-                circuit_error_params=None, if_plot=True):
+                circuit_error_params=None, if_plot=True, checkpoint=None,
+                shard_across_processes: bool = False):
         """(len(code_list), len(eval_p_list)) WER array
-        (src/Simulators.py:752-908)."""
+        (src/Simulators.py:752-908).
+
+        ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
+        (code, p) cells are persisted as they complete and skipped on rerun.
+        ``shard_across_processes``: in a multi-host JAX program, each process
+        computes a round-robin subset of the grid; the scalar results merge
+        over DCN at the end (parallel/grid.py).
+        """
         assert noise_model in ["data", "phenl", "circuit"], (
             "noise_model should be one of [data, phenl, circuit]"
         )
         assert eval_logical_type in ["X", "Z", "Total"], (
             "eval_type should be one of [X, Y, Total]"
         )
+        from ..parallel.grid import merge_cell_results, process_cell_owner
+        from ..utils.observability import get_logger, log_record, stage_timer
+
+        logger = get_logger()
+        cells = [
+            (ci, code, eval_p)
+            for ci, code in enumerate(self.code_list)
+            for eval_p in eval_p_list
+        ]
+        owned = (
+            process_cell_owner(len(cells)) if shard_across_processes
+            else np.ones(len(cells), dtype=bool)
+        )
         eval_wer_list = []
-        for code in self.code_list:
-            for eval_p in eval_p_list:
+        for (ci, code, eval_p), mine in zip(cells, owned):
+            if not mine:
+                eval_wer_list.append(np.nan)
+                continue
+            cell_key = {
+                "code": code.name or f"code{ci}_N{code.N}K{code.K}",
+                "noise": noise_model, "type": eval_logical_type,
+                "p": float(eval_p), "cycles": int(num_cycles),
+                "samples": int(num_samples),
+            }
+            if checkpoint is not None and (rec := checkpoint.get(cell_key)):
+                eval_wer_list.append(rec["wer"])
+                continue
+            with stage_timer(f"cell:{noise_model}"):
                 if noise_model == "data":
                     wer = self._data_wer(code, eval_p, eval_logical_type,
                                          num_samples)
@@ -142,11 +175,15 @@ class CodeFamily:
                         num_cycles, data_synd_noise_ratio, circuit_type,
                         circuit_error_params,
                     )
-                eval_wer_list.append(wer)
+            log_record(logger, "cell_done", **cell_key, wer=float(wer))
+            if checkpoint is not None:
+                checkpoint.put(cell_key, {"wer": float(wer)})
+            eval_wer_list.append(wer)
 
-        eval_wer_array = np.reshape(
-            np.array(eval_wer_list), [len(self.code_list), len(eval_p_list)]
-        )
+        values = np.asarray(eval_wer_list, dtype=float)
+        if shard_across_processes:
+            values = merge_cell_results(values)
+        eval_wer_array = values.reshape(len(self.code_list), len(eval_p_list))
         if if_plot:
             self._plot_wer(eval_p_list, eval_wer_array, num_cycles)
         return eval_wer_array

@@ -41,19 +41,27 @@ class CodeFamily_SpaceTime:
     def EvalWER(self, noise_model: str, eval_logical_type: str,
                 eval_p_list: list, num_samples: int, num_cycles=1, num_rep=1,
                 circuit_type="coloration", circuit_error_params=None,
-                if_plot=True, if_adaptive=False, adaptive_params=None):
+                if_plot=True, if_adaptive=False, adaptive_params=None,
+                checkpoint=None):
         """(ragged) per-code WER/p lists
-        (src/Simulators_SpaceTime.py:1158-1307)."""
+        (src/Simulators_SpaceTime.py:1158-1307).
+
+        ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
+        cells are persisted as they complete and skipped on rerun.
+        """
         assert noise_model in ["data", "phenl", "circuit"], (
             "noise_model should be one of [data, phenl, circuit]"
         )
         assert eval_logical_type in ["X", "Z", "Total"], (
             "eval_type should be one of [X, Y, Total]"
         )
+        from ..utils.observability import get_logger, log_record, stage_timer
+
+        logger = get_logger()
         eval_wer_list = []
         eval_p_adapt_list = []
 
-        for code in self.code_list:
+        for ci, code in enumerate(self.code_list):
             if noise_model == "circuit" and if_adaptive:
                 WEREst = adaptive_params["WEREst"]
                 min_wer = adaptive_params["min_wer"]
@@ -63,24 +71,32 @@ class CodeFamily_SpaceTime:
 
             wer_per_code = []
             for eval_p in p_list:
-                if noise_model == "data":
-                    wer_per_code.append(
-                        self._data_wer(code, eval_p, eval_logical_type,
-                                       num_samples)
-                    )
-                elif noise_model == "phenl":
-                    wer_per_code.append(
-                        self._phenl_wer(code, eval_p, eval_logical_type,
-                                        num_samples, num_cycles, num_rep)
-                    )
-                else:
-                    wer_per_code.append(
-                        self._circuit_wer(
+                cell_key = {
+                    "code": code.name or f"code{ci}_N{code.N}K{code.K}",
+                    "noise": f"st-{noise_model}", "type": eval_logical_type,
+                    "p": float(eval_p), "cycles": int(num_cycles),
+                    "rep": int(num_rep), "samples": int(num_samples),
+                }
+                if checkpoint is not None and (rec := checkpoint.get(cell_key)):
+                    wer_per_code.append(rec["wer"])
+                    continue
+                with stage_timer(f"cell:st-{noise_model}"):
+                    if noise_model == "data":
+                        wer = self._data_wer(code, eval_p, eval_logical_type,
+                                             num_samples)
+                    elif noise_model == "phenl":
+                        wer = self._phenl_wer(code, eval_p, eval_logical_type,
+                                              num_samples, num_cycles, num_rep)
+                    else:
+                        wer = self._circuit_wer(
                             code, eval_p, eval_logical_type, num_samples,
                             num_cycles, num_rep, circuit_type,
                             circuit_error_params,
                         )
-                    )
+                log_record(logger, "cell_done", **cell_key, wer=float(wer))
+                if checkpoint is not None:
+                    checkpoint.put(cell_key, {"wer": float(wer)})
+                wer_per_code.append(wer)
             eval_p_adapt_list.append(np.array(p_list))
             eval_wer_list.append(np.array(wer_per_code))
 

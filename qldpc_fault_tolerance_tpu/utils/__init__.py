@@ -1,5 +1,18 @@
-"""Utility modules: classical linear-block-code teaching tools (par2gen)."""
+"""Utilities: par2gen teaching tools, observability, sweep checkpointing."""
 from . import par2gen
+from .checkpoint import SweepCheckpoint
+from .observability import (
+    get_logger,
+    log_record,
+    profile_trace,
+    reset_timings,
+    stage_timer,
+    timings,
+)
 from .par2gen import GtoH, GtoP, HtoG, HtoP, LinearBlockCode
 
-__all__ = ["par2gen", "HtoG", "GtoH", "HtoP", "GtoP", "LinearBlockCode"]
+__all__ = [
+    "par2gen", "HtoG", "GtoH", "HtoP", "GtoP", "LinearBlockCode",
+    "SweepCheckpoint", "stage_timer", "timings", "reset_timings",
+    "profile_trace", "get_logger", "log_record",
+]

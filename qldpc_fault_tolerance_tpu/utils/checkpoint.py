@@ -1,0 +1,69 @@
+"""Shard-level sweep checkpointing.
+
+The reference restarts killed sweeps from scratch (SURVEY §5: "checkpoint /
+resume: none").  Here each (code, noise model, p, cycles) cell's outcome is
+appended to a JSONL file as soon as it finishes; re-running the same sweep
+skips completed cells.  Cells are keyed by their physical parameters, so a
+resumed sweep may change batch sizes or ordering freely.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+__all__ = ["SweepCheckpoint"]
+
+
+def _canon(value):
+    if isinstance(value, float):
+        return round(value, 12)
+    return value
+
+
+class SweepCheckpoint:
+    """Append-only JSONL store of finished sweep cells.
+
+    >>> ckpt = SweepCheckpoint("sweep.jsonl")
+    >>> key = dict(code="hgp_34_n625", noise="phenl", p=0.01, cycles=5)
+    >>> if (rec := ckpt.get(key)) is None:
+    ...     rec = {"wer": run_the_cell()}
+    ...     ckpt.put(key, rec)
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._cells: dict[str, dict] = {}
+        if os.path.exists(path):
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    self._cells[self._key_str(entry["key"])] = entry["record"]
+
+    @staticmethod
+    def _key_str(key: dict) -> str:
+        return json.dumps(
+            {k: _canon(v) for k, v in key.items()}, sort_keys=True
+        )
+
+    def get(self, key: dict):
+        """Record for a finished cell, or None."""
+        return self._cells.get(self._key_str(key))
+
+    def put(self, key: dict, record: dict) -> None:
+        """Persist a finished cell (atomic append + fsync)."""
+        ks = self._key_str(key)
+        self._cells[ks] = record
+        with open(self.path, "a") as f:
+            f.write(json.dumps({"key": json.loads(ks), "record": record}) + "\n")
+            f.flush()
+            os.fsync(f.fileno())
+
+    def __len__(self) -> int:
+        return len(self._cells)
+
+    def __contains__(self, key: dict) -> bool:
+        return self._key_str(key) in self._cells

@@ -1,0 +1,87 @@
+"""Observability: stage timers, structured logging, profiler hooks.
+
+The reference has no tracing or logging at all — notebooks time whole sweeps
+with ``time.time()`` prints (SURVEY §5).  Here every sweep stage can be
+timed, the results are structured records, and the JAX profiler can be
+attached around any region for XLA-level traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import time
+from collections import defaultdict
+
+__all__ = ["stage_timer", "timings", "reset_timings", "profile_trace",
+           "get_logger", "log_record"]
+
+_TIMINGS: dict[str, list[float]] = defaultdict(list)
+
+
+@contextlib.contextmanager
+def stage_timer(name: str):
+    """Accumulate wall-clock for a named stage (sample/decode/osd/fit/...).
+
+    with stage_timer("decode"):
+        sim.WordErrorRate(...)
+    """
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _TIMINGS[name].append(time.perf_counter() - t0)
+
+
+def timings() -> dict[str, dict]:
+    """Summary of accumulated stage timings: count / total / mean seconds."""
+    return {
+        name: {
+            "count": len(vals),
+            "total_s": round(sum(vals), 6),
+            "mean_s": round(sum(vals) / len(vals), 6),
+        }
+        for name, vals in _TIMINGS.items()
+    }
+
+
+def reset_timings() -> None:
+    _TIMINGS.clear()
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str):
+    """Attach the JAX/XLA profiler around a region; view with TensorBoard or
+    xprof.  No-op context if the profiler cannot start (e.g. already active).
+    """
+    import jax
+
+    started = False
+    try:
+        jax.profiler.start_trace(log_dir)
+        started = True
+    except Exception as e:  # pragma: no cover - defensive
+        logging.getLogger("qldpc").warning("profiler not started: %s", e)
+    try:
+        yield
+    finally:
+        if started:
+            jax.profiler.stop_trace()
+
+
+def get_logger(name: str = "qldpc") -> logging.Logger:
+    """Framework logger; INFO to stderr unless the app configured logging."""
+    logger = logging.getLogger(name)
+    if not logger.handlers and not logging.getLogger().handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+    return logger
+
+
+def log_record(logger: logging.Logger, event: str, **fields) -> None:
+    """One structured (JSON) log line — grep/parse-friendly sweep records."""
+    logger.info("%s %s", event, json.dumps(fields, sort_keys=True, default=str))

@@ -63,3 +63,35 @@ def test_generic_sharded_failure_count():
     total = int(run(keys))
     assert 0 < total < 8 * 128
     np.testing.assert_allclose(total / (8 * 128), 0.25, atol=0.08)
+
+
+def test_process_grid_single_process_identity():
+    import numpy as np
+    from qldpc_fault_tolerance_tpu.parallel import (
+        merge_cell_results,
+        process_cell_owner,
+    )
+
+    owned = process_cell_owner(5)
+    assert owned.all()  # single-process: owns every cell
+    vals = np.array([1.0, 2.0, 3.0])
+    assert np.array_equal(merge_cell_results(vals), vals)
+
+
+def test_code_family_sharded_flag_single_process():
+    import numpy as np
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+
+    fam = CodeFamily(
+        [hgp(rep_code(3), rep_code(3))],
+        decoder1_class=BP_Decoder_Class(3, "minimum_sum", 0.625),
+        decoder2_class=BP_Decoder_Class(3, "minimum_sum", 0.625),
+        batch_size=64, seed=0,
+    )
+    a = fam.EvalWER("data", "Total", [0.03], 128, if_plot=False)
+    b = fam.EvalWER("data", "Total", [0.03], 128, if_plot=False,
+                    shard_across_processes=True)
+    assert a.shape == b.shape == (1, 1)
+    assert not np.isnan(b).any()

@@ -1,0 +1,70 @@
+"""Tests for observability and sweep checkpointing."""
+import os
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.utils import (
+    SweepCheckpoint,
+    reset_timings,
+    stage_timer,
+    timings,
+)
+
+
+def test_stage_timer_accumulates():
+    reset_timings()
+    with stage_timer("unit-test-stage"):
+        pass
+    with stage_timer("unit-test-stage"):
+        pass
+    t = timings()["unit-test-stage"]
+    assert t["count"] == 2
+    assert t["total_s"] >= 0
+    reset_timings()
+
+
+def test_sweep_checkpoint_roundtrip(tmp_path):
+    path = str(tmp_path / "ckpt.jsonl")
+    ck = SweepCheckpoint(path)
+    key = {"code": "x", "noise": "data", "p": 0.01, "cycles": 3, "samples": 10}
+    assert ck.get(key) is None
+    ck.put(key, {"wer": 0.125})
+    assert ck.get(key) == {"wer": 0.125}
+    # reload from disk
+    ck2 = SweepCheckpoint(path)
+    assert len(ck2) == 1
+    assert ck2.get(dict(key)) == {"wer": 0.125}
+    # float keys are canonicalized
+    key_float = dict(key, p=0.010000000000001)
+    assert ck2.get(key_float) == {"wer": 0.125}
+
+
+def test_code_family_resumes_from_checkpoint(tmp_path):
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class, BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+
+    code = hgp(rep_code(3), rep_code(3))
+    fam = CodeFamily(
+        [code],
+        decoder1_class=BP_Decoder_Class(3, "minimum_sum", 0.625),
+        decoder2_class=BPOSD_Decoder_Class(3, "minimum_sum", 0.625, "osd_e", 2),
+        batch_size=64, seed=0,
+    )
+    path = str(tmp_path / "sweep.jsonl")
+    ck = SweepCheckpoint(path)
+    wer1 = fam.EvalWER("data", "Total", [0.02, 0.05], 128, if_plot=False,
+                       checkpoint=ck)
+    assert len(ck) == 2
+    # rerun with a poisoned cell value: resumed sweep must read it back
+    # verbatim (proving the cells were skipped, not recomputed)
+    ck2 = SweepCheckpoint(path)
+    key = {"code": code.name or f"code0_N{code.N}K{code.K}",
+           "noise": "data", "type": "Total", "p": 0.02, "cycles": 1,
+           "samples": 128}
+    ck2.put(key, {"wer": 0.424242})
+    wer2 = fam.EvalWER("data", "Total", [0.02, 0.05], 128, if_plot=False,
+                       checkpoint=SweepCheckpoint(path))
+    assert wer2[0, 0] == 0.424242
+    assert wer2[0, 1] == wer1[0, 1]
