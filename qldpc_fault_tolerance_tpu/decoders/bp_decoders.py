@@ -55,6 +55,59 @@ def _norm_method(bp_method: str) -> str:
     return _BP_METHOD_ALIASES[str(bp_method).lower()]
 
 
+class FusedBPPair:
+    """Two independent plain-BP decodes fused into one kernel call.
+
+    Builds the block-diagonal Tanner graph of ``dec_a.h`` and ``dec_b.h`` and
+    decodes both syndromes in one ``bp_decode_two_phase`` invocation with
+    per-sector convergence/freeze (ops/bp.bp_decode ``sectors=``), so results
+    are bit-identical to running the two decoders separately while paying the
+    iteration-loop and straggler-tail costs once.  Used by the simulators to
+    fuse their X-/Z-sector decodes (the reference runs two sequential native
+    decoders per shot, src/Simulators.py:129-133).
+    """
+
+    @staticmethod
+    def compatible(dec_a, dec_b) -> bool:
+        return (
+            type(dec_a) is BPDecoder and type(dec_b) is BPDecoder
+            and dec_a.max_iter == dec_b.max_iter
+            and dec_a.bp_method == dec_b.bp_method
+            and dec_a.ms_scaling_factor == dec_b.ms_scaling_factor
+            and dec_a.two_phase and dec_b.two_phase
+        )
+
+    def __init__(self, dec_a, dec_b):
+        ha, hb = dec_a._h01, dec_b._h01
+        (ma, na), (mb, nb) = ha.shape, hb.shape
+        h = np.zeros((ma + mb, na + nb), dtype=np.uint8)
+        h[:ma, :na] = ha
+        h[ma:, na:] = hb
+        self.graph = bp.build_tanner_graph(h)
+        self.sectors = ((ma, mb), (na, nb))
+        self._split = na
+        self.llr0 = jnp.concatenate([dec_a.llr0, dec_b.llr0])
+        self.max_iter = dec_a.max_iter
+        self.bp_method = dec_a.bp_method
+        self.ms_scaling_factor = dec_a.ms_scaling_factor
+
+    def decode_pair_device(self, synd_a, synd_b):
+        """(B, ma), (B, mb) -> corrections (B, na), (B, nb)."""
+        synd = jnp.concatenate(
+            [jnp.asarray(synd_a), jnp.asarray(synd_b)], axis=-1
+        )
+        res = bp.bp_decode_two_phase(
+            self.graph,
+            synd,
+            self.llr0,
+            max_iter=self.max_iter,
+            method=self.bp_method,
+            ms_scaling_factor=self.ms_scaling_factor,
+            sectors=self.sectors,
+        )
+        return res.error[:, : self._split], res.error[:, self._split:]
+
+
 class BPDecoder:
     """Plain BP decoder (reference BPDecoder, src/Decoders.py:77-90)."""
 

@@ -9,13 +9,16 @@ convergence flags and posterior LLRs (the soft input OSD needs).
 Design (TPU-first, not a translation):
   * The Tanner graph is compiled once per H into padded adjacency arrays:
     check->neighbor and variable->neighbor index maps with cross slot maps, so
-    one BP iteration is 2 dense gathers + rowwise reductions over (batch, m,
-    max_row_w) / (batch, n, max_col_w) arrays.  Row weights of the codes_lib
-    matrices are <=~12, so padding waste is bounded.
-  * The whole shot batch lives in one kernel invocation (leading batch axis),
-    iterations run in a ``lax.while_loop`` that exits when every shot in the
-    batch has matched its syndrome (or max_iter is reached); converged shots
-    freeze so results equal ldpc's return-on-convergence semantics.
+    one BP iteration is 2 leading-axis gathers + small-axis reductions.
+  * **Batch-last layout**: all loop state is (m, rw, B) / (n, cw, B) / (n, B)
+    with the shot batch on the minor (lane) axis.  The padded degrees rw/cw
+    are ~4-12 — putting them minor would waste 120+ of the 128 vector lanes
+    per tile; batch-minor keeps every lane busy and turns the edge gathers
+    into contiguous row gathers (measured ~5x over batch-major on v5e).
+  * The whole shot batch lives in one kernel invocation, iterations run in a
+    ``lax.while_loop`` that exits when every shot in the batch has matched
+    its syndrome (or max_iter is reached); converged shots freeze so results
+    equal ldpc's return-on-convergence semantics.
   * Messages are float32 (bf16 loses too much for near-threshold LLRs).
 """
 from __future__ import annotations
@@ -26,8 +29,6 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-from .linalg import gf2_matmul
 
 __all__ = [
     "TannerGraph",
@@ -53,7 +54,7 @@ class TannerGraph(NamedTuple):
     var_nbr_slot: jnp.ndarray     # (n, cw) int32: slot of this edge in the check's list
     chk_mask: jnp.ndarray         # (m, rw) bool
     var_mask: jnp.ndarray         # (n, cw) bool
-    h_t: jnp.ndarray              # (n, m) uint8 — transpose kept for syndrome products
+    h_t: jnp.ndarray              # (n, m) uint8 — transpose kept for host-side uses
 
 
 def build_tanner_graph(h: np.ndarray) -> TannerGraph:
@@ -110,24 +111,39 @@ def llr_from_probs(channel_probs) -> jnp.ndarray:
 
 
 def _check_update_minsum(v2c, synd_sign, graph, scale):
-    """Scaled min-sum check-node update with self-exclusion via top-2 mins."""
-    mask = graph.chk_mask
+    """Scaled min-sum check-node update with self-exclusion via top-2 mins.
+
+    v2c: (m, rw, B); synd_sign: (m, B).  Returns (m, rw, B).
+    """
+    mask = graph.chk_mask[..., None]
     mag = jnp.where(mask, jnp.abs(v2c), _BIG)
     sgn = jnp.where(mask & (v2c < 0), -1.0, 1.0)
 
     # exclusion products: total sign / self sign  (signs are +-1)
-    total_sign = jnp.prod(sgn, axis=-1, keepdims=True) * synd_sign[..., None]
+    total_sign = jnp.prod(sgn, axis=1, keepdims=True) * synd_sign[:, None, :]
     excl_sign = total_sign * sgn
 
     # exclusion min via smallest + second-smallest
-    min1 = jnp.min(mag, axis=-1, keepdims=True)
-    amin = jnp.argmin(mag, axis=-1)
-    is_min = jax.nn.one_hot(amin, mag.shape[-1], dtype=bool)
-    min2 = jnp.min(jnp.where(is_min, _BIG, mag), axis=-1, keepdims=True)
+    min1 = jnp.min(mag, axis=1, keepdims=True)
+    amin = jnp.argmin(mag, axis=1)                              # (m, B)
+    rw = mag.shape[1]
+    is_min = jnp.arange(rw, dtype=amin.dtype)[None, :, None] == amin[:, None, :]
+    min2 = jnp.min(jnp.where(is_min, _BIG, mag), axis=1, keepdims=True)
     excl_min = jnp.where(is_min, min2, min1)
     excl_min = jnp.minimum(excl_min, _BIG)
 
     return jnp.where(mask, scale * excl_sign * excl_min, 0.0)
+
+
+def _check_update_prodsum(v2c, synd_sign, graph, scale):
+    """Product-sum (tanh rule) update in a numerically-guarded form."""
+    del scale
+    mask = graph.chk_mask[..., None]
+    t = jnp.where(mask, jnp.tanh(jnp.clip(v2c, -30.0, 30.0) / 2.0), 1.0)
+    t = jnp.where(jnp.abs(t) < 1e-12, jnp.where(t < 0, -1e-12, 1e-12), t)
+    total = jnp.prod(t, axis=1, keepdims=True) * synd_sign[:, None, :]
+    excl = jnp.clip(total / t, -0.9999999, 0.9999999)
+    return jnp.where(mask, 2.0 * jnp.arctanh(excl), 0.0)
 
 
 def _varying_zeros(ref, shape, dtype):
@@ -140,19 +156,18 @@ def _varying_zeros(ref, shape, dtype):
     return jnp.zeros(shape, dtype) + (tag.astype(jnp.int32) * 0).astype(dtype)
 
 
-def _check_update_prodsum(v2c, synd_sign, graph, scale):
-    """Product-sum (tanh rule) update in a numerically-guarded form."""
-    del scale
-    mask = graph.chk_mask
-    t = jnp.where(mask, jnp.tanh(jnp.clip(v2c, -30.0, 30.0) / 2.0), 1.0)
-    t = jnp.where(jnp.abs(t) < 1e-12, jnp.where(t < 0, -1e-12, 1e-12), t)
-    total = jnp.prod(t, axis=-1, keepdims=True) * synd_sign[..., None]
-    excl = jnp.clip(total / t, -0.9999999, 0.9999999)
-    return jnp.where(mask, 2.0 * jnp.arctanh(excl), 0.0)
+def _edge_parity_bl(err, graph):
+    """Syndrome of a hard decision, batch-last: err (n, B) -> (m, B) uint8."""
+    bits = err[graph.chk_nbr]                                  # (m, rw, B)
+    s = jnp.sum(
+        jnp.where(graph.chk_mask[..., None], bits, 0), axis=1, dtype=jnp.uint8
+    )
+    return s & jnp.uint8(1)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("max_iter", "method", "early_stop")
+    jax.jit,
+    static_argnames=("max_iter", "method", "early_stop", "sectors"),
 )
 def bp_decode(
     graph: TannerGraph,
@@ -163,55 +178,84 @@ def bp_decode(
     method: str = "minimum_sum",
     ms_scaling_factor=0.625,
     early_stop: bool = True,
+    sectors: tuple | None = None,
 ) -> BPResult:
     """Decode a batch of syndromes against one Tanner graph.
 
     syndromes: (B, m) {0,1}; channel_llr: (n,) or (B, n) float32.
     max_iter follows the reference convention of being precomputed by the
     decoder factories (num_qubits/max_iter_ratio, src/Decoders.py:123).
+
+    ``sectors=((m0, m1, ...), (n0, n1, ...))`` marks the graph as a block
+    diagonal of independent sub-decodes (check/var counts per block, in
+    order).  Messages never cross blocks, so running them in one kernel is
+    exactly ldpc running each block's decoder separately — convergence is
+    tracked and outputs freeze **per sector**, preserving each sub-decoder's
+    return-on-convergence semantics while sharing one iteration loop (this
+    is how the simulators fuse their X- and Z-sector decodes).
+    ``converged``/``iterations`` report the AND / max across sectors.
+
+    The public interface is batch-major; internally everything runs
+    batch-last (see module docstring) with cheap transposes at the boundary.
     """
     syndromes = jnp.asarray(syndromes)
     if syndromes.ndim == 1:
         syndromes = syndromes[None]
     b = syndromes.shape[0]
     n = graph.var_nbr.shape[0]
+    m = graph.chk_nbr.shape[0]
+    if sectors is None:
+        sectors = ((m,), (n,))
+    chk_sizes, var_sizes = sectors
+    assert sum(chk_sizes) == m and sum(var_sizes) == n
+    n_sec = len(chk_sizes)
+    chk_off = np.concatenate([[0], np.cumsum(chk_sizes)]).astype(int)
+    var_off = np.concatenate([[0], np.cumsum(var_sizes)]).astype(int)
+
     llr0 = jnp.broadcast_to(jnp.asarray(channel_llr, jnp.float32), (b, n))
-    synd_sign = (1.0 - 2.0 * syndromes.astype(jnp.float32))  # (B, m)
+    llr0_bl = llr0.T                                            # (n, B)
+    synd_bl = syndromes.T                                       # (m, B)
+    synd_sign = 1.0 - 2.0 * synd_bl.astype(jnp.float32)
     scale = jnp.asarray(ms_scaling_factor, jnp.float32)
 
     update = {"minimum_sum": _check_update_minsum, "product_sum": _check_update_prodsum}[
         method
     ]
 
-    def gather_chk_to_var(c2v_chk):
-        # (B, m, rw) -> (B, n, cw): value of edge (var j, slot t) lives at
-        # (check var_nbr[j,t], slot var_nbr_slot[j,t])
-        return c2v_chk[:, graph.var_nbr, graph.var_nbr_slot]
+    def one_iteration(v2c):
+        c2v_chk = update(v2c, synd_sign, graph, scale)          # (m, rw, B)
+        c2v_var = c2v_chk[graph.var_nbr, graph.var_nbr_slot]    # (n, cw, B)
+        c2v_var = jnp.where(graph.var_mask[..., None], c2v_var, 0.0)
+        total = llr0_bl + jnp.sum(c2v_var, axis=1)              # (n, B)
+        v2c_var = total[:, None, :] - c2v_var                   # self-exclusion
+        return v2c_var[graph.chk_nbr, graph.chk_nbr_slot], total
 
-    def gather_var_to_chk(v2c_var):
-        return v2c_var[:, graph.chk_nbr, graph.chk_nbr_slot]
+    def sector_matches(ok):
+        """ok: (m, B) bool per-check match -> (n_sec, B) per-sector all."""
+        return jnp.stack(
+            [jnp.all(ok[chk_off[s]:chk_off[s + 1]], axis=0) for s in range(n_sec)]
+        )
 
-    def one_iteration(v2c_chk):
-        c2v_chk = update(v2c_chk, synd_sign, graph, scale)
-        c2v_var = gather_chk_to_var(c2v_chk)
-        c2v_var = jnp.where(graph.var_mask, c2v_var, 0.0)
-        total = llr0 + jnp.sum(c2v_var, axis=-1)           # (B, n) posterior
-        v2c_var = total[..., None] - c2v_var               # self-exclusion
-        return gather_var_to_chk(v2c_var), total
-
-    def hard_decision(total):
-        return (total < 0).astype(jnp.uint8)
+    def expand_to_vars(done_sec):
+        """(n_sec, B) -> (n, B) per-variable freeze mask."""
+        return jnp.concatenate(
+            [
+                jnp.broadcast_to(done_sec[s][None], (int(var_sizes[s]), b))
+                for s in range(n_sec)
+            ]
+        )
 
     # carry inits derive a zero from the (possibly mesh-sharded) syndromes so
     # their varying-axis tags match the body outputs under shard_map
-    zf = _varying_zeros(syndromes, (b, 1), jnp.float32)
+    zf = _varying_zeros(syndromes, (1, b), jnp.float32)
     init = dict(
         it=jnp.zeros((), jnp.int32),
-        v2c=llr0[:, graph.chk_nbr] + zf[..., None],        # init messages = channel LLRs
-        err=_varying_zeros(syndromes, (b, n), jnp.uint8),
-        llr=llr0 + zf,
-        done=_varying_zeros(syndromes, (b,), jnp.bool_),
-        iters=jnp.full((b,), max_iter, jnp.int32) + _varying_zeros(syndromes, (b,), jnp.int32),
+        v2c=llr0_bl[graph.chk_nbr] + zf[None],                  # (m, rw, B)
+        err=_varying_zeros(syndromes, (n, b), jnp.uint8),
+        llr=llr0_bl + zf,
+        done=_varying_zeros(syndromes, (n_sec, b), jnp.bool_),
+        iters=jnp.full((n_sec, b), max_iter, jnp.int32)
+        + _varying_zeros(syndromes, (n_sec, b), jnp.int32),
     )
 
     def cond(carry):
@@ -220,14 +264,19 @@ def bp_decode(
 
     def body(carry):
         v2c_new, total = one_iteration(carry["v2c"])
-        err_new = hard_decision(total)
-        match = jnp.all(gf2_matmul(err_new, graph.h_t) == syndromes, axis=-1)
+        err_new = (total < 0).astype(jnp.uint8)                 # (n, B)
+        ok = _edge_parity_bl(err_new, graph) == synd_bl         # (m, B)
+        match = sector_matches(ok)                              # (n_sec, B)
         done_prev = carry["done"]
         newly = match & ~done_prev
-        keep = done_prev[:, None]
+        keep = expand_to_vars(done_prev)                        # (n, B)
+        # outputs (err/llr/iters) freeze at first convergence — ldpc
+        # return-on-convergence semantics; the messages themselves keep
+        # updating (their values no longer reach any output), which saves
+        # a (m, rw, B) select + rewrite per iteration
         return dict(
             it=carry["it"] + 1,
-            v2c=jnp.where(keep[..., None], carry["v2c"], v2c_new),
+            v2c=v2c_new,
             err=jnp.where(keep, carry["err"], err_new),
             llr=jnp.where(keep, carry["llr"], total),
             done=done_prev | match,
@@ -236,16 +285,18 @@ def bp_decode(
 
     out = jax.lax.while_loop(cond, body, init)
     return BPResult(
-        error=out["err"],
-        converged=out["done"],
-        posterior_llr=out["llr"],
-        iterations=out["iters"],
+        error=out["err"].T,
+        converged=jnp.all(out["done"], axis=0),
+        posterior_llr=out["llr"].T,
+        iterations=jnp.max(out["iters"], axis=0),
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_iter", "method", "head_iters", "tail_capacity"),
+    static_argnames=(
+        "max_iter", "method", "head_iters", "tail_capacity", "sectors"
+    ),
 )
 def bp_decode_two_phase(
     graph: TannerGraph,
@@ -257,6 +308,7 @@ def bp_decode_two_phase(
     ms_scaling_factor=0.625,
     head_iters: int = 3,
     tail_capacity: int | None = None,
+    sectors: tuple | None = None,
 ) -> BPResult:
     """Straggler-compacted BP: run ``head_iters`` for the whole batch, then
     decode only the unconverged shots (gathered into a fixed-capacity
@@ -285,13 +337,13 @@ def bp_decode_two_phase(
     if head_iters >= max_iter or tail_capacity >= b:
         return bp_decode(
             graph, syndromes, channel_llr, max_iter=max_iter, method=method,
-            ms_scaling_factor=ms_scaling_factor,
+            ms_scaling_factor=ms_scaling_factor, sectors=sectors,
         )
     llr0 = jnp.broadcast_to(jnp.asarray(channel_llr, jnp.float32), (b, n))
 
     head = bp_decode(
         graph, syndromes, channel_llr, max_iter=head_iters, method=method,
-        ms_scaling_factor=ms_scaling_factor,
+        ms_scaling_factor=ms_scaling_factor, sectors=sectors,
     )
     bad = ~head.converged
     n_bad = bad.sum(dtype=jnp.int32)
@@ -299,31 +351,36 @@ def bp_decode_two_phase(
     def full(_):
         return bp_decode(
             graph, syndromes, channel_llr, max_iter=max_iter, method=method,
-            ms_scaling_factor=ms_scaling_factor,
+            ms_scaling_factor=ms_scaling_factor, sectors=sectors,
         )
 
     def compacted(_):
-        idx = jnp.nonzero(bad, size=tail_capacity, fill_value=0)[0]
-        valid = bad[idx]
+        # pad the gather with an out-of-range sentinel (b): padded rows read
+        # a zero scratch syndrome (row b of the extended arrays) and their
+        # scatters land in a scratch row sliced off below — no duplicate
+        # writes to real shots, so nothing depends on scatter ordering
+        idx = jnp.nonzero(bad, size=tail_capacity, fill_value=b)[0]
+        synd_ext = jnp.concatenate(
+            [syndromes, jnp.zeros((1,) + syndromes.shape[1:], syndromes.dtype)]
+        )
+        llr_ext = jnp.concatenate([llr0, llr0[:1]])
         tail = bp_decode(
-            graph, syndromes[idx], llr0[idx], max_iter=max_iter,
+            graph, synd_ext[idx], llr_ext[idx], max_iter=max_iter,
             method=method, ms_scaling_factor=ms_scaling_factor,
+            sectors=sectors,
         )
-        upd = valid[:, None]
-        error = head.error.at[idx].set(
-            jnp.where(upd, tail.error, head.error[idx])
+
+        def merge(head_arr, tail_arr):
+            scratch = jnp.zeros((1,) + head_arr.shape[1:], head_arr.dtype)
+            ext = jnp.concatenate([head_arr, scratch])
+            return ext.at[idx].set(tail_arr)[:b]
+
+        return BPResult(
+            error=merge(head.error, tail.error),
+            converged=merge(head.converged, tail.converged),
+            posterior_llr=merge(head.posterior_llr, tail.posterior_llr),
+            iterations=merge(head.iterations, tail.iterations),
         )
-        llr = head.posterior_llr.at[idx].set(
-            jnp.where(upd, tail.posterior_llr, head.posterior_llr[idx])
-        )
-        conv = head.converged.at[idx].set(
-            jnp.where(valid, tail.converged, head.converged[idx])
-        )
-        iters = head.iterations.at[idx].set(
-            jnp.where(valid, tail.iterations, head.iterations[idx])
-        )
-        return BPResult(error=error, converged=conv, posterior_llr=llr,
-                        iterations=iters)
 
     return jax.lax.cond(n_bad > tail_capacity, full, compacted, operand=None)
 
@@ -342,7 +399,8 @@ def first_min_bp_decode(
     messages, accumulating the correction while the syndrome weight is
     non-increasing, for at most ``max_restarts`` accepted restarts.
 
-    Batched as a ``lax.scan`` over restart steps with a per-shot active mask.
+    Batched as a ``lax.scan`` over restart steps with a per-shot active mask,
+    batch-last like ``bp_decode``.
     Returns (correction (B,n) uint8, final syndrome weight (B,) int32).
     """
     syndromes = jnp.asarray(syndromes)
@@ -351,32 +409,37 @@ def first_min_bp_decode(
     b = syndromes.shape[0]
     n = graph.var_nbr.shape[0]
     llr0 = jnp.broadcast_to(jnp.asarray(channel_llr, jnp.float32), (b, n))
+    llr0_bl = llr0.T
     scale = jnp.asarray(ms_scaling_factor, jnp.float32)
+    v2c0 = llr0_bl[graph.chk_nbr]                               # (m, rw, B)
 
-    def one_iter_decode(synd):
-        synd_sign = 1.0 - 2.0 * synd.astype(jnp.float32)
-        v2c = llr0[:, graph.chk_nbr]
-        c2v_chk = _check_update_minsum(v2c, synd_sign, graph, scale)
-        c2v_var = jnp.where(graph.var_mask, c2v_chk[:, graph.var_nbr, graph.var_nbr_slot], 0.0)
-        total = llr0 + jnp.sum(c2v_var, axis=-1)
-        return (total < 0).astype(jnp.uint8)
+    def one_iter_decode(synd_bl):
+        synd_sign = 1.0 - 2.0 * synd_bl.astype(jnp.float32)
+        c2v_chk = _check_update_minsum(v2c0, synd_sign, graph, scale)
+        c2v_var = jnp.where(
+            graph.var_mask[..., None],
+            c2v_chk[graph.var_nbr, graph.var_nbr_slot],
+            0.0,
+        )
+        total = llr0_bl + jnp.sum(c2v_var, axis=1)
+        return (total < 0).astype(jnp.uint8)                    # (n, B)
 
     def step(carry, _):
         cur_synd, corr, active = carry
         err = one_iter_decode(cur_synd)
-        new_synd = gf2_matmul(err, graph.h_t) ^ cur_synd
+        new_synd = _edge_parity_bl(err, graph) ^ cur_synd
         accept = active & (
-            jnp.sum(new_synd, axis=-1).astype(jnp.int32)
-            <= jnp.sum(cur_synd, axis=-1).astype(jnp.int32)
+            jnp.sum(new_synd, axis=0).astype(jnp.int32)
+            <= jnp.sum(cur_synd, axis=0).astype(jnp.int32)
         )
-        corr = jnp.where(accept[:, None], corr ^ err, corr)
-        cur_synd = jnp.where(accept[:, None], new_synd, cur_synd)
+        corr = jnp.where(accept[None, :], corr ^ err, corr)
+        cur_synd = jnp.where(accept[None, :], new_synd, cur_synd)
         return (cur_synd, corr, accept), None
 
     init = (
-        syndromes.astype(jnp.uint8),
-        _varying_zeros(syndromes, (b, n), jnp.uint8),
+        syndromes.T.astype(jnp.uint8),
+        _varying_zeros(syndromes, (n, b), jnp.uint8),
         ~_varying_zeros(syndromes, (b,), jnp.bool_),
     )
     (final_synd, corr, _), _ = jax.lax.scan(step, init, None, length=max_restarts)
-    return corr, jnp.sum(final_synd, axis=-1).astype(jnp.int32)
+    return corr.T, jnp.sum(final_synd, axis=0).astype(jnp.int32)

@@ -19,10 +19,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..noise import depolarizing_xz
-from ..ops.linalg import gf2_matmul
+from ..ops.linalg import ParityOp, gf2_matmul
 from .common import (
     ShotBatcher,
-    accumulate_device,
     wer_single_shot,
     windowed_count,
 )
@@ -39,7 +38,8 @@ class CodeSimulator_DataError:
 
     def __init__(self, code=None, decoder_x=None, decoder_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), eval_logical_type="Total",
-                 seed: int = 0, batch_size: int = 2048, mesh=None):
+                 seed: int = 0, batch_size: int = 2048, mesh=None,
+                 fuse_sectors: bool = False):
         assert eval_logical_type in ["X", "Z", "Total"]
         self.code = code
         self.decoder_z, self.decoder_x = decoder_z, decoder_x
@@ -52,35 +52,56 @@ class CodeSimulator_DataError:
         self._base_key = jax.random.PRNGKey(seed)
         self._mesh = mesh
 
-        self._hx_t = jnp.asarray(code.hx.T)
-        self._hz_t = jnp.asarray(code.hz.T)
+        # syndromes / residual stabilizer checks as sparse parity gathers
+        # (row weight <= ~12 for codes_lib matrices — far cheaper than the
+        # dense f32 matmul); logical checks stay matmuls (K columns, tiny)
+        self._hx_par = ParityOp(code.hx)
+        self._hz_par = ParityOp(code.hz)
         self._lx_t = jnp.asarray(code.lx.T)
         self._lz_t = jnp.asarray(code.lz.T)
         self._needs_host = (
             decoder_x.needs_host_postprocess or decoder_z.needs_host_postprocess
         )
+        # Optionally fuse the two sector decodes into one kernel call when
+        # both are plain BP with identical settings (bit-identical results,
+        # one iteration loop / straggler tail instead of two).  Off by
+        # default: measured slower under XLA on v5e — the padded-adjacency
+        # gathers scale superlinearly with graph size, so one double-size
+        # decode loses to two single-size ones.  Kept for kernel backends
+        # where the fixed costs dominate.
+        self._fused = None
+        if fuse_sectors:
+            from ..decoders.bp_decoders import FusedBPPair
+
+            if FusedBPPair.compatible(decoder_x, decoder_z):
+                self._fused = FusedBPPair(decoder_x, decoder_z)
 
     # ------------------------------------------------------------------
     # device stages
     # ------------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
-    def _sample_and_bp(self, key, batch_size: int):
+    def _sample_and_bp_impl(self, key, batch_size: int):
         probs = tuple(self.channel_probs)
         error_x, error_z = depolarizing_xz(key, (batch_size, self.N), probs)
-        synd_z = gf2_matmul(error_z, self._hx_t)   # src/Simulators.py:127
-        synd_x = gf2_matmul(error_x, self._hz_t)   # src/Simulators.py:131
+        synd_z = self._hx_par(error_z)             # src/Simulators.py:127
+        synd_x = self._hz_par(error_x)             # src/Simulators.py:131
+        if self._fused is not None:
+            cor_x, cor_z = self._fused.decode_pair_device(synd_x, synd_z)
+            return error_x, error_z, synd_x, synd_z, cor_x, cor_z, {}, {}
         cor_z, aux_z = self.decoder_z.decode_batch_device(synd_z)
         cor_x, aux_x = self.decoder_x.decode_batch_device(synd_x)
         return error_x, error_z, synd_x, synd_z, cor_x, cor_z, aux_x, aux_z
 
-    @functools.partial(jax.jit, static_argnames=("self",))
-    def _check_failures(self, error_x, error_z, cor_x, cor_z):
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _sample_and_bp(self, key, batch_size: int):
+        return self._sample_and_bp_impl(key, batch_size)
+
+    def _check_failures_impl(self, error_x, error_z, cor_x, cor_z):
         """Residual stabilizer/logical checks (src/Simulators.py:135-168)."""
         residual_x = error_x ^ cor_x
         residual_z = error_z ^ cor_z
-        x_stab = gf2_matmul(residual_x, self._hz_t).any(axis=-1)
+        x_stab = self._hz_par(residual_x).any(axis=-1)
         x_log = gf2_matmul(residual_x, self._lz_t).any(axis=-1)
-        z_stab = gf2_matmul(residual_z, self._hx_t).any(axis=-1)
+        z_stab = self._hx_par(residual_z).any(axis=-1)
         z_log = gf2_matmul(residual_z, self._lx_t).any(axis=-1)
         x_failure = x_stab | x_log
         z_failure = z_stab | z_log
@@ -94,6 +115,10 @@ class CodeSimulator_DataError:
         wx = jnp.where(x_log, residual_x.sum(axis=-1), self.N)
         wz = jnp.where(z_log, residual_z.sum(axis=-1), self.N)
         return fail, jnp.minimum(wx.min(), wz.min())
+
+    @functools.partial(jax.jit, static_argnames=("self",))
+    def _check_failures(self, error_x, error_z, cor_x, cor_z):
+        return self._check_failures_impl(error_x, error_z, cor_x, cor_z)
 
     # ------------------------------------------------------------------
     def device_failures(self, key, batch_size: int):
@@ -109,9 +134,47 @@ class CodeSimulator_DataError:
         No host transfer — callers accumulate these device scalars across
         batches and read back once per sweep (the tunneled TPU pays ~100ms
         latency per device->host transfer; per-batch syncs would dominate)."""
-        ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp(key, batch_size)
-        fail, min_w = self._check_failures(ex, ez, cx, cz)
+        ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp_impl(key, batch_size)
+        fail, min_w = self._check_failures_impl(ex, ez, cx, cz)
         return fail.sum(dtype=jnp.int32), min_w
+
+    # batches per compiled scan dispatch: large enough that the ~40ms
+    # per-dispatch tunnel overhead is amortized, small enough that short
+    # sweeps don't overshoot their shot budget by much
+    _SCAN_CHUNK = 8
+
+    @functools.partial(
+        jax.jit, static_argnames=("self", "batch_size", "chunk")
+    )
+    def _chunk_stats(self, key, offset, batch_size: int, chunk: int):
+        """``chunk`` batches as one dispatch: ``lax.scan`` over batch index,
+        failure count and min logical weight accumulated on device.  The
+        batch offset is a traced argument so every chunk of a run (and every
+        run) reuses one compilation."""
+
+        def body(carry, j):
+            k = jax.random.fold_in(key, offset + j)
+            ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp_impl(k, batch_size)
+            fail, min_w = self._check_failures_impl(ex, ez, cx, cz)
+            cnt, mw = carry
+            return (cnt + fail.sum(dtype=jnp.int32), jnp.minimum(mw, min_w)), ()
+
+        init = (jnp.zeros((), jnp.int32), jnp.asarray(self.N, jnp.int32))
+        (cnt, mw), _ = jax.lax.scan(body, init, jnp.arange(chunk))
+        return cnt, mw
+
+    def _device_run_stats(self, key, batch_size: int, n_batches: int):
+        """Run ``n_batches`` batches in fixed-size scan chunks; device scalars
+        accumulate across the (async) chunk dispatches.  Returns device
+        scalars — the caller's materialization is the only host sync."""
+        chunk = min(n_batches, self._SCAN_CHUNK)
+        cnt, mw = 0, jnp.asarray(self.N, jnp.int32)
+        for start in range(0, n_batches, chunk):
+            c, w = self._chunk_stats(
+                key, jnp.asarray(start, jnp.int32), batch_size, chunk
+            )
+            cnt, mw = cnt + c, jnp.minimum(mw, w)
+        return cnt, mw
 
     def _sharded_runner(self):
         from ..parallel import sharded_failure_count
@@ -170,17 +233,19 @@ class CodeSimulator_DataError:
                 error_count += int(run(keys))
             return wer_single_shot(error_count, batcher.total, self.K)
         batcher = ShotBatcher(num_run, self.batch_size)
-        keys = [jax.random.fold_in(key, i) for i in batcher]
         if not self._needs_host:
-            # all-device accumulation: every batch dispatch is async, the
-            # single materialization at the end is the only host sync
-            total, min_w = accumulate_device(
-                lambda k: self._device_batch_stats(k, self.batch_size),
-                keys,
-                lambda a, b: (a[0] + b[0], jnp.minimum(a[1], b[1])),
+            # scan-chunked dispatches, one host sync; chunks run whole, so
+            # the denominator rounds up to the chunk multiple actually run
+            chunk = min(batcher.num_batches, self._SCAN_CHUNK)
+            n_batches = -(-batcher.num_batches // chunk) * chunk
+            total, min_w = self._device_run_stats(
+                key, self.batch_size, n_batches
             )
             self.min_logical_weight = min(self.min_logical_weight, int(min_w))
-            return wer_single_shot(int(total), batcher.total, self.K)
+            return wer_single_shot(
+                int(total), n_batches * self.batch_size, self.K
+            )
+        keys = [jax.random.fold_in(key, i) for i in batcher]
         # host-postprocess (OSD) path: bounded in-flight window so device
         # compute overlaps the host transfers
         error_count = windowed_count(

@@ -125,3 +125,31 @@ def test_factory_st_quirk_psynd_from_pdata():
     n, m = 5, 4
     np.testing.assert_allclose(probs[:n], 0.03)
     np.testing.assert_allclose(probs[n:n + m], 0.03)  # NOT 0.9
+
+
+def test_fused_pair_matches_separate_decodes():
+    """FusedBPPair (block-diagonal sectors=) must be bit-identical to the two
+    separate BPDecoder runs (per-sector freeze preserves each sub-decoder's
+    return-on-convergence semantics)."""
+    import jax
+
+    code = hgp(rep_code(4), rep_code(5))
+    dec_x = BPDecoder(code.hz, np.full(code.N, 0.06), max_iter=40)
+    dec_z = BPDecoder(code.hx, np.full(code.N, 0.06), max_iter=40)
+    from qldpc_fault_tolerance_tpu.decoders.bp_decoders import FusedBPPair
+
+    assert FusedBPPair.compatible(dec_x, dec_z)
+    fused = FusedBPPair(dec_x, dec_z)
+
+    key = jax.random.PRNGKey(7)
+    from qldpc_fault_tolerance_tpu.noise import depolarizing_xz
+    from qldpc_fault_tolerance_tpu.ops.linalg import ParityOp
+
+    ex, ez = depolarizing_xz(key, (96, code.N), (0.02, 0.02, 0.02))
+    sx = ParityOp(code.hz)(ex)
+    sz = ParityOp(code.hx)(ez)
+    cx_f, cz_f = fused.decode_pair_device(sx, sz)
+    cx, _ = dec_x.decode_batch_device(sx)
+    cz, _ = dec_z.decode_batch_device(sz)
+    np.testing.assert_array_equal(np.asarray(cx_f), np.asarray(cx))
+    np.testing.assert_array_equal(np.asarray(cz_f), np.asarray(cz))
