@@ -39,7 +39,8 @@ def main():
 
     code = _bench_code()
     p = 0.01
-    batch = int(os.environ.get("BENCH_BATCH", "8192"))
+    batch = int(os.environ.get("BENCH_BATCH", "16384"))
+    n_batches = int(os.environ.get("BENCH_BATCHES", "128"))
     dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=50)
     dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=50)
     sim = CodeSimulator_DataError(
@@ -49,18 +50,15 @@ def main():
         pauli_error_probs=[p / 3, p / 3, p / 3],
         batch_size=batch,
         seed=0,
+        # the whole timed run is one scan dispatch + one host sync (the
+        # tunneled chip pays ~50-100ms per dispatch/fetch round-trip)
+        scan_chunk=n_batches,
     )
 
     key = jax.random.PRNGKey(123)
-    # warmup / compile (one full scan chunk, same compiled shape as the
-    # timed run)
-    sim.WordErrorRate(8 * batch, key=jax.random.fold_in(key, 0))
-    # timed steady state: device-side failure accumulation, one host sync
-    # per run; median of 3 runs for a stable number
-    n_batches = int(os.environ.get("BENCH_BATCHES", "32"))
-    # WordErrorRate runs whole scan chunks — count the shots it actually runs
-    chunk = CodeSimulator_DataError._SCAN_CHUNK
-    n_batches = -(-n_batches // chunk) * chunk
+    # warmup / compile (same compiled scan shape as the timed run)
+    sim.WordErrorRate(n_batches * batch, key=jax.random.fold_in(key, 0))
+    # timed steady state; median of 3 runs for a stable number
     shots = n_batches * batch
     times = []
     for rep in range(3):

@@ -14,8 +14,10 @@ on top of the TPU BP kernel:
 """
 from __future__ import annotations
 
+import os
 from abc import ABC, abstractmethod
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -128,6 +130,24 @@ class BPDecoder:
         # results, ~max_iter/head_iters less HBM traffic at low p
         self.two_phase = bool(two_phase)
         self.llr0 = bp.llr_from_probs(self.channel_probs)
+        # VMEM-resident Pallas head (ops/bp_pallas): ~10x head throughput on
+        # TPU; stragglers still go through the exact f32 XLA tail.  Gated on
+        # backend, method, and the incidence stack fitting VMEM.
+        self._pallas_head = None
+        if (
+            self.bp_method == "minimum_sum"
+            and os.environ.get("QLDPC_PALLAS", "1") != "0"
+        ):
+            try:
+                on_tpu = jax.default_backend() == "tpu"
+            except Exception:
+                on_tpu = False
+            if on_tpu:
+                from ..ops.bp_pallas import build_pallas_head
+
+                pg = build_pallas_head(self.graph)
+                if pg.fits_vmem():
+                    self._pallas_head = pg
 
     needs_host_postprocess = False
 
@@ -151,6 +171,7 @@ class BPDecoder:
                 max_iter=self.max_iter,
                 method=self.bp_method,
                 ms_scaling_factor=self.ms_scaling_factor,
+                pallas_head=self._pallas_head,
             )
         return bp.bp_decode(
             self.graph,

@@ -295,7 +295,8 @@ def bp_decode(
 @functools.partial(
     jax.jit,
     static_argnames=(
-        "max_iter", "method", "head_iters", "tail_capacity", "sectors"
+        "max_iter", "method", "head_iters", "tail_capacity", "sectors",
+        "pallas_block", "ms_scaling_factor",
     ),
 )
 def bp_decode_two_phase(
@@ -309,6 +310,8 @@ def bp_decode_two_phase(
     head_iters: int = 3,
     tail_capacity: int | None = None,
     sectors: tuple | None = None,
+    pallas_head=None,
+    pallas_block: int = 256,
 ) -> BPResult:
     """Straggler-compacted BP: run ``head_iters`` for the whole batch, then
     decode only the unconverged shots (gathered into a fixed-capacity
@@ -341,10 +344,27 @@ def bp_decode_two_phase(
         )
     llr0 = jnp.broadcast_to(jnp.asarray(channel_llr, jnp.float32), (b, n))
 
-    head = bp_decode(
-        graph, syndromes, channel_llr, max_iter=head_iters, method=method,
-        ms_scaling_factor=ms_scaling_factor, sectors=sectors,
+    # Head and tail run in the VMEM-resident Pallas kernel when the caller
+    # provides its compiled incidence stack (decoders build it once per H).
+    use_pallas = (
+        pallas_head is not None
+        and sectors is None
+        and method == "minimum_sum"
+        and b % pallas_block == 0
+        and np.ndim(channel_llr) == 1
     )
+    if use_pallas:
+        from .bp_pallas import bp_head_pallas
+
+        head = bp_head_pallas(
+            pallas_head, syndromes, channel_llr, head_iters=head_iters,
+            ms_scaling_factor=float(ms_scaling_factor), block_b=pallas_block,
+        )
+    else:
+        head = bp_decode(
+            graph, syndromes, channel_llr, max_iter=head_iters, method=method,
+            ms_scaling_factor=ms_scaling_factor, sectors=sectors,
+        )
     bad = ~head.converged
     n_bad = bad.sum(dtype=jnp.int32)
 
@@ -364,11 +384,25 @@ def bp_decode_two_phase(
             [syndromes, jnp.zeros((1,) + syndromes.shape[1:], syndromes.dtype)]
         )
         llr_ext = jnp.concatenate([llr0, llr0[:1]])
-        tail = bp_decode(
-            graph, synd_ext[idx], llr_ext[idx], max_iter=max_iter,
-            method=method, ms_scaling_factor=ms_scaling_factor,
-            sectors=sectors,
-        )
+        if use_pallas:
+            # tail in the same VMEM-resident kernel, as one wide tile with
+            # early exit (the XLA while-loop pays ~0.15ms of sequential
+            # latency per iteration at straggler batch sizes)
+            from .bp_pallas import bp_head_pallas
+
+            tail = bp_head_pallas(
+                pallas_head, synd_ext[idx],
+                jnp.asarray(channel_llr, jnp.float32),
+                head_iters=max_iter,
+                ms_scaling_factor=float(ms_scaling_factor),
+                block_b=min(tail_capacity, 512), early_stop=True,
+            )
+        else:
+            tail = bp_decode(
+                graph, synd_ext[idx], llr_ext[idx], max_iter=max_iter,
+                method=method, ms_scaling_factor=ms_scaling_factor,
+                sectors=sectors,
+            )
 
         def merge(head_arr, tail_arr):
             scratch = jnp.zeros((1,) + head_arr.shape[1:], head_arr.dtype)

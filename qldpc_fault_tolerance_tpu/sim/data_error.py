@@ -39,7 +39,7 @@ class CodeSimulator_DataError:
     def __init__(self, code=None, decoder_x=None, decoder_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), eval_logical_type="Total",
                  seed: int = 0, batch_size: int = 2048, mesh=None,
-                 fuse_sectors: bool = False):
+                 fuse_sectors: bool = False, scan_chunk: int = 8):
         assert eval_logical_type in ["X", "Z", "Total"]
         self.code = code
         self.decoder_z, self.decoder_x = decoder_z, decoder_x
@@ -49,6 +49,7 @@ class CodeSimulator_DataError:
         self.eval_logical_type = eval_logical_type
         self.min_logical_weight = self.N
         self.batch_size = int(batch_size)
+        self._scan_chunk = max(1, int(scan_chunk))
         self._base_key = jax.random.PRNGKey(seed)
         self._mesh = mesh
 
@@ -138,9 +139,11 @@ class CodeSimulator_DataError:
         fail, min_w = self._check_failures_impl(ex, ez, cx, cz)
         return fail.sum(dtype=jnp.int32), min_w
 
-    # batches per compiled scan dispatch: large enough that the ~40ms
-    # per-dispatch tunnel overhead is amortized, small enough that short
-    # sweeps don't overshoot their shot budget by much
+    # default batches per compiled scan dispatch (``scan_chunk`` ctor arg):
+    # large enough that the ~40-60ms per-dispatch tunnel overhead is
+    # amortized, small enough that short sweeps don't overshoot their shot
+    # budget by much; throughput-critical callers (bench) raise it so the
+    # whole run is one dispatch
     _SCAN_CHUNK = 8
 
     @functools.partial(
@@ -167,7 +170,7 @@ class CodeSimulator_DataError:
         """Run ``n_batches`` batches in fixed-size scan chunks; device scalars
         accumulate across the (async) chunk dispatches.  Returns device
         scalars — the caller's materialization is the only host sync."""
-        chunk = min(n_batches, self._SCAN_CHUNK)
+        chunk = min(n_batches, self._scan_chunk)
         cnt, mw = 0, jnp.asarray(self.N, jnp.int32)
         for start in range(0, n_batches, chunk):
             c, w = self._chunk_stats(
@@ -236,7 +239,7 @@ class CodeSimulator_DataError:
         if not self._needs_host:
             # scan-chunked dispatches, one host sync; chunks run whole, so
             # the denominator rounds up to the chunk multiple actually run
-            chunk = min(batcher.num_batches, self._SCAN_CHUNK)
+            chunk = min(batcher.num_batches, self._scan_chunk)
             n_batches = -(-batcher.num_batches // chunk) * chunk
             total, min_w = self._device_run_stats(
                 key, self.batch_size, n_batches
