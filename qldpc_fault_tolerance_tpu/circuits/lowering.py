@@ -170,7 +170,8 @@ def _fuse(ops: list[Op]) -> list[Op]:
 
 
 def _lower_instruction(ins: Instruction, rec_base: int):
-    """Lower one IR instruction to zero or one proto-op.  rec_base is the
+    """Lower one IR instruction to zero, one, or a list of proto-ops.
+    rec_base is the
     measurement count before this instruction (for record columns relative to
     the enclosing segment)."""
     name = ins.name
@@ -182,7 +183,18 @@ def _lower_instruction(ins: Instruction, rec_base: int):
     if name == "H":
         return Op("h", q)
     if name in ("CX", "CZ"):
-        return Op(name.lower(), q[0::2], q[1::2])
+        a, b = q[0::2], q[1::2]
+        if set(a.tolist()) & set(b.tolist()):
+            # Chained pairs sharing a qubit across sides ('CX 0 1 1 2'):
+            # stim applies the pairs left to right, so a later pair must see
+            # the frame already updated by an earlier one.  A single fused
+            # scatter op would read pre-update values — split into
+            # sequential per-pair ops (_fuse re-merges only the safe ones).
+            return [
+                Op(name.lower(), a[i : i + 1], b[i : i + 1])
+                for i in range(len(a))
+            ]
+        return Op(name.lower(), a, b)
     if name in ("M", "MR", "MX"):
         rec = np.arange(rec_base, rec_base + len(q), dtype=np.int32)
         return Op(
@@ -245,7 +257,7 @@ def compile_circuit(circuit: Circuit) -> CompiledCircuit:
                     1 for t in ins.targets if not isinstance(t, RecTarget)
                 )
             if op is not None:
-                ops_out.append(op)
+                ops_out.extend(op) if isinstance(op, list) else ops_out.append(op)
 
     pending: list[Op] = []
     pending_rec_offset = 0

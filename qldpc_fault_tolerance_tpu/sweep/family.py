@@ -138,6 +138,17 @@ class CodeFamily:
         from ..parallel.grid import merge_cell_results, process_cell_owner
         from ..utils.observability import get_logger, log_record, stage_timer
 
+        if noise_model == "circuit" and eval_logical_type == "X" and len(eval_p_list) > 1:
+            import warnings
+
+            warnings.warn(
+                "eval_logical_type='X' swaps hx<->hz in place on the shared "
+                "code object (reference quirk, src/Simulators.py:390-402); "
+                "across multiple p-points the cells alternate between X- and "
+                "Z-type logicals.  Use 'Total' (symmetric) or one p per call.",
+                stacklevel=2,
+            )
+
         logger = get_logger()
         cells = [
             (ci, code, eval_p)

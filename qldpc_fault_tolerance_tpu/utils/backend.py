@@ -1,0 +1,46 @@
+"""Backend plumbing for virtual-device testing.
+
+The test suite and the driver's multichip dryrun validate sharding logic on a
+virtual CPU mesh (``--xla_force_host_platform_device_count``).  Forcing the
+platform after another backend initialized (the image's sitecustomize eagerly
+registers the single-chip TPU plugin) requires tearing down the initialized
+backends — a private JAX API that moves across releases, so it is isolated
+here behind a version guard instead of being reached into at every call site.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_virtual_cpu"]
+
+
+def force_virtual_cpu(n_devices: int) -> bool:
+    """Point JAX at the host CPU platform with ``n_devices`` virtual XLA
+    devices.  Returns True if the platform is (now) CPU with enough devices.
+
+    Safe to call multiple times.  Works from any JAX state when the private
+    backend-teardown hook exists; otherwise only guaranteed before first
+    backend use (set JAX_PLATFORMS=cpu in the environment for that case).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:  # private API: present in jax 0.4-0.8, guarded for future releases
+        import jax._src.xla_bridge as _xb
+
+        if getattr(_xb, "_backends", None):
+            _xb._clear_backends()
+    except Exception:  # pragma: no cover - backend may already be clean
+        pass
+    try:
+        devs = jax.devices()
+    except Exception:  # pragma: no cover
+        return False
+    return devs[0].platform == "cpu" and len(devs) >= n_devices

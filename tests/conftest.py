@@ -9,23 +9,12 @@ platform through jax.config and drop any already-initialized backends.
 import os
 import sys
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-try:
-    import jax._src.xla_bridge as _xb
-
-    _xb._clear_backends()
-except Exception:  # pragma: no cover - best effort; env may already be clean
-    pass
-
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
+
+from qldpc_fault_tolerance_tpu.utils.backend import force_virtual_cpu  # noqa: E402
+
+force_virtual_cpu(8)
 
 REFERENCE_CODES_LIB = "/root/reference/codes_lib"

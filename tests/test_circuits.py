@@ -192,6 +192,26 @@ def test_sampler_depolarize2_propagation():
     assert abs(rate - 8 / 15) < 4 * np.sqrt((8 / 15) * (7 / 15) / n)
 
 
+def test_sampler_chained_cx_sequential_semantics():
+    """'CX 0 1 1 2' (one instruction, qubit 1 on both sides) must apply the
+    pairs sequentially like stim: an X on qubit 0 propagates 0 -> 1 -> 2.  A
+    simultaneous scatter would read qubit 1's pre-update frame and leave
+    qubit 2 unflipped."""
+    c = Circuit()
+    c.append("R", [0, 1, 2])
+    c.append("X_ERROR", [0], 1.0)
+    c.append("CX", [0, 1, 1, 2])
+    c.append("M", [0, 1, 2])
+    for k in (-3, -2, -1):
+        c.append("DETECTOR", [target_rec(k)])
+    s = FrameSampler(c)
+    dets, _ = s.sample(jax.random.PRNGKey(0), 4)
+    assert np.asarray(dets).all()
+    # the DEM propagator shares the lowering, so its fault must hit all three
+    dem = str(detector_error_model(c))
+    assert "D0 D1 D2" in dem
+
+
 # -------------------------------------------------------------------- DEM
 def test_dem_single_fault():
     c = _rep3_two_rounds(0.125)
