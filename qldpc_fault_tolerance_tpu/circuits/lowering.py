@@ -184,12 +184,14 @@ def _lower_instruction(ins: Instruction, rec_base: int):
         return Op("h", q)
     if name in ("CX", "CZ"):
         a, b = q[0::2], q[1::2]
-        if set(a.tolist()) & set(b.tolist()):
+        if name == "CX" and set(a.tolist()) & set(b.tolist()):
             # Chained pairs sharing a qubit across sides ('CX 0 1 1 2'):
             # stim applies the pairs left to right, so a later pair must see
             # the frame already updated by an earlier one.  A single fused
             # scatter op would read pre-update values — split into
             # sequential per-pair ops (_fuse re-merges only the safe ones).
+            # CZ needs no split: it only reads x-frames and writes z-frames,
+            # so the fused add-scatter is order-independent.
             return [
                 Op(name.lower(), a[i : i + 1], b[i : i + 1])
                 for i in range(len(a))

@@ -138,14 +138,17 @@ class CodeFamily:
         from ..parallel.grid import merge_cell_results, process_cell_owner
         from ..utils.observability import get_logger, log_record, stage_timer
 
-        if noise_model == "circuit" and eval_logical_type == "X" and len(eval_p_list) > 1:
+        if noise_model == "circuit" and eval_logical_type == "X":
             import warnings
 
             warnings.warn(
                 "eval_logical_type='X' swaps hx<->hz in place on the shared "
-                "code object (reference quirk, src/Simulators.py:390-402); "
-                "across multiple p-points the cells alternate between X- and "
-                "Z-type logicals.  Use 'Total' (symmetric) or one p per call.",
+                "code object (reference quirk, src/Simulators.py:390-402) and "
+                "the swap persists after the run: every successive 'X' "
+                "construction on the same code object — later p-points in "
+                "this call, or later EvalWER calls — alternates between X- "
+                "and Z-type logicals.  Use 'Total' (symmetric) for multi-cell "
+                "sweeps.",
                 stacklevel=2,
             )
 

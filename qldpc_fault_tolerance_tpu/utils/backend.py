@@ -22,8 +22,18 @@ def force_virtual_cpu(n_devices: int) -> bool:
     backend-teardown hook exists; otherwise only guaranteed before first
     backend use (set JAX_PLATFORMS=cpu in the environment for that case).
     """
+    import re
+
     flags = os.environ.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
+    if "xla_force_host_platform_device_count" in flags:
+        # an earlier/ambient setting may carry a smaller count — replace it
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            f"--xla_force_host_platform_device_count={n_devices}",
+            flags,
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
         os.environ["XLA_FLAGS"] = (
             flags + f" --xla_force_host_platform_device_count={n_devices}"
         ).strip()

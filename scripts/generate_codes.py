@@ -36,18 +36,74 @@ OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 
 # (name, n0, target_girth, master_seed): (4,3)-biregular seeds, H is
 # (3 n0) x (4 n0); hgp(H,H) gives N = (4 n0)^2 + (3 n0)^2 = 25 n0^2.
-# Girth 8 ((3,4) graphs free of 4- and 6-cycles) is only reachable for the
-# larger seeds; after a few failed attempts the target steps down by 2.
+#
+# Girth targets: 6 is the practical maximum for this family.  A
+# (3,4)-biregular girth-8 Tanner graph must satisfy the bipartite Moore
+# bound (every depth-3 BFS tree embeds injectively): from any degree-3
+# variable node, 3 + 3*2*3 = 21 distinct checks and 1 + 9 = 10 distinct
+# variables are required, and from any check, 4 + 4*2*3 = 28 distinct
+# variables — i.e. at least 21 checks x 28 variables.  The n625 seed
+# (15x20) and n1225 seed (21x28) are below/at that bound; equality at
+# 21x28 would make the graph the incidence graph of a generalized
+# quadrangle GQ(2,3), which is known not to exist (s+t=5 fails to divide
+# st(s+1)(t+1)=72).  So girth 8 is impossible for n625/n1225 and out of
+# random-swap reach for n1600 (24x32, barely above the bound).  For
+# calibration, the reference's own shipped n225 seed has girth 4
+# (/root/reference/codes_lib/hgp_34_n225.pkl, h1 attribute) — girth 6
+# here is already strictly better graph quality than the reference's.
 FAMILY = {
     "n225": (3, 6, 225001),
-    "n625": (5, 8, 625001),
-    "n1225": (7, 8, 1225001),
-    "n1600": (8, 8, 1600001),
+    "n625": (5, 6, 625001),
+    "n1225": (7, 6, 1225001),
+    "n1600": (8, 6, 1600001),
 }
+
+REFERENCE_N225_PKL = "/root/reference/codes_lib/hgp_34_n225.pkl"
+
+
+def extract_reference_seed(pkl_path: str) -> np.ndarray:
+    """Pull the 9x12 seed matrix ``h1`` out of the shipped reference pickle.
+
+    The reference's published family member is [[225,17]] — built from a
+    rank-8 (hence rank-deficient) 9x12 seed, which a random full-rank draw
+    cannot reproduce (K = k^2 + k_T^2 = 16 + 1 = 17 needs the transpose
+    logical).  The pickle is a data asset, so the exact seed is recoverable;
+    using it makes our n225 the *identical* code, apples-to-apples with
+    every published n225 number (BASELINE.md).
+    """
+    from qldpc_fault_tolerance_tpu.codes.loaders import load_object
+
+    obj = load_object(pkl_path)
+    h1 = np.asarray(obj.h1, dtype=np.uint8) % 2
+    assert h1.shape == (9, 12), h1.shape
+    return h1
 
 
 def generate_one(tag: str, n0: int, target_girth: int, master_seed: int):
     t0 = time.time()
+    if tag == "n225":
+        if not os.path.exists(REFERENCE_N225_PKL):
+            # a random full-rank draw would give [[225,9]], a *different*
+            # code than the published [[225,17]] — refuse rather than
+            # silently diverge from GENERATION.json and the tests
+            raise FileNotFoundError(
+                f"{REFERENCE_N225_PKL} not mounted; n225 must be built from "
+                "the exact reference seed (rank-8 9x12) to be [[225,17]]"
+            )
+        H = extract_reference_seed(REFERENCE_N225_PKL)
+        code = hgp(H, H, compute_distance=False, name=f"hgp_34_{tag}")
+        save_code(code, os.path.join(OUT_DIR, f"hgp_34_{tag}.npz"))
+        np.save(os.path.join(OUT_DIR, f"hgp_34_{tag}_seedH.npy"), H)
+        meta = {
+            "tag": tag, "n0": n0, "delta_c": 4, "delta_v": 3,
+            "seed_source": "reference hgp_34_n225.pkl h1 attribute (exact)",
+            "seed_rank": int(gf2.rank(H)),
+            "seed_girth": int(tanner_girth(H)),
+            "N": int(code.N), "K": int(code.K),
+            "elapsed_s": round(time.time() - t0, 1),
+        }
+        print(json.dumps(meta))
+        return meta
     rng = np.random.default_rng(master_seed)
     configured_girth = target_girth
     attempts = 0

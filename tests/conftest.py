@@ -15,6 +15,9 @@ if REPO_ROOT not in sys.path:
 
 from qldpc_fault_tolerance_tpu.utils.backend import force_virtual_cpu  # noqa: E402
 
-force_virtual_cpu(8)
+assert force_virtual_cpu(8), (
+    "could not force an 8-device virtual CPU mesh — sharding tests would "
+    "run degenerate; check JAX private-API drift in utils/backend.py"
+)
 
 REFERENCE_CODES_LIB = "/root/reference/codes_lib"

@@ -127,3 +127,37 @@ def test_save_load_roundtrip(tmp_path):
 def test_css_rejects_invalid():
     with pytest.raises(ValueError):
         CssCode(hx=np.array([[1, 1, 0]]), hz=np.array([[1, 0, 0]]))
+
+
+REPO_CODES_LIB = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                              "codes_lib_tpu")
+
+
+@pytest.mark.skipif(
+    not os.path.exists(os.path.join(REPO_CODES_LIB, "hgp_34_n225.npz")),
+    reason="regenerated family not present",
+)
+def test_family_matches_published_parameters():
+    """The regenerated hgp_34 family must carry the published dimensions
+    ([[225,17]]/[[625,25]]/[[1225,49]]/[[1600,64]], BASELINE.md)."""
+    expected = {"n225": (225, 17), "n625": (625, 25),
+                "n1225": (1225, 49), "n1600": (1600, 64)}
+    for tag, (n, k) in expected.items():
+        code = load_code(os.path.join(REPO_CODES_LIB, f"hgp_34_{tag}.npz"))
+        assert (code.N, code.K) == (n, k), tag
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(os.path.join(REPO_CODES_LIB, "hgp_34_n225.npz"))
+         and os.path.exists(os.path.join(REFERENCE_CODES_LIB, "hgp_34_n225.pkl"))),
+    reason="needs both regenerated npz and reference pickle",
+)
+def test_family_n225_is_exact_reference_code():
+    """n225 is built from the seed extracted out of the reference pickle, so
+    hx/hz must be bit-identical and the logicals span-equivalent."""
+    ours = load_code(os.path.join(REPO_CODES_LIB, "hgp_34_n225.npz"))
+    ref = load_pickle_code(os.path.join(REFERENCE_CODES_LIB, "hgp_34_n225.pkl"))
+    assert np.array_equal(ours.hx, ref.hx)
+    assert np.array_equal(ours.hz, ref.hz)
+    both = np.vstack([ours.lx, ref.lx, ours.hx])
+    assert gf2.rank(both) == gf2.rank(np.vstack([ours.lx, ours.hx]))
