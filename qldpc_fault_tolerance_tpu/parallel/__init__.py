@@ -1,9 +1,14 @@
 from .grid import merge_cell_results, process_cell_owner
-from .shots import SHOT_AXIS, sharded_failure_count, shot_mesh, split_keys_for_mesh
+from .shots import (
+    SHOT_AXIS,
+    sharded_batch_stats,
+    shot_mesh,
+    split_keys_for_mesh,
+)
 
 __all__ = [
     "SHOT_AXIS",
-    "sharded_failure_count",
+    "sharded_batch_stats",
     "shot_mesh",
     "split_keys_for_mesh",
     "process_cell_owner",

@@ -14,11 +14,14 @@ from __future__ import annotations
 import functools
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-__all__ = ["shot_mesh", "sharded_failure_count", "split_keys_for_mesh"]
+__all__ = [
+    "shot_mesh",
+    "sharded_batch_stats",
+    "split_keys_for_mesh",
+]
 
 SHOT_AXIS = "shots"
 
@@ -35,24 +38,34 @@ def split_keys_for_mesh(key, mesh: Mesh):
     return jax.random.split(key, n)
 
 
-def sharded_failure_count(device_fn, mesh: Mesh, per_device_batch: int):
-    """Build a jitted function (keys (n_dev,) -> total failures scalar).
+def sharded_batch_stats(stats_fn, mesh: Mesh):
+    """Build a jitted function (keys (n_dev,) -> (count, min_weight) scalars).
 
-    ``device_fn(key, batch_size) -> (B,) bool/int failure flags`` must be pure
-    device code (no host callbacks).  Each mesh device runs its own batch from
-    its own key; counts are psum-reduced over ICI.
+    ``stats_fn(key) -> (int32 failure count, int32 min logical weight)`` runs
+    one per-device batch of pure device code (no host callbacks).  This is
+    the mesh unit shared by every MC engine: the count psum-reduces and the
+    diagnostic min-logical-weight pmin-reduces over ICI — the only
+    cross-device traffic is these two scalars.
     """
 
+    # check_vma=False: engine internals scan with replicated zero-init
+    # carries that become shot-varying after the first step; the varying-
+    # manual-axes checker rejects that even though the program is correct.
+    # Engines stay mesh-agnostic; correctness is pinned by the exact
+    # sharded-vs-replay equality tests (tests/test_parallel.py).
     @jax.jit
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(SHOT_AXIS),),
-        out_specs=P(),
+        out_specs=(P(), P()),
+        check_vma=False,
     )
     def run(keys):
-        fail = device_fn(keys[0], per_device_batch)
-        local = jnp.sum(fail.astype(jnp.int32))
-        return jax.lax.psum(local, SHOT_AXIS)
+        count, min_w = stats_fn(keys[0])
+        return (
+            jax.lax.psum(count, SHOT_AXIS),
+            jax.lax.pmin(min_w, SHOT_AXIS),
+        )
 
     return run

@@ -26,7 +26,13 @@ import numpy as np
 from ..circuits import AddCXError, Circuit, ColorationCircuit, FrameSampler, \
     RandomCircuit, target_rec
 from ..ops.linalg import gf2_matmul
-from .common import ShotBatcher, accumulate_counts, wer_per_cycle, windowed_count
+from .common import (
+    ShotBatcher,
+    accumulate_counts,
+    mesh_batch_stats,
+    wer_per_cycle,
+    windowed_count,
+)
 
 __all__ = ["CodeSimulator_Circuit", "build_memory_circuit"]
 
@@ -196,7 +202,7 @@ class CodeSimulator_Circuit:
                  decoder2_z=None, decoder2_x=None, p=0, num_cycles=1,
                  error_params=None, eval_logical_type="Z",
                  circuit_type="coloration", rand_scheduling_seed=0,
-                 seed: int = 0, batch_size: int = 256):
+                 seed: int = 0, batch_size: int = 256, mesh=None):
         if eval_logical_type == "X":
             _swap_xz_inplace(code)
             decoder1_z = decoder1_x
@@ -216,6 +222,7 @@ class CodeSimulator_Circuit:
         self.error_params = error_params
         self.batch_size = int(batch_size)
         self._base_key = jax.random.PRNGKey(seed)
+        self._mesh = mesh
 
         if circuit_type == "random":
             self.scheduling_X = RandomCircuit(code.hx)
@@ -324,19 +331,37 @@ class CodeSimulator_Circuit:
             obs, correction, corrected_final, final_cor
         ).sum(dtype=jnp.int32)
 
+    def _device_batch_stats(self, key, batch_size: int):
+        """Mesh-shardable unit.  The reference tracks no min_logical_weight
+        in the circuit engine (the decode lives in detector space), so the
+        weight slot is the neutral element N."""
+        return (
+            self._device_batch_count(key, batch_size),
+            jnp.asarray(self.N, jnp.int32),
+        )
+
     def WordErrorRate(self, num_samples: int, key=None):
         """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
         self._ensure_circuit()
         self._assert_round_decoder_device()
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
-        batcher = ShotBatcher(num_samples, self.batch_size)
-        keys = [jax.random.fold_in(key, i) for i in batcher]
         if not self.decoder2_z.needs_host_postprocess:
+            if self._mesh is not None:
+                count, total, _ = mesh_batch_stats(
+                    self, ("circuit", self.batch_size),
+                    lambda k: self._device_batch_stats(k, self.batch_size),
+                    num_samples, key,
+                )
+                return wer_per_cycle(count, total, self.K, self.num_cycles)
+            batcher = ShotBatcher(num_samples, self.batch_size)
+            keys = [jax.random.fold_in(key, i) for i in batcher]
             count = accumulate_counts(
                 lambda k: self._device_batch_count(k, self.batch_size), keys
             )
             return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(
             lambda k: self._sample_and_decode_rounds(k, self.batch_size),
             self._finish_batch, keys,

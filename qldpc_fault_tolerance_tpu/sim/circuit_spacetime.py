@@ -34,7 +34,13 @@ from ..circuits import (
 )
 from ..ops.linalg import gf2_matmul
 from .circuit import _swap_xz_inplace, build_memory_circuit
-from .common import ShotBatcher, accumulate_counts, wer_per_cycle, windowed_count
+from .common import (
+    ShotBatcher,
+    accumulate_counts,
+    mesh_batch_stats,
+    wer_per_cycle,
+    windowed_count,
+)
 
 __all__ = ["CodeSimulator_Circuit_SpaceTime"]
 
@@ -50,7 +56,7 @@ class CodeSimulator_Circuit_SpaceTime:
                  decoder2_z=None, decoder2_x=None, p=0, num_cycles=1,
                  num_rep=1, error_params=None, eval_logical_type="Z",
                  circuit_type="coloration", rand_scheduling_seed=0,
-                 seed: int = 0, batch_size: int = 256):
+                 seed: int = 0, batch_size: int = 256, mesh=None):
         if eval_logical_type == "X":
             _swap_xz_inplace(code)
             decoder1_z = decoder1_x
@@ -75,6 +81,7 @@ class CodeSimulator_Circuit_SpaceTime:
         self.error_params = error_params
         self.batch_size = int(batch_size)
         self._base_key = jax.random.PRNGKey(seed)
+        self._mesh = mesh
 
         if circuit_type == "random":
             self.scheduling_X = RandomCircuit(code.hx)
@@ -220,19 +227,36 @@ class CodeSimulator_Circuit_SpaceTime:
             obs, total_log, final_syn, final_cor
         ).sum(dtype=jnp.int32)
 
+    def _device_batch_stats(self, key, batch_size: int):
+        """Mesh-shardable unit; the weight slot is the neutral element N
+        (the reference tracks no min_logical_weight in circuit engines)."""
+        return (
+            self._device_batch_count(key, batch_size),
+            jnp.asarray(self.N, jnp.int32),
+        )
+
     def WordErrorRate(self, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:1031-1049."""
         self._ensure_ready()
         self._assert_window_decoder_device()
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
-        batcher = ShotBatcher(num_samples, self.batch_size)
-        keys = [jax.random.fold_in(key, i) for i in batcher]
         if not self.decoder2_z.needs_host_postprocess:
+            if self._mesh is not None:
+                count, total, _ = mesh_batch_stats(
+                    self, ("circuit_st", self.batch_size),
+                    lambda k: self._device_batch_stats(k, self.batch_size),
+                    num_samples, key,
+                )
+                return wer_per_cycle(count, total, self.K, self.num_cycles)
+            batcher = ShotBatcher(num_samples, self.batch_size)
+            keys = [jax.random.fold_in(key, i) for i in batcher]
             count = accumulate_counts(
                 lambda k: self._device_batch_count(k, self.batch_size), keys
             )
             return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(
             lambda k: self._sample_and_decode_windows(k, self.batch_size),
             self._finish_batch, keys,

@@ -20,6 +20,7 @@ __all__ = [
     "accumulate_device",
     "accumulate_counts",
     "windowed_count",
+    "mesh_batch_stats",
 ]
 
 
@@ -55,6 +56,38 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     while window:
         count += int(np.asarray(finish(window.pop(0))).sum())
     return count
+
+
+def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key):
+    """Shot loop sharded over ``sim._mesh``: every mesh device runs
+    ``sim.batch_size``-shot batches of ``stats_fn(key) -> (count, min_w)``;
+    scalars reduce over ICI (parallel.sharded_batch_stats).
+
+    Compiled runners are cached on the simulator keyed by ``cache_key``
+    (anything static the closure bakes in: num_rounds, batch size, ...).
+    Dispatches are asynchronous; the two int() reads at the end are the only
+    host syncs.  Returns (failure_count, shots_run, min_logical_weight).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..parallel import sharded_batch_stats, split_keys_for_mesh
+
+    mesh = sim._mesh
+    runners = sim.__dict__.setdefault("_mesh_runners", {})
+    run = runners.get(cache_key)
+    if run is None:
+        run = runners[cache_key] = sharded_batch_stats(stats_fn, mesh)
+    n_dev = mesh.devices.size
+    batcher = ShotBatcher(num_samples, sim.batch_size * n_dev)
+    count, min_w = None, None
+    for i in batcher:
+        keys = split_keys_for_mesh(jax.random.fold_in(key, i), mesh)
+        c, w = run(keys)
+        count = c if count is None else count + c
+        min_w = w if min_w is None else jnp.minimum(min_w, w)
+    count, min_w = jax.device_get((count, min_w))  # one host round-trip
+    return int(count), batcher.total, int(min_w)
 
 
 def wer_single_shot(error_count: int, num_run: int, K: int):

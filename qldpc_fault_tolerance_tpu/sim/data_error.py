@@ -22,6 +22,7 @@ from ..noise import depolarizing_xz
 from ..ops.linalg import ParityOp, gf2_matmul
 from .common import (
     ShotBatcher,
+    mesh_batch_stats,
     wer_single_shot,
     windowed_count,
 )
@@ -122,13 +123,6 @@ class CodeSimulator_DataError:
         return self._check_failures_impl(error_x, error_z, cor_x, cor_z)
 
     # ------------------------------------------------------------------
-    def device_failures(self, key, batch_size: int):
-        """Pure-device per-shot failure flags — the unit that shards across a
-        mesh (only valid when no host OSD stage is required)."""
-        ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp(key, batch_size)
-        fail, _ = self._check_failures(ex, ez, cx, cz)
-        return fail
-
     @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _device_batch_stats(self, key, batch_size: int):
         """One batch fully on device: (failure count, min logical weight).
@@ -179,19 +173,6 @@ class CodeSimulator_DataError:
             cnt, mw = cnt + c, jnp.minimum(mw, w)
         return cnt, mw
 
-    def _sharded_runner(self):
-        from ..parallel import sharded_failure_count
-
-        if getattr(self, "_sharded", None) is None:
-            assert not self._needs_host, (
-                "mesh sharding requires pure-device decoders (plain BP); "
-                "BPOSD's host stage is per-chip only"
-            )
-            self._sharded = sharded_failure_count(
-                self.device_failures, self._mesh, self.batch_size
-            )
-        return self._sharded
-
     def _drain_batch(self, batch_out) -> np.ndarray:
         """Host-postprocess one _sample_and_bp output tuple and return the
         per-shot failure flags; updates min_logical_weight."""
@@ -225,16 +206,13 @@ class CodeSimulator_DataError:
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         if self._mesh is not None and not self._needs_host:
-            from ..parallel import split_keys_for_mesh
-
-            n_dev = self._mesh.devices.size
-            run = self._sharded_runner()
-            batcher = ShotBatcher(num_run, self.batch_size * n_dev)
-            error_count = 0
-            for i in batcher:
-                keys = split_keys_for_mesh(jax.random.fold_in(key, i), self._mesh)
-                error_count += int(run(keys))
-            return wer_single_shot(error_count, batcher.total, self.K)
+            count, total, min_w = mesh_batch_stats(
+                self, ("data", self.batch_size),
+                lambda k: self._device_batch_stats(k, self.batch_size),
+                num_run, key,
+            )
+            self.min_logical_weight = min(self.min_logical_weight, min_w)
+            return wer_single_shot(count, total, self.K)
         batcher = ShotBatcher(num_run, self.batch_size)
         if not self._needs_host:
             # scan-chunked dispatches, one host sync; chunks run whole, so

@@ -23,7 +23,13 @@ import numpy as np
 
 from ..noise import bit_flips, depolarizing_xz
 from ..ops.linalg import gf2_matmul
-from .common import ShotBatcher, accumulate_counts, wer_per_cycle, windowed_count
+from .common import (
+    ShotBatcher,
+    accumulate_device,
+    mesh_batch_stats,
+    wer_per_cycle,
+    windowed_count,
+)
 
 __all__ = ["CodeSimulator_Phenon_SpaceTime"]
 
@@ -33,7 +39,7 @@ class CodeSimulator_Phenon_SpaceTime:
                  decoder2_x=None, decoder2_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), q=0,
                  eval_logical_type="Total", num_rep: int = 1, seed: int = 0,
-                 batch_size: int = 512):
+                 batch_size: int = 512, mesh=None):
         assert eval_logical_type in ["X", "Z", "Total"]
         self.code = code
         self.hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
@@ -49,6 +55,7 @@ class CodeSimulator_Phenon_SpaceTime:
         self.min_logical_weight = self.N
         self.batch_size = int(batch_size)
         self._base_key = jax.random.PRNGKey(seed)
+        self._mesh = mesh
 
         self._mx = code.hx.shape[0]
         self._mz = code.hz.shape[0]
@@ -122,17 +129,26 @@ class CodeSimulator_Phenon_SpaceTime:
 
     @functools.partial(jax.jit, static_argnames=("self",))
     def _check_failures(self, cur_x, cur_z, dec_x, dec_z):
+        """Returns (per-shot failure flags, min residual logical weight).
+        Weight tracking mirrors the reference asymmetry
+        (src/Simulators_SpaceTime.py:499-517): X counted whenever the
+        logical check fires, Z only when the stabilizer check passed."""
         residual_x = cur_x ^ dec_x
         residual_z = cur_z ^ dec_z
-        x_fail = (gf2_matmul(residual_x, self._hz_t).any(axis=-1)
-                  | gf2_matmul(residual_x, self._lz_t).any(axis=-1))
-        z_fail = (gf2_matmul(residual_z, self._hx_t).any(axis=-1)
-                  | gf2_matmul(residual_z, self._lx_t).any(axis=-1))
+        x_stab = gf2_matmul(residual_x, self._hz_t).any(axis=-1)
+        x_log = gf2_matmul(residual_x, self._lz_t).any(axis=-1)
+        z_stab = gf2_matmul(residual_z, self._hx_t).any(axis=-1)
+        z_log = gf2_matmul(residual_z, self._lx_t).any(axis=-1)
+        x_fail = x_stab | x_log
+        z_fail = z_stab | z_log
+        wx = jnp.where(x_log, residual_x.sum(axis=-1), self.N)
+        wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1), self.N)
+        min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
         if self.eval_logical_type == "X":
-            return x_fail
+            return x_fail, min_w
         if self.eval_logical_type == "Z":
-            return z_fail
-        return x_fail | z_fail
+            return z_fail, min_w
+        return x_fail | z_fail, min_w
 
     # ------------------------------------------------------------------
     def _launch_batch(self, key, num_rounds: int, batch_size: int):
@@ -150,7 +166,9 @@ class CodeSimulator_Phenon_SpaceTime:
         if self.decoder2_z.needs_host_postprocess:
             dz = jnp.asarray(self.decoder2_z.host_postprocess(
                 np.asarray(sz), np.asarray(dz), jax.device_get(az)))
-        return self._check_failures(cur_x, cur_z, dx, dz)
+        fail, min_w = self._check_failures(cur_x, cur_z, dx, dz)
+        self.min_logical_weight = min(self.min_logical_weight, int(min_w))
+        return fail
 
     def _assert_window_decoders_device(self):
         assert not (self.decoder1_x.needs_host_postprocess
@@ -171,14 +189,16 @@ class CodeSimulator_Phenon_SpaceTime:
         return int(self.run_batch(sub, num_rounds, 1)[0])
 
     @functools.partial(jax.jit, static_argnames=("self", "num_rounds", "batch_size"))
-    def _device_batch_count(self, key, num_rounds: int, batch_size: int):
-        """Whole batch on device -> failure count scalar (no host sync)."""
+    def _device_batch_stats(self, key, num_rounds: int, batch_size: int):
+        """Whole batch on device -> (failure count, min weight) scalars (no
+        host sync) — the unit the mesh path shards (parallel/shots.py)."""
         k_rounds, k_final = jax.random.split(key)
         data_x, data_z = self._noisy_rounds_device(k_rounds, batch_size, num_rounds)
         cur_x, cur_z, _, _, dx, dz, _, _ = self._final_round(
             k_final, data_x, data_z, batch_size
         )
-        return self._check_failures(cur_x, cur_z, dx, dz).sum(dtype=jnp.int32)
+        fail, min_w = self._check_failures(cur_x, cur_z, dx, dz)
+        return fail.sum(dtype=jnp.int32), min_w
 
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into
@@ -188,16 +208,30 @@ class CodeSimulator_Phenon_SpaceTime:
         total_num_cycles = (num_rounds - 1) * self.num_rep + 1
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
-        batcher = ShotBatcher(num_samples, self.batch_size)
-        keys = [jax.random.fold_in(key, i) for i in batcher]
         dec2_host = (self.decoder2_x.needs_host_postprocess
                      or self.decoder2_z.needs_host_postprocess)
         if not dec2_host:
-            count = accumulate_counts(
-                lambda k: self._device_batch_count(k, num_rounds, self.batch_size),
+            if self._mesh is not None:
+                count, total, min_w = mesh_batch_stats(
+                    self, ("phenl_st", num_rounds, self.batch_size),
+                    lambda k: self._device_batch_stats(
+                        k, num_rounds, self.batch_size),
+                    num_samples, key,
+                )
+                self.min_logical_weight = min(self.min_logical_weight, min_w)
+                return wer_per_cycle(count, total, self.K, total_num_cycles)
+            batcher = ShotBatcher(num_samples, self.batch_size)
+            keys = [jax.random.fold_in(key, i) for i in batcher]
+            stats = accumulate_device(
+                lambda k: self._device_batch_stats(k, num_rounds, self.batch_size),
                 keys,
+                lambda a, b: (a[0] + b[0], jnp.minimum(a[1], b[1])),
             )
-            return wer_per_cycle(count, batcher.total, self.K, total_num_cycles)
+            self.min_logical_weight = min(self.min_logical_weight, int(stats[1]))
+            return wer_per_cycle(int(stats[0]), batcher.total, self.K,
+                                 total_num_cycles)
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(
             lambda k: self._launch_batch(k, num_rounds, self.batch_size),
             self._finish_batch, keys,

@@ -35,12 +35,13 @@ class CodeFamily:
 
     def __init__(self, code_list: list, decoder1_class: DecoderClass,
                  decoder2_class: DecoderClass, batch_size: int = 512,
-                 seed: int = 0):
+                 seed: int = 0, mesh=None):
         self.code_list = code_list
         self.decoder1_class = decoder1_class
         self.decoder2_class = decoder2_class
         self.batch_size = int(batch_size)
         self.seed = int(seed)
+        self.mesh = mesh  # chip mesh every simulator shards its shots over
 
     # ------------------------------------------------------------------
     def _data_wer(self, code, eval_p, eval_logical_type, num_samples):
@@ -52,7 +53,7 @@ class CodeFamily:
             code=code, decoder_x=decoder_x, decoder_z=decoder_z,
             pauli_error_probs=[p / 3, p / 3, p / 3],
             eval_logical_type=eval_logical_type,
-            batch_size=self.batch_size, seed=self.seed,
+            batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
         return sim.WordErrorRate(num_samples)[0]
 
@@ -73,7 +74,7 @@ class CodeFamily:
             decoder2_x=dec2_x, decoder2_z=dec2_z,
             pauli_error_probs=[p / 3, p / 3, p / 3], q=q,
             eval_logical_type=eval_logical_type,
-            batch_size=self.batch_size, seed=self.seed,
+            batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
         return sim.WordErrorRate(num_rounds=num_cycles, num_samples=num_samples)[0]
 
@@ -102,7 +103,7 @@ class CodeFamily:
                 num_cycles=num_cycles, error_params=error_params,
                 eval_logical_type=logical_type, circuit_type=circuit_type,
                 rand_scheduling_seed=1, batch_size=self.batch_size,
-                seed=self.seed,
+                seed=self.seed, mesh=self.mesh,
             )
             sim._generate_circuit()
             return sim.WordErrorRate(num_samples=num_samples)[0]

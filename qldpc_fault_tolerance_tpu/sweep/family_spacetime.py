@@ -30,24 +30,30 @@ __all__ = ["CodeFamily_SpaceTime"]
 class CodeFamily_SpaceTime:
     def __init__(self, code_list: list, decoder1_class: DecoderClass,
                  decoder2_class: DecoderClass, batch_size: int = 512,
-                 seed: int = 0):
+                 seed: int = 0, mesh=None):
         self.code_list = code_list
         self.decoder1_class = decoder1_class
         self.decoder2_class = decoder2_class
         self.batch_size = int(batch_size)
         self.seed = int(seed)
+        self.mesh = mesh  # chip mesh every simulator shards its shots over
 
     # ------------------------------------------------------------------
     def EvalWER(self, noise_model: str, eval_logical_type: str,
                 eval_p_list: list, num_samples: int, num_cycles=1, num_rep=1,
                 circuit_type="coloration", circuit_error_params=None,
                 if_plot=True, if_adaptive=False, adaptive_params=None,
-                checkpoint=None):
+                checkpoint=None, shard_across_processes: bool = False):
         """(ragged) per-code WER/p lists
         (src/Simulators_SpaceTime.py:1158-1307).
 
         ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
         cells are persisted as they complete and skipped on rerun.
+        ``shard_across_processes``: in a multi-host JAX program, each process
+        computes a round-robin subset of the (code, p) cells (the adaptive
+        pruning predicate is deterministic, so every process enumerates the
+        same cells); the scalar results merge over DCN at the end
+        (parallel/grid.py).
         """
         assert noise_model in ["data", "phenl", "circuit"], (
             "noise_model should be one of [data, phenl, circuit]"
@@ -55,51 +61,68 @@ class CodeFamily_SpaceTime:
         assert eval_logical_type in ["X", "Z", "Total"], (
             "eval_type should be one of [X, Y, Total]"
         )
+        from ..parallel.grid import merge_cell_results, process_cell_owner
         from ..utils.observability import get_logger, log_record, stage_timer
 
         logger = get_logger()
-        eval_wer_list = []
-        eval_p_adapt_list = []
 
-        for ci, code in enumerate(self.code_list):
+        # deterministic cell enumeration (same on every process)
+        per_code_p: list[list] = []
+        for code in self.code_list:
             if noise_model == "circuit" and if_adaptive:
                 WEREst = adaptive_params["WEREst"]
                 min_wer = adaptive_params["min_wer"]
-                p_list = [p for p in eval_p_list if WEREst(code.N, p) >= min_wer]
+                per_code_p.append(
+                    [p for p in eval_p_list if WEREst(code.N, p) >= min_wer])
             else:
-                p_list = list(eval_p_list)
+                per_code_p.append(list(eval_p_list))
+        cells = [
+            (ci, p) for ci, p_list in enumerate(per_code_p) for p in p_list
+        ]
+        owned = (
+            process_cell_owner(len(cells)) if shard_across_processes
+            else np.ones(len(cells), dtype=bool)
+        )
 
-            wer_per_code = []
-            for eval_p in p_list:
-                cell_key = {
-                    "code": code.name or f"code{ci}_N{code.N}K{code.K}",
-                    "noise": f"st-{noise_model}", "type": eval_logical_type,
-                    "p": float(eval_p), "cycles": int(num_cycles),
-                    "rep": int(num_rep), "samples": int(num_samples),
-                }
-                if checkpoint is not None and (rec := checkpoint.get(cell_key)):
-                    wer_per_code.append(rec["wer"])
-                    continue
-                with stage_timer(f"cell:st-{noise_model}"):
-                    if noise_model == "data":
-                        wer = self._data_wer(code, eval_p, eval_logical_type,
-                                             num_samples)
-                    elif noise_model == "phenl":
-                        wer = self._phenl_wer(code, eval_p, eval_logical_type,
-                                              num_samples, num_cycles, num_rep)
-                    else:
-                        wer = self._circuit_wer(
-                            code, eval_p, eval_logical_type, num_samples,
-                            num_cycles, num_rep, circuit_type,
-                            circuit_error_params,
-                        )
-                log_record(logger, "cell_done", **cell_key, wer=float(wer))
-                if checkpoint is not None:
-                    checkpoint.put(cell_key, {"wer": float(wer)})
-                wer_per_code.append(wer)
+        flat_wer = np.full(len(cells), np.nan)
+        for idx, (ci, eval_p) in enumerate(cells):
+            if not owned[idx]:
+                continue
+            code = self.code_list[ci]
+            cell_key = {
+                "code": code.name or f"code{ci}_N{code.N}K{code.K}",
+                "noise": f"st-{noise_model}", "type": eval_logical_type,
+                "p": float(eval_p), "cycles": int(num_cycles),
+                "rep": int(num_rep), "samples": int(num_samples),
+            }
+            if checkpoint is not None and (rec := checkpoint.get(cell_key)):
+                flat_wer[idx] = rec["wer"]
+                continue
+            with stage_timer(f"cell:st-{noise_model}"):
+                if noise_model == "data":
+                    wer = self._data_wer(code, eval_p, eval_logical_type,
+                                         num_samples)
+                elif noise_model == "phenl":
+                    wer = self._phenl_wer(code, eval_p, eval_logical_type,
+                                          num_samples, num_cycles, num_rep)
+                else:
+                    wer = self._circuit_wer(
+                        code, eval_p, eval_logical_type, num_samples,
+                        num_cycles, num_rep, circuit_type,
+                        circuit_error_params,
+                    )
+            log_record(logger, "cell_done", **cell_key, wer=float(wer))
+            if checkpoint is not None:
+                checkpoint.put(cell_key, {"wer": float(wer)})
+            flat_wer[idx] = wer
+        if shard_across_processes:
+            flat_wer = merge_cell_results(flat_wer)
+
+        eval_wer_list, eval_p_adapt_list, pos = [], [], 0
+        for p_list in per_code_p:
             eval_p_adapt_list.append(np.array(p_list))
-            eval_wer_list.append(np.array(wer_per_code))
-
+            eval_wer_list.append(flat_wer[pos: pos + len(p_list)])
+            pos += len(p_list)
         return eval_wer_list, eval_p_adapt_list
 
     # ------------------------------------------------------------------
@@ -120,7 +143,7 @@ class CodeFamily_SpaceTime:
             code=code, decoder_x=decoder_x, decoder_z=decoder_z,
             pauli_error_probs=[p / 3, p / 3, p / 3],
             eval_logical_type=eval_logical_type,
-            batch_size=self.batch_size, seed=self.seed,
+            batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
         return sim.WordErrorRate(num_samples)[0]
 
@@ -141,7 +164,7 @@ class CodeFamily_SpaceTime:
             decoder2_x=dec2_x, decoder2_z=dec2_z,
             pauli_error_probs=[p / 3, p / 3, p / 3], q=q,
             eval_logical_type=eval_logical_type, num_rep=num_rep,
-            batch_size=self.batch_size, seed=self.seed,
+            batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
         return sim.WordErrorRate(num_cycles=num_cycles, num_samples=num_samples)[0]
 
@@ -158,7 +181,7 @@ class CodeFamily_SpaceTime:
             code=code, p=p, num_cycles=num_cycles, num_rep=num_rep,
             error_params=error_params, eval_logical_type=eval_logical_type,
             circuit_type=circuit_type, rand_scheduling_seed=1,
-            batch_size=self.batch_size, seed=self.seed,
+            batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
         sim._generate_circuit()
         sim._generate_circuit_graph()

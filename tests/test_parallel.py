@@ -6,7 +6,7 @@ import jax
 from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
 from qldpc_fault_tolerance_tpu.decoders import BPDecoder
 from qldpc_fault_tolerance_tpu.parallel import (
-    sharded_failure_count,
+    sharded_batch_stats,
     shot_mesh,
     split_keys_for_mesh,
 )
@@ -34,35 +34,204 @@ def test_sharded_count_matches_per_device_runs():
     sim = _make_sim(mesh=mesh, batch_size=32)
     key = jax.random.PRNGKey(3)
     keys = split_keys_for_mesh(key, mesh)
-    total = int(sim._sharded_runner()(keys))
+    run = sharded_batch_stats(lambda k: sim._device_batch_stats(k, 32), mesh)
+    total, _ = (int(v) for v in run(keys))
     # reference computation: same per-device batches run unsharded
     expect = sum(int(sim.run_batch(k, 32).sum()) for k in keys)
     assert total == expect
 
 
-def test_mesh_wer_consistent_with_single_device():
+def _expected_mesh_wer(sim, stats_fn, num_samples, key, wer_fn):
+    """Replay the mesh path's exact shot stream unsharded: same per-device
+    keys, same batch stats function, summed/min-reduced on one device.
+    Returns (wer_result, min_logical_weight)."""
+    from qldpc_fault_tolerance_tpu.sim.common import ShotBatcher
+
     mesh = shot_mesh()
-    sim_mesh = _make_sim(mesh=mesh, batch_size=64, seed=7)
-    sim_one = _make_sim(mesh=None, batch_size=64, seed=7)
-    wer_m, _ = sim_mesh.WordErrorRate(512, key=jax.random.PRNGKey(11))
-    wer_s, _ = sim_one.WordErrorRate(512, key=jax.random.PRNGKey(11))
-    # different shot streams, same statistics: both in [0, 1] and same regime
-    assert 0 <= wer_m <= 1 and 0 <= wer_s <= 1
-    if wer_s > 0:
-        assert abs(wer_m - wer_s) < 10 * max(wer_s, 0.02)
+    batcher = ShotBatcher(num_samples, sim.batch_size * mesh.devices.size)
+    count, min_w = 0, sim.N
+    for i in batcher:
+        for k in split_keys_for_mesh(jax.random.fold_in(key, i), mesh):
+            c, w = stats_fn(k)
+            count += int(c)
+            min_w = min(min_w, int(w))
+    return wer_fn(count, batcher.total), min_w
 
 
-def test_generic_sharded_failure_count():
+def test_mesh_wer_equals_unsharded_replay_data_engine():
+    from qldpc_fault_tolerance_tpu.sim.common import wer_single_shot
+
+    mesh = shot_mesh()
+    sim = _make_sim(mesh=mesh, batch_size=64, seed=7)
+    key = jax.random.PRNGKey(11)
+    wer_m, _ = sim.WordErrorRate(512, key=key)
+    sim_ref = _make_sim(mesh=None, batch_size=64, seed=7)
+    (wer_e, _), min_w_e = _expected_mesh_wer(
+        sim_ref, lambda k: sim_ref._device_batch_stats(k, 64), 512, key,
+        lambda c, t: wer_single_shot(c, t, sim_ref.K),
+    )
+    assert wer_m == wer_e
+    # the pmin-reduced diagnostic must equal the unsharded replay's minimum
+    assert sim.min_logical_weight == min(sim.N, min_w_e)
+
+
+def test_mesh_wer_equals_unsharded_replay_phenom_engine():
+    from qldpc_fault_tolerance_tpu.sim.common import wer_per_cycle
+    from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+
+    code = hgp(rep_code(3), rep_code(3))
+    p, q = 0.04, 0.04
+
+    def make(mesh):
+        hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+        hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+        d1x = BPDecoder(hz_ext, np.concatenate([np.full(code.N, p),
+                                                np.full(code.hz.shape[0], q)]),
+                        max_iter=8)
+        d1z = BPDecoder(hx_ext, np.concatenate([np.full(code.N, p),
+                                                np.full(code.hx.shape[0], q)]),
+                        max_iter=8)
+        d2x = BPDecoder(code.hz, np.full(code.N, p), max_iter=8)
+        d2z = BPDecoder(code.hx, np.full(code.N, p), max_iter=8)
+        return CodeSimulator_Phenon(
+            code=code, decoder1_x=d1x, decoder1_z=d1z, decoder2_x=d2x,
+            decoder2_z=d2z, pauli_error_probs=[p / 3, p / 3, p / 3], q=q,
+            batch_size=32, mesh=mesh,
+        )
+
+    key = jax.random.PRNGKey(5)
+    sim_m = make(shot_mesh())
+    wer_m, _ = sim_m.WordErrorRate(5, 256, key=key)
+    sim_s = make(None)
+    (wer_e, _), min_w_e = _expected_mesh_wer(
+        sim_s, lambda k: sim_s._device_batch_stats(k, 5, 32), 256, key,
+        lambda c, t: wer_per_cycle(c, t, sim_s.K, 5),
+    )
+    assert wer_m == wer_e
+    # the pmin-reduced diagnostic must equal the unsharded replay's minimum
+    assert sim_m.min_logical_weight == min(sim_m.N, min_w_e)
+
+
+def test_mesh_wer_equals_unsharded_replay_circuit_engines():
+    from qldpc_fault_tolerance_tpu.decoders import (
+        ST_BP_Decoder_Circuit,
+    )
+    from qldpc_fault_tolerance_tpu.sim.circuit import CodeSimulator_Circuit
+    from qldpc_fault_tolerance_tpu.sim.circuit_spacetime import (
+        CodeSimulator_Circuit_SpaceTime,
+    )
+    from qldpc_fault_tolerance_tpu.sim.common import wer_per_cycle
+
+    code = hgp(rep_code(3), rep_code(3))
+    p = 0.01
+    ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 1, "p_idling_gate": 0}
+
+    def make_plain(mesh):
+        m = code.hx.shape[0]
+        hx_ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+        d1 = BPDecoder(hx_ext, np.concatenate([np.full(code.N, p),
+                                               np.full(m, p)]), max_iter=8)
+        d2 = BPDecoder(code.hx, np.full(code.N, p), max_iter=8)
+        sim = CodeSimulator_Circuit(
+            code=code, decoder1_z=d1, decoder2_z=d2, p=p, num_cycles=3,
+            error_params=ep, batch_size=32, mesh=mesh,
+        )
+        return sim
+
+    key = jax.random.PRNGKey(9)
+    sim_m = make_plain(shot_mesh())
+    wer_m, _ = sim_m.WordErrorRate(256, key=key)
+    sim_s = make_plain(None)
+    sim_s._ensure_circuit()
+    (wer_e, _), _ = _expected_mesh_wer(
+        sim_s, lambda k: sim_s._device_batch_stats(k, 32), 256, key,
+        lambda c, t: wer_per_cycle(c, t, sim_s.K, 3),
+    )
+    assert wer_m == wer_e
+
+    def make_st(mesh):
+        sim = CodeSimulator_Circuit_SpaceTime(
+            code=code, p=p, num_cycles=7, num_rep=3, error_params=ep,
+            batch_size=32, mesh=mesh,
+        )
+        sim._generate_circuit()
+        sim._generate_circuit_graph()
+        g = sim.circuit_graph
+        sim.decoder1_z = ST_BP_Decoder_Circuit(g["h1"], g["channel_ps1"],
+                                               max_iter=8)
+        sim.decoder2_z = ST_BP_Decoder_Circuit(g["h2"], g["channel_ps2"],
+                                               max_iter=8)
+        return sim
+
+    sim_m = make_st(shot_mesh())
+    wer_m, _ = sim_m.WordErrorRate(256, key=key)
+    sim_s = make_st(None)
+    (wer_e, _), _ = _expected_mesh_wer(
+        sim_s, lambda k: sim_s._device_batch_stats(k, 32), 256, key,
+        lambda c, t: wer_per_cycle(c, t, sim_s.K, 7),
+    )
+    assert wer_m == wer_e
+
+
+def test_mesh_wer_equals_unsharded_replay_phenom_st_engine():
+    from qldpc_fault_tolerance_tpu.decoders import ST_BP_Decoder_syndrome
+    from qldpc_fault_tolerance_tpu.sim.common import wer_per_cycle
+    from qldpc_fault_tolerance_tpu.sim.phenom_spacetime import (
+        CodeSimulator_Phenon_SpaceTime,
+    )
+
+    code = hgp(rep_code(3), rep_code(3))
+    p, q, num_rep = 0.03, 0.03, 2
+
+    def make(mesh):
+        d1x = ST_BP_Decoder_syndrome(code.hz, p_data=p, p_synd=q,
+                                     max_iter=8, num_rep=num_rep)
+        d1z = ST_BP_Decoder_syndrome(code.hx, p_data=p, p_synd=q,
+                                     max_iter=8, num_rep=num_rep)
+        d2x = BPDecoder(code.hz, np.full(code.N, p), max_iter=8)
+        d2z = BPDecoder(code.hx, np.full(code.N, p), max_iter=8)
+        return CodeSimulator_Phenon_SpaceTime(
+            code=code, decoder1_x=d1x, decoder1_z=d1z, decoder2_x=d2x,
+            decoder2_z=d2z, pauli_error_probs=[p / 3, p / 3, p / 3], q=q,
+            num_rep=num_rep, batch_size=32, mesh=mesh,
+        )
+
+    key = jax.random.PRNGKey(13)
+    sim_m = make(shot_mesh())
+    wer_m, _ = sim_m.WordErrorRate(5, 256, key=key)
+    num_rounds = int((5 - 1) / num_rep + 1)
+    total_cycles = (num_rounds - 1) * num_rep + 1
+    sim_s = make(None)
+    (wer_e, _), min_w_e = _expected_mesh_wer(
+        sim_s, lambda k: sim_s._device_batch_stats(k, num_rounds, 32), 256,
+        key, lambda c, t: wer_per_cycle(c, t, sim_s.K, total_cycles),
+    )
+    assert wer_m == wer_e
+    assert sim_m.min_logical_weight == min(sim_m.N, min_w_e)
+
+
+def test_generic_sharded_batch_stats():
+    import jax.numpy as jnp
+
     mesh = shot_mesh()
 
-    def dev_fn(key, bs):
-        return jax.random.uniform(key, (bs,)) < 0.25
+    def stats_fn(key):
+        fail = jax.random.uniform(key, (128,)) < 0.25
+        weights = jax.random.randint(key, (128,), 0, 100)
+        return (fail.sum(dtype=jnp.int32),
+                jnp.where(fail, weights, 1000).min().astype(jnp.int32))
 
-    run = sharded_failure_count(dev_fn, mesh, 128)
+    run = sharded_batch_stats(stats_fn, mesh)
     keys = split_keys_for_mesh(jax.random.PRNGKey(0), mesh)
-    total = int(run(keys))
-    assert 0 < total < 8 * 128
-    np.testing.assert_allclose(total / (8 * 128), 0.25, atol=0.08)
+    total, min_w = (int(v) for v in run(keys))
+    # exact replay on one device
+    exp_total, exp_min = 0, 1000
+    for k in keys:
+        c, w = stats_fn(k)
+        exp_total += int(c)
+        exp_min = min(exp_min, int(w))
+    assert total == exp_total
+    assert min_w == exp_min
 
 
 def test_process_grid_single_process_identity():
