@@ -62,7 +62,8 @@ struct BitMat {
 struct OsdWorker {
   int m, n;
   const uint8_t* H;            // m*n row-major {0,1}
-  const double* channel_cost;  // n: log((1-p)/p) >= 0 cost of flipping bit j
+  const double* channel_cost;  // n: signed log((1-p)/p) cost of flipping bit j
+                               // (negative when a prior exceeds 1/2)
 
   std::vector<int> order;      // column permutation (most suspect first)
   std::vector<int> pivot_cols; // permuted indices chosen as pivots (size r)
@@ -199,7 +200,7 @@ extern "C" {
 //   H            : m*n row-major {0,1}
 //   syndromes    : batch*m
 //   posterior_llr: batch*n (soft BP output; ordering key)
-//   channel_cost : n (log((1-p)/p), clipped >= 0; candidate scoring)
+//   channel_cost : n (signed log((1-p)/p); candidate scoring)
 //   method       : 0 osd0, 1 osd_e, 2 osd_cs
 //   out          : batch*n error estimates
 int qldpc_osd_decode_batch(const uint8_t* H, int m, int n,

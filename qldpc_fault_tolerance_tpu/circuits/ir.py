@@ -155,6 +155,14 @@ class Circuit:
 
     __rmul__ = __mul__
 
+    def detector_error_model(self, flatten_loops: bool = True):
+        """stim-parity surface: notebooks call
+        ``circuit.detector_error_model(flatten_loops=True)`` directly
+        (SpaceTimeDecodingDemo cell 4)."""
+        from .dem import detector_error_model
+
+        return detector_error_model(self, flatten_loops=flatten_loops)
+
     def copy(self) -> "Circuit":
         out = Circuit()
         for item in self.items:

@@ -101,6 +101,43 @@ class CompiledCircuit:
     # args) events in circuit order, only for detectors carrying args
     coord_events: list[tuple]
 
+    def structure_key(self) -> str:
+        """Digest of the circuit *structure* — every field the sampler bakes
+        into its traced program EXCEPT the noise probabilities ``op.p``
+        (which ride in as traced arguments).  Two compiled circuits with
+        equal keys lower to the identical XLA program, so a p-sweep over one
+        memory-circuit layout shares a single compiled sampler
+        (sampler.py's module cache)."""
+        import hashlib
+
+        h = hashlib.sha256()
+
+        def put(*vals):
+            # each value is framed (type tag + shape/dtype for arrays + a
+            # terminator) so adjacent fields can never alias across
+            # boundaries — ints (1, 23) vs (12, 3) must hash differently
+            for v in vals:
+                if isinstance(v, np.ndarray):
+                    h.update(f"a{v.dtype}{v.shape}|".encode())
+                    h.update(v.tobytes())
+                else:
+                    h.update(f"v{v!r}".encode())
+                h.update(b";")
+
+        put(self.num_qubits, self.num_measurements, self.num_detectors,
+            self.num_observables)
+        for seg in self.segments:
+            put(seg.kind, seg.repeat_count, seg.meas_per_iter, seg.rec_offset)
+            for op in seg.ops:
+                put(op.kind, op.a, op.b if op.b is not None else "-",
+                    op.basis, op.rec if op.rec is not None else "-",
+                    op.reset_after, op.collapse, op.fx, op.fz, op.noise_id)
+        for cols in self.det_cols:
+            put(cols)
+        for cols in self.obs_cols:
+            put(cols)
+        return h.hexdigest()
+
     def flattened_ops(self):
         """Ops with repeat segments unrolled; measurement record columns
         shifted per iteration.  Yields (op, unrolled_index)."""

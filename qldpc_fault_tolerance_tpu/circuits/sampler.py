@@ -64,11 +64,14 @@ def _apply_gate(op: Op, x, z):
     raise AssertionError(op.kind)
 
 
-def _apply_noise(op: Op, key, x, z):
+def _apply_noise(op: Op, key, x, z, p):
+    """``p`` is a traced scalar (probs[op.noise_id]) so probability changes
+    don't retrace — only the circuit structure is baked into the program."""
     kop = jax.random.fold_in(key, op.noise_id)
     if op.kind == "perr":
         q = jnp.asarray(op.a)
-        flips = jax.random.bernoulli(kop, op.p, (x.shape[0], len(op.a))).astype(jnp.uint8)
+        u = jax.random.uniform(kop, (x.shape[0], len(op.a)))
+        flips = (u < p).astype(jnp.uint8)
         if op.fx:
             x = x.at[:, q].add(flips) & 1
         if op.fz:
@@ -77,8 +80,8 @@ def _apply_noise(op: Op, key, x, z):
     if op.kind == "dep1":
         q = jnp.asarray(op.a)
         u = jax.random.uniform(kop, (x.shape[0], len(op.a)))
-        event = u < op.p
-        comp = jnp.clip((u * (3.0 / op.p)).astype(jnp.int32), 0, 2)
+        event = u < p
+        comp = jnp.clip((u * (3.0 / p)).astype(jnp.int32), 0, 2)
         fx = (event & (comp <= 1)).astype(jnp.uint8)  # X or Y
         fz = (event & (comp >= 1)).astype(jnp.uint8)  # Y or Z
         x = x.at[:, q].add(fx) & 1
@@ -88,8 +91,8 @@ def _apply_noise(op: Op, key, x, z):
         a = jnp.asarray(op.a)
         b = jnp.asarray(op.b)
         u = jax.random.uniform(kop, (x.shape[0], len(op.a)))
-        event = u < op.p
-        comp = jnp.clip((u * (15.0 / op.p)).astype(jnp.int32), 0, 14) + 1
+        event = u < p
+        comp = jnp.clip((u * (15.0 / p)).astype(jnp.int32), 0, 14) + 1
         p1 = comp >> 2  # first-qubit Pauli in {I,X,Y,Z} = {0,1,2,3}
         p2 = comp & 3
         fxa = (event & ((p1 == 1) | (p1 == 2))).astype(jnp.uint8)
@@ -145,19 +148,33 @@ class FrameSampler:
         self.num_observables = c.num_observables
         self._det_idx = _pad_cols(c.det_cols, pad=c.num_measurements)
         self._obs_idx = _pad_cols(c.obs_cols, pad=c.num_measurements)
+        # noise probabilities as a traced vector indexed by noise_id: circuits
+        # that differ only in their error rates (a p-sweep over one memory
+        # layout) share one compiled sampler (module cache on structure_key)
+        self._structure_key = c.structure_key()
+        max_id = max(
+            (op.noise_id for seg in c.segments for op in seg.ops
+             if op.noise_id >= 0),
+            default=-1,
+        )
+        probs = np.zeros(max(max_id + 1, 1), np.float32)
+        for seg in c.segments:
+            for op in seg.ops:
+                if op.kind in ("dep1", "dep2", "perr"):
+                    probs[op.noise_id] = op.p
+        self._probs = jnp.asarray(probs)
 
-    def _run_ops(self, ops: list[Op], key, x, z, buf, rec_shift):
+    def _run_ops(self, ops: list[Op], key, x, z, buf, rec_shift, probs):
         for op in ops:
             if op.kind in ("cx", "cz", "h", "reset"):
                 x, z = _apply_gate(op, x, z)
             elif op.kind == "measure":
                 x, z, buf = _apply_measure(op, key, x, z, buf, op.rec + rec_shift)
             else:
-                x, z = _apply_noise(op, key, x, z)
+                x, z = _apply_noise(op, key, x, z, probs[op.noise_id])
         return x, z, buf
 
-    @functools.partial(jax.jit, static_argnames=("self", "shots"))
-    def sample(self, key, shots: int):
+    def _sample_impl(self, key, probs, shots: int):
         c = self.compiled
         x = jnp.zeros((shots, self.num_qubits), jnp.uint8)
         z = jnp.zeros((shots, self.num_qubits), jnp.uint8)
@@ -166,7 +183,8 @@ class FrameSampler:
         for si, seg in enumerate(c.segments):
             kseg = jax.random.fold_in(key, si)
             if seg.kind == "block":
-                x, z, rec = self._run_ops(seg.ops, kseg, x, z, rec, seg.rec_offset)
+                x, z, rec = self._run_ops(
+                    seg.ops, kseg, x, z, rec, seg.rec_offset, probs)
             else:
                 per = seg.meas_per_iter
 
@@ -177,7 +195,8 @@ class FrameSampler:
                     # record columns inside the body are iteration-relative;
                     # the stacked scan output is reshaped into the global
                     # record below (iterations are contiguous)
-                    xx, zz, buf = self._run_ops(seg.ops, kit, x, z, buf, 0)
+                    xx, zz, buf = self._run_ops(seg.ops, kit, x, z, buf, 0,
+                                                probs)
                     return (xx, zz), buf[:, :per]
 
                 (x, z), stacked = jax.lax.scan(
@@ -201,6 +220,31 @@ class FrameSampler:
             obs = obs ^ rec[:, jnp.asarray(self._obs_idx[:, t])]
         obs = obs[:, : self.num_observables]
         return dets, obs
+
+    # compiled sampler cache: (structure_key, shots) -> jitted (key, probs)
+    # closure.  Closing over ONE sampler instance is sound because the
+    # structure key digests every array/flag the trace bakes in (only op.p —
+    # routed through the traced probs vector — is excluded).
+    _CACHE: dict = {}
+
+    def sample(self, key, shots: int):
+        fn = FrameSampler._CACHE.get((self._structure_key, shots))
+        if fn is None:
+            fn = jax.jit(
+                functools.partial(self._sample_impl, shots=shots)
+            )
+            FrameSampler._CACHE[(self._structure_key, shots)] = fn
+        return fn(key, self._probs)
+
+    # Samplers hash/compare by circuit structure so they can serve as static
+    # jit arguments in the simulators' value-based pipelines: a p-sweep's
+    # samplers are interchangeable there (probs arrive as traced arguments).
+    def __hash__(self):
+        return hash(self._structure_key)
+
+    def __eq__(self, other):
+        return (isinstance(other, FrameSampler)
+                and self._structure_key == other._structure_key)
 
     def sample_np(self, seed_or_key, shots: int, append_observables: bool = False):
         """stim-like convenience: host uint8 array, observables appended as

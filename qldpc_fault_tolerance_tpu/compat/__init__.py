@@ -44,6 +44,8 @@ def install(include_third_party_stubs: bool = True) -> None:
     if include_third_party_stubs:
         _install_ldpc_stub()
         _install_bposd_stub()
+        _install_stim_stub()
+        _install_graph_tools_stub()
 
 
 def _install_ldpc_stub() -> None:
@@ -54,7 +56,10 @@ def _install_ldpc_stub() -> None:
         pass
     from ..codes import gf2, classical_code_distance, rep_code, ring_code
 
+    from ..decoders import BPDecoder
+
     ldpc = types.ModuleType("ldpc")
+    ldpc.bp_decoder = BPDecoder  # same ctor keywords + .decode contract
     codes_mod = types.ModuleType("ldpc.codes")
     codes_mod.rep_code = rep_code
     codes_mod.ring_code = ring_code
@@ -80,14 +85,54 @@ def _install_bposd_stub() -> None:
     except ImportError:
         pass
     from ..codes import CssCode, hgp
+    from ..decoders import BPOSD_Decoder
 
     bposd = types.ModuleType("bposd")
+    bposd.bposd_decoder = BPOSD_Decoder  # same ctor keywords + .decode
     hgp_mod = types.ModuleType("bposd.hgp")
     hgp_mod.hgp = hgp
     css_mod = types.ModuleType("bposd.css")
     css_mod.css_code = CssCode
+    sim_mod = types.ModuleType("bposd.css_decode_sim")
+    sim_mod.css_decode_sim = None  # imported but unused by the notebooks
     bposd.hgp = hgp_mod
     bposd.css = css_mod
+    bposd.css_decode_sim = sim_mod
     sys.modules["bposd"] = bposd
     sys.modules["bposd.hgp"] = hgp_mod
     sys.modules["bposd.css"] = css_mod
+    sys.modules["bposd.css_decode_sim"] = sim_mod
+
+
+def _install_stim_stub() -> None:
+    """The notebooks ``import stim`` at the top; every actual use goes
+    through the library layer (circuit IR + Pauli-frame sampler + DEM), so
+    the stub only needs the construction surface."""
+    try:
+        import stim  # noqa: F401
+        return
+    except ImportError:
+        pass
+    from ..circuits import Circuit, target_rec
+
+    stim = types.ModuleType("stim")
+    stim.Circuit = Circuit
+    stim.target_rec = target_rec
+    sys.modules["stim"] = stim
+
+
+def _install_graph_tools_stub() -> None:
+    """``from graph_tools import Graph`` appears in every notebook header;
+    Graph is never used afterwards."""
+    try:
+        import graph_tools  # noqa: F401
+        return
+    except ImportError:
+        pass
+    gt = types.ModuleType("graph_tools")
+
+    class Graph:  # pragma: no cover - never exercised by the notebooks
+        pass
+
+    gt.Graph = Graph
+    sys.modules["graph_tools"] = gt

@@ -57,6 +57,54 @@ def _norm_method(bp_method: str) -> str:
     return _BP_METHOD_ALIASES[str(bp_method).lower()]
 
 
+def decode_device(static, state, syndromes):
+    """Value-based device decode: the traced program depends only on
+    ``static`` (a hashable tuple from ``decoder.device_static``) while every
+    array — Tanner graph, channel LLRs — arrives through ``state`` (a pytree
+    from ``decoder.device_state``).
+
+    This is the key to compile sharing across a sweep: simulators jit their
+    pipelines with the decoder *statics* in the cache key and the decoder
+    *state* as traced arguments, so the 6 p-points of a threshold grid (or
+    the codes of equal shape) reuse one executable instead of recompiling
+    per (code, p) cell.  Semantically identical to
+    ``decoder.decode_batch_device(syndromes)``.
+    """
+    kind = static[0]
+    if kind == "st_syndrome":
+        _, num_rep, m, n, inner = static
+        b = syndromes.shape[0]
+        synd = syndromes.reshape(b, num_rep * m)
+        corr, aux = decode_device(inner, state, synd)
+        data = corr.reshape(b, num_rep, n + m)[:, :, :n]
+        folded = (jnp.sum(data.astype(jnp.int32), axis=1) % 2).astype(jnp.uint8)
+        return folded, aux
+    if kind == "firstmin":
+        _, max_restarts, msf = static
+        corr, w = bp.first_min_bp_decode(
+            state["graph"], syndromes, state["llr0"],
+            max_restarts=max_restarts, ms_scaling_factor=msf,
+        )
+        return corr, {"final_weight": w}
+    assert kind == "bp", kind
+    _, max_iter, method, msf, two_phase, _has_pallas = static
+    if (two_phase and syndromes.ndim == 2 and syndromes.shape[0] >= 64
+            and max_iter > 8):
+        res = bp.bp_decode_two_phase(
+            state["graph"], syndromes, state["llr0"],
+            max_iter=max_iter, method=method, ms_scaling_factor=msf,
+            pallas_head=state["pallas"],
+        )
+    else:
+        res = bp.bp_decode(
+            state["graph"], syndromes, state["llr0"],
+            max_iter=max_iter, method=method, ms_scaling_factor=msf,
+        )
+    return res.error, {
+        "converged": res.converged, "posterior_llr": res.posterior_llr
+    }
+
+
 class FusedBPPair:
     """Two independent plain-BP decodes fused into one kernel call.
 
@@ -150,6 +198,20 @@ class BPDecoder:
                     self._pallas_head = pg
 
     needs_host_postprocess = False
+
+    # --- value-based device interface (compile sharing across sweeps) ---
+    @property
+    def device_static(self):
+        """Hashable program config — goes into the jit cache key."""
+        return ("bp", self.max_iter, self.bp_method,
+                float(self.ms_scaling_factor), self.two_phase,
+                self._pallas_head is not None)
+
+    @property
+    def device_state(self):
+        """Pytree of arrays — traced arguments, value changes don't retrace."""
+        return {"graph": self.graph, "llr0": self.llr0,
+                "pallas": self._pallas_head}
 
     # --- device-side (for composition inside jitted simulators) ---
     def decode_batch_device(self, syndromes):
@@ -249,6 +311,14 @@ class FirstMinBPDecoder:
 
     needs_host_postprocess = False
 
+    @property
+    def device_static(self):
+        return ("firstmin", self.max_iter, float(self.ms_scaling_factor))
+
+    @property
+    def device_state(self):
+        return {"graph": self.graph, "llr0": self.llr0}
+
     def decode_batch_device(self, syndromes):
         corr, w = bp.first_min_bp_decode(
             self.graph,
@@ -321,6 +391,15 @@ class ST_BP_Decoder_syndrome:
         )
 
     needs_host_postprocess = False
+
+    @property
+    def device_static(self):
+        return ("st_syndrome", self.num_rep, self.num_checks,
+                self.num_qubits, self._bp.device_static)
+
+    @property
+    def device_state(self):
+        return self._bp.device_state
 
     def decode_batch_device(self, detector_histories):
         """Device path: (B, num_rep, m) detector histories -> (B, n) folded

@@ -21,8 +21,14 @@ _METHODS = {"osd_0": 0, "osd0": 0, "osd_e": 1, "osd_cs": 2, "exhaustive": 1}
 
 
 def _channel_cost(channel_probs: np.ndarray) -> np.ndarray:
+    """Signed per-bit cost log((1-p)/p) of setting a bit in the candidate.
+
+    Kept signed: a channel prior > 1/2 (possible for DEM-merged fault
+    priors) makes setting that bit *cheaper* than leaving it clear, which a
+    clamp-to-positive would silently invert.  Only the p->0/1 endpoints are
+    clipped for finiteness."""
     p = np.clip(np.asarray(channel_probs, dtype=np.float64), 1e-12, 1 - 1e-7)
-    return np.maximum(np.log((1 - p) / p), 1e-12)
+    return np.log((1 - p) / p)
 
 
 def osd_decode_batch(
@@ -145,19 +151,22 @@ def osd_postprocess(
     osd_order: int = 10,
 ) -> np.ndarray:
     """Combine BP output with OSD on the non-converged shots (bposd semantics)."""
+    from ..utils.observability import stage_timer
+
     bp_errors = np.asarray(bp_errors, dtype=np.uint8)
     conv = np.asarray(bp_converged, dtype=bool)
     if conv.all():
         return bp_errors
     idx = np.nonzero(~conv)[0]
-    fixed = osd_decode_batch(
-        h,
-        np.asarray(syndromes)[idx],
-        np.asarray(posterior_llrs)[idx],
-        channel_probs,
-        osd_method=osd_method,
-        osd_order=osd_order,
-    )
+    with stage_timer("osd_host"):
+        fixed = osd_decode_batch(
+            h,
+            np.asarray(syndromes)[idx],
+            np.asarray(posterior_llrs)[idx],
+            channel_probs,
+            osd_method=osd_method,
+            osd_order=osd_order,
+        )
     out = bp_errors.copy()
     out[idx] = fixed
     return out

@@ -46,9 +46,16 @@ class ParityOp:
 
     def __call__(self, bits):
         """bits: (..., n) {0,1} -> (..., m) uint8 parity."""
-        g = jnp.asarray(bits).astype(jnp.uint8)[..., self.nbr]
-        s = jnp.sum(jnp.where(self.mask, g, 0), axis=-1, dtype=jnp.uint8)
-        return s & jnp.uint8(1)
+        return parity_apply(self.nbr, self.mask, bits)
+
+
+def parity_apply(nbr, mask, bits):
+    """Padded-adjacency gather parity (the body of ParityOp, shared with the
+    simulators' value-based pipelines, which carry (nbr, mask) as traced
+    state)."""
+    g = jnp.asarray(bits).astype(jnp.uint8)[..., nbr]
+    s = jnp.sum(jnp.where(mask, g, 0), axis=-1, dtype=jnp.uint8)
+    return s & jnp.uint8(1)
 
 
 def syndrome(h, e):

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..circuits import AddCXError, Circuit, ColorationCircuit, FrameSampler, \
     RandomCircuit, target_rec
+from ..decoders.bp_decoders import decode_device
 from ..ops.linalg import gf2_matmul
 from .common import (
     ShotBatcher,
@@ -194,6 +195,61 @@ def _swap_xz_inplace(code):
     code.lx, code.lz = code.lz, code.lx
 
 
+# ---------------------------------------------------------------------------
+# Value-based device pipeline (module-level: the jit cache is keyed on the
+# circuit structure + decoder statics, so a p-sweep over one memory layout
+# compiles once — noise probabilities and decoder LLRs are traced arguments).
+# cfg = (batch_size, num_cycles, N, m, sampler, d1_static, d2_static)
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _rounds_decode(cfg, state, key):
+    """Sample detectors and run the sequential per-round decode
+    (src/Simulators.py:612-632) as a lax.scan; returns everything the
+    final (host-assisted) decode stage needs."""
+    batch_size, num_cycles, n, m, sampler, d1_static, d2_static = cfg
+    dets, obs = sampler._sample_impl(key, state["probs"], batch_size)
+    hist = dets.reshape(batch_size, num_cycles, m)
+
+    def round_step(carry, synd_j):
+        correction, residual = carry
+        corrected = synd_j ^ residual
+        new_cor, _ = decode_device(d1_static, state["d1"], corrected)
+        data_cor = new_cor[:, :n]
+        correction = correction ^ data_cor
+        residual = corrected ^ gf2_matmul(data_cor, state["hx_t"])
+        return (correction, residual), None
+
+    init = (
+        jnp.zeros((batch_size, n), jnp.uint8),
+        jnp.zeros((batch_size, m), jnp.uint8),
+    )
+    (correction, residual), _ = jax.lax.scan(
+        round_step, init, jnp.moveaxis(hist[:, :-1], 1, 0)
+    )
+    corrected_final = hist[:, -1] ^ residual
+    final_cor, final_aux = decode_device(d2_static, state["d2"],
+                                         corrected_final)
+    return obs, correction, corrected_final, final_cor, final_aux
+
+
+@jax.jit
+def _check(state, obs, correction, corrected_final, final_cor):
+    """src/Simulators.py:634-641."""
+    total = correction ^ final_cor
+    residual_syn = corrected_final ^ gf2_matmul(final_cor, state["hx_t"])
+    logical_cor = gf2_matmul(total, state["lx_t"])
+    residual_log = obs ^ logical_cor
+    return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_count(cfg, state, key):
+    """Whole batch on device -> failure count scalar (no host sync)."""
+    obs, correction, corrected_final, final_cor, _ = _rounds_decode(
+        cfg, state, key)
+    return _check(state, obs, correction, corrected_final,
+                  final_cor).sum(dtype=jnp.int32)
+
+
 class CodeSimulator_Circuit:
     """Same constructor surface as the reference class (src/Simulators.py:386-435),
     plus ``seed`` / ``batch_size``."""
@@ -253,42 +309,25 @@ class CodeSimulator_Circuit:
             self._generate_circuit()
 
     # ------------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _cfg(self, batch_size: int):
+        # the sampler hashes by circuit structure, so p-sweep cells over one
+        # memory-circuit layout share these executables (see sampler.py)
+        return (batch_size, self.num_cycles, self.N, self._m, self._sampler,
+                self.decoder1_z.device_static, self.decoder2_z.device_static)
+
+    @property
+    def _dev_state(self):
+        return {"probs": self._sampler._probs, "hx_t": self._hx_t,
+                "lx_t": self._lx_t, "d1": self.decoder1_z.device_state,
+                "d2": self.decoder2_z.device_state}
+
     def _sample_and_decode_rounds(self, key, batch_size: int):
-        """Sample detectors and run the sequential per-round decode
-        (src/Simulators.py:612-632) as a lax.scan; returns everything the
-        final (host-assisted) decode stage needs."""
-        dets, obs = self._sampler.sample(key, batch_size)
-        hist = dets.reshape(batch_size, self.num_cycles, self._m)
+        self._ensure_circuit()
+        return _rounds_decode(self._cfg(batch_size), self._dev_state, key)
 
-        def round_step(carry, synd_j):
-            correction, residual = carry
-            corrected = synd_j ^ residual
-            new_cor, _ = self.decoder1_z.decode_batch_device(corrected)
-            data_cor = new_cor[:, : self.N]
-            correction = correction ^ data_cor
-            residual = corrected ^ gf2_matmul(data_cor, self._hx_t)
-            return (correction, residual), None
-
-        init = (
-            jnp.zeros((batch_size, self.N), jnp.uint8),
-            jnp.zeros((batch_size, self._m), jnp.uint8),
-        )
-        (correction, residual), _ = jax.lax.scan(
-            round_step, init, jnp.moveaxis(hist[:, :-1], 1, 0)
-        )
-        corrected_final = hist[:, -1] ^ residual
-        final_cor, final_aux = self.decoder2_z.decode_batch_device(corrected_final)
-        return obs, correction, corrected_final, final_cor, final_aux
-
-    @functools.partial(jax.jit, static_argnames=("self",))
     def _check_failures(self, obs, correction, corrected_final, final_cor):
-        """src/Simulators.py:634-641."""
-        total = correction ^ final_cor
-        residual_syn = corrected_final ^ gf2_matmul(final_cor, self._hx_t)
-        logical_cor = gf2_matmul(total, self._lx_t)
-        residual_log = obs ^ logical_cor
-        return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
+        return _check(self._dev_state, obs, correction, corrected_final,
+                      final_cor)
 
     # ------------------------------------------------------------------
     def _finish_batch(self, pending):
@@ -323,13 +362,8 @@ class CodeSimulator_Circuit:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, 1)[0])
 
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _device_batch_count(self, key, batch_size: int):
-        obs, correction, corrected_final, final_cor, _ = \
-            self._sample_and_decode_rounds(key, batch_size)
-        return self._check_failures(
-            obs, correction, corrected_final, final_cor
-        ).sum(dtype=jnp.int32)
+        return _batch_count(self._cfg(batch_size), self._dev_state, key)
 
     def _device_batch_stats(self, key, batch_size: int):
         """Mesh-shardable unit.  The reference tracks no min_logical_weight
@@ -340,8 +374,8 @@ class CodeSimulator_Circuit:
             jnp.asarray(self.N, jnp.int32),
         )
 
-    def WordErrorRate(self, num_samples: int, key=None):
-        """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
+    def _count_failures(self, num_samples: int, key=None):
+        """(failure count, shots actually run) over the right dispatch path."""
         self._ensure_circuit()
         self._assert_round_decoder_device()
         if key is None:
@@ -353,17 +387,22 @@ class CodeSimulator_Circuit:
                     lambda k: self._device_batch_stats(k, self.batch_size),
                     num_samples, key,
                 )
-                return wer_per_cycle(count, total, self.K, self.num_cycles)
+                return count, total
             batcher = ShotBatcher(num_samples, self.batch_size)
             keys = [jax.random.fold_in(key, i) for i in batcher]
             count = accumulate_counts(
                 lambda k: self._device_batch_count(k, self.batch_size), keys
             )
-            return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+            return count, batcher.total
         batcher = ShotBatcher(num_samples, self.batch_size)
         keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(
             lambda k: self._sample_and_decode_rounds(k, self.batch_size),
             self._finish_batch, keys,
         )
-        return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+        return count, batcher.total
+
+    def WordErrorRate(self, num_samples: int, key=None):
+        """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
+        count, total = self._count_failures(num_samples, key)
+        return wer_per_cycle(count, total, self.K, self.num_cycles)

@@ -32,6 +32,7 @@ from ..circuits import (
     RandomCircuit,
     detector_error_model,
 )
+from ..decoders.bp_decoders import decode_device
 from ..ops.linalg import gf2_matmul
 from .circuit import _swap_xz_inplace, build_memory_circuit
 from .common import (
@@ -43,6 +44,62 @@ from .common import (
 )
 
 __all__ = ["CodeSimulator_Circuit_SpaceTime"]
+
+
+# ---------------------------------------------------------------------------
+# Value-based device pipeline (module-level; see sim/circuit.py — the jit
+# cache is keyed on circuit structure + decoder statics, so a p-sweep over
+# one memory layout compiles once).
+# cfg = (batch_size, num_cycles, num_rounds, num_rep, num_checks,
+#        num_logicals, sampler, d1_static, d2_static)
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _windows_decode(cfg, state, key):
+    """Sliding-window decode (src/Simulators_SpaceTime.py:969-1006) as a
+    scan; returns what the final host-assisted decode needs."""
+    (batch_size, num_cycles, num_rounds, num_rep, m, num_logicals,
+     sampler, d1_static, d2_static) = cfg
+    dets, obs = sampler._sample_impl(key, state["probs"], batch_size)
+    hist = dets.reshape(batch_size, num_cycles, m)
+    windows = hist[:, : num_rounds * num_rep].reshape(
+        batch_size, num_rounds, num_rep * m
+    )
+    final_syn_raw = hist[:, -1]
+
+    def window_step(carry, syn_j):
+        total_space, total_log = carry
+        syn = syn_j.at[:, :m].set(syn_j[:, :m] ^ total_space)
+        cor, _ = decode_device(d1_static, state["d1"], syn)
+        total_space = total_space ^ gf2_matmul(cor, state["h1_space_cor_t"])
+        total_log = total_log ^ gf2_matmul(cor, state["L1_t"])
+        return (total_space, total_log), None
+
+    init = (
+        jnp.zeros((batch_size, m), jnp.uint8),
+        jnp.zeros((batch_size, num_logicals), jnp.uint8),
+    )
+    (total_space, total_log), _ = jax.lax.scan(
+        window_step, init, jnp.moveaxis(windows, 1, 0)
+    )
+    final_syn = final_syn_raw ^ total_space
+    final_cor, final_aux = decode_device(d2_static, state["d2"], final_syn)
+    return obs, total_log, final_syn, final_cor, final_aux
+
+
+@jax.jit
+def _check(state, obs, total_log, final_syn, final_cor):
+    """src/Simulators_SpaceTime.py:1004-1017."""
+    total_log = total_log ^ gf2_matmul(final_cor, state["L2_t"])
+    residual_syn = final_syn ^ gf2_matmul(final_cor, state["h2_t"])
+    residual_log = obs ^ total_log
+    return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_count(cfg, state, key):
+    """Whole batch on device -> failure count scalar (no host sync)."""
+    obs, total_log, final_syn, final_cor, _ = _windows_decode(cfg, state, key)
+    return _check(state, obs, total_log, final_syn,
+                  final_cor).sum(dtype=jnp.int32)
 
 
 class CodeSimulator_Circuit_SpaceTime:
@@ -142,49 +199,37 @@ class CodeSimulator_Circuit_SpaceTime:
             self._generate_circuit_graph()
 
     # ------------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _cfg(self, batch_size: int):
+        # sampler hashes by circuit structure (sampler.py), so a p-sweep
+        # over one memory layout shares these executables
+        return (batch_size, self.num_cycles, self.num_rounds, self.num_rep,
+                self.num_checks, self.num_logicals, self.detector_sampler,
+                self.decoder1_z.device_static, self.decoder2_z.device_static)
+
+    @property
+    def _dev_state(self):
+        # the DEM-derived matrices are uploaded once (decoders can be swapped
+        # after construction — SpaceTimeDecodingDemo does — so only the
+        # constant part is cached)
+        if getattr(self, "_dev_state_const", None) is None:
+            self._dev_state_const = {
+                "probs": self.detector_sampler._probs,
+                "h1_space_cor_t": jnp.asarray(
+                    self.h1_space_cor.T.astype(np.uint8)),
+                "L1_t": jnp.asarray(self.circuit_graph["L1"].T.astype(np.uint8)),
+                "h2_t": jnp.asarray(self.circuit_graph["h2"].T.astype(np.uint8)),
+                "L2_t": jnp.asarray(self.circuit_graph["L2"].T.astype(np.uint8)),
+            }
+        return dict(self._dev_state_const,
+                    d1=self.decoder1_z.device_state,
+                    d2=self.decoder2_z.device_state)
+
     def _sample_and_decode_windows(self, key, batch_size: int):
-        """Sliding-window decode (src/Simulators_SpaceTime.py:969-1006) as a
-        scan; returns what the final host-assisted decode needs."""
-        m = self.num_checks
-        dets, obs = self.detector_sampler.sample(key, batch_size)
-        hist = dets.reshape(batch_size, self.num_cycles, m)
-        windows = hist[:, : self.num_rounds * self.num_rep].reshape(
-            batch_size, self.num_rounds, self.num_rep * m
-        )
-        final_syn_raw = hist[:, -1]
+        self._ensure_ready()
+        return _windows_decode(self._cfg(batch_size), self._dev_state, key)
 
-        h1_space_cor_t = jnp.asarray(self.h1_space_cor.T.astype(np.uint8))
-        L1_t = jnp.asarray(self.circuit_graph["L1"].T.astype(np.uint8))
-
-        def window_step(carry, syn_j):
-            total_space, total_log = carry
-            syn = syn_j.at[:, :m].set(syn_j[:, :m] ^ total_space)
-            cor, _ = self.decoder1_z.decode_batch_device(syn)
-            total_space = total_space ^ gf2_matmul(cor, h1_space_cor_t)
-            total_log = total_log ^ gf2_matmul(cor, L1_t)
-            return (total_space, total_log), None
-
-        init = (
-            jnp.zeros((batch_size, m), jnp.uint8),
-            jnp.zeros((batch_size, self.num_logicals), jnp.uint8),
-        )
-        (total_space, total_log), _ = jax.lax.scan(
-            window_step, init, jnp.moveaxis(windows, 1, 0)
-        )
-        final_syn = final_syn_raw ^ total_space
-        final_cor, final_aux = self.decoder2_z.decode_batch_device(final_syn)
-        return obs, total_log, final_syn, final_cor, final_aux
-
-    @functools.partial(jax.jit, static_argnames=("self",))
     def _check_failures(self, obs, total_log, final_syn, final_cor):
-        """src/Simulators_SpaceTime.py:1004-1017."""
-        h2_t = jnp.asarray(self.circuit_graph["h2"].T.astype(np.uint8))
-        L2_t = jnp.asarray(self.circuit_graph["L2"].T.astype(np.uint8))
-        total_log = total_log ^ gf2_matmul(final_cor, L2_t)
-        residual_syn = final_syn ^ gf2_matmul(final_cor, h2_t)
-        residual_log = obs ^ total_log
-        return residual_syn.any(axis=-1) | residual_log.any(axis=-1)
+        return _check(self._dev_state, obs, total_log, final_syn, final_cor)
 
     # ------------------------------------------------------------------
     def _finish_batch(self, pending):
@@ -219,13 +264,8 @@ class CodeSimulator_Circuit_SpaceTime:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, 1)[0])
 
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _device_batch_count(self, key, batch_size: int):
-        obs, total_log, final_syn, final_cor, _ = \
-            self._sample_and_decode_windows(key, batch_size)
-        return self._check_failures(
-            obs, total_log, final_syn, final_cor
-        ).sum(dtype=jnp.int32)
+        return _batch_count(self._cfg(batch_size), self._dev_state, key)
 
     def _device_batch_stats(self, key, batch_size: int):
         """Mesh-shardable unit; the weight slot is the neutral element N
@@ -235,8 +275,8 @@ class CodeSimulator_Circuit_SpaceTime:
             jnp.asarray(self.N, jnp.int32),
         )
 
-    def WordErrorRate(self, num_samples: int, key=None):
-        """src/Simulators_SpaceTime.py:1031-1049."""
+    def _count_failures(self, num_samples: int, key=None):
+        """(failure count, shots actually run) over the right dispatch path."""
         self._ensure_ready()
         self._assert_window_decoder_device()
         if key is None:
@@ -248,20 +288,25 @@ class CodeSimulator_Circuit_SpaceTime:
                     lambda k: self._device_batch_stats(k, self.batch_size),
                     num_samples, key,
                 )
-                return wer_per_cycle(count, total, self.K, self.num_cycles)
+                return count, total
             batcher = ShotBatcher(num_samples, self.batch_size)
             keys = [jax.random.fold_in(key, i) for i in batcher]
             count = accumulate_counts(
                 lambda k: self._device_batch_count(k, self.batch_size), keys
             )
-            return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+            return count, batcher.total
         batcher = ShotBatcher(num_samples, self.batch_size)
         keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(
             lambda k: self._sample_and_decode_windows(k, self.batch_size),
             self._finish_batch, keys,
         )
-        return wer_per_cycle(count, batcher.total, self.K, self.num_cycles)
+        return count, batcher.total
+
+    def WordErrorRate(self, num_samples: int, key=None):
+        """src/Simulators_SpaceTime.py:1031-1049."""
+        count, total = self._count_failures(num_samples, key)
+        return wer_per_cycle(count, total, self.K, self.num_cycles)
 
     def WordErrorRate_TargetFailure(self, target_failures: int, batch_size: int,
                                     max_batches: int, key=None):

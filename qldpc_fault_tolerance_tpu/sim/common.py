@@ -40,21 +40,37 @@ def accumulate_device(step_fn, keys, combine):
 
 def accumulate_counts(count_fn, keys) -> int:
     """Sum device scalar counts over batches with ONE final host sync."""
-    total = accumulate_device(count_fn, keys, lambda a, b: a + b)
-    return 0 if total is None else int(total)
+    from ..utils.observability import stage_timer
+
+    with stage_timer("device_dispatch"):
+        total = accumulate_device(count_fn, keys, lambda a, b: a + b)
+    if total is None:
+        return 0
+    with stage_timer("device_sync"):
+        return int(total)
 
 
 def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     """Failure counting for host-assisted (OSD) paths: keep ``in_flight``
     batches of device work pending so compute overlaps the host transfers,
-    without holding every batch's outputs in HBM at once."""
+    without holding every batch's outputs in HBM at once.
+
+    Per-stage wall-clock lands in utils.observability.timings():
+    "launch" (async device dispatch), "finish" (device->host transfer +
+    host postprocess + checks; the OSD slice inside it is separately
+    tracked as "osd_host" by decoders/osd.py)."""
+    from ..utils.observability import stage_timer
+
     window, count = [], 0
     for k in keys:
-        window.append(launch(k))
+        with stage_timer("launch"):
+            window.append(launch(k))
         if len(window) >= in_flight:
-            count += int(np.asarray(finish(window.pop(0))).sum())
+            with stage_timer("finish"):
+                count += int(np.asarray(finish(window.pop(0))).sum())
     while window:
-        count += int(np.asarray(finish(window.pop(0))).sum())
+        with stage_timer("finish"):
+            count += int(np.asarray(finish(window.pop(0))).sum())
     return count
 
 

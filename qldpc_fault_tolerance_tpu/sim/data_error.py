@@ -18,8 +18,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..decoders.bp_decoders import decode_device
 from ..noise import depolarizing_xz
-from ..ops.linalg import ParityOp, gf2_matmul
+from ..ops.linalg import ParityOp, gf2_matmul, parity_apply
 from .common import (
     ShotBatcher,
     mesh_batch_stats,
@@ -28,6 +29,89 @@ from .common import (
 )
 
 __all__ = ["CodeSimulator_DataError"]
+
+
+# ---------------------------------------------------------------------------
+# Value-based device pipeline (module-level; see sim/phenom.py): the jit
+# cache is keyed on ``cfg`` = (batch_size, N, eval_logical_type, dx_static,
+# dz_static); all arrays — parity gathers, logicals, channel probs, decoder
+# LLRs — ride in the ``state`` pytree, so a p-sweep (or equal-shape codes)
+# shares one executable per structure.
+def _parity(par, bits):
+    return parity_apply(par[0], par[1], bits)
+
+
+def _sample_and_bp(cfg, state, key):
+    batch_size, n = cfg[0], cfg[1]
+    error_x, error_z = depolarizing_xz(key, (batch_size, n), state["probs"])
+    synd_z = _parity(state["hx_par"], error_z)     # src/Simulators.py:127
+    synd_x = _parity(state["hz_par"], error_x)     # src/Simulators.py:131
+    cor_z, aux_z = decode_device(cfg[4], state["dz"], synd_z)
+    cor_x, aux_x = decode_device(cfg[3], state["dx"], synd_x)
+    return error_x, error_z, synd_x, synd_z, cor_x, cor_z, aux_x, aux_z
+
+
+def _check(cfg, state, error_x, error_z, cor_x, cor_z):
+    """Residual stabilizer/logical checks (src/Simulators.py:135-168)."""
+    n, eval_type = cfg[1], cfg[2]
+    residual_x = error_x ^ cor_x
+    residual_z = error_z ^ cor_z
+    x_stab = _parity(state["hz_par"], residual_x).any(axis=-1)
+    x_log = gf2_matmul(residual_x, state["lz_t"]).any(axis=-1)
+    z_stab = _parity(state["hx_par"], residual_z).any(axis=-1)
+    z_log = gf2_matmul(residual_z, state["lx_t"]).any(axis=-1)
+    x_failure = x_stab | x_log
+    z_failure = z_stab | z_log
+    if eval_type == "X":
+        fail = x_failure
+    elif eval_type == "Z":
+        fail = z_failure
+    else:
+        fail = x_failure | z_failure
+    # min residual weight among logical failures (min_logical_weight track)
+    wx = jnp.where(x_log, residual_x.sum(axis=-1), n)
+    wz = jnp.where(z_log, residual_z.sum(axis=-1), n)
+    return fail, jnp.minimum(wx.min(), wz.min())
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _sample_and_bp_jit(cfg, state, key):
+    return _sample_and_bp(cfg, state, key)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _check_jit(cfg, state, error_x, error_z, cor_x, cor_z):
+    return _check(cfg, state, error_x, error_z, cor_x, cor_z)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_stats(cfg, state, key):
+    """One batch fully on device: (failure count, min logical weight).
+    No host transfer — callers accumulate these device scalars across
+    batches and read back once per sweep (the tunneled TPU pays ~100ms
+    latency per device->host transfer; per-batch syncs would dominate)."""
+    ex, ez, _, _, cx, cz, _, _ = _sample_and_bp(cfg, state, key)
+    fail, min_w = _check(cfg, state, ex, ez, cx, cz)
+    return fail.sum(dtype=jnp.int32), min_w
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "chunk"))
+def _chunk_stats(cfg, state, key, offset, chunk: int):
+    """``chunk`` batches as one dispatch: ``lax.scan`` over batch index,
+    failure count and min logical weight accumulated on device.  The
+    batch offset is a traced argument so every chunk of a run (and every
+    run) reuses one compilation."""
+
+    def body(carry, j):
+        k = jax.random.fold_in(key, offset + j)
+        ex, ez, _, _, cx, cz, _, _ = _sample_and_bp(cfg, state, k)
+        fail, min_w = _check(cfg, state, ex, ez, cx, cz)
+        cnt, mw = carry
+        return (cnt + fail.sum(dtype=jnp.int32), jnp.minimum(mw, min_w)), ()
+
+    init = (jnp.zeros((), jnp.int32), jnp.asarray(cfg[1], jnp.int32))
+    (cnt, mw), _ = jax.lax.scan(body, init, jnp.arange(chunk))
+    return cnt, mw
 
 
 class CodeSimulator_DataError:
@@ -64,6 +148,13 @@ class CodeSimulator_DataError:
         self._needs_host = (
             decoder_x.needs_host_postprocess or decoder_z.needs_host_postprocess
         )
+        self._dev_state = {
+            "hx_par": (self._hx_par.nbr, self._hx_par.mask),
+            "hz_par": (self._hz_par.nbr, self._hz_par.mask),
+            "lx_t": self._lx_t, "lz_t": self._lz_t,
+            "probs": jnp.asarray(self.channel_probs, jnp.float32),
+            "dx": decoder_x.device_state, "dz": decoder_z.device_state,
+        }
         # Optionally fuse the two sector decodes into one kernel call when
         # both are plain BP with identical settings (bit-identical results,
         # one iteration loop / straggler tail instead of two).  Off by
@@ -79,59 +170,38 @@ class CodeSimulator_DataError:
                 self._fused = FusedBPPair(decoder_x, decoder_z)
 
     # ------------------------------------------------------------------
-    # device stages
+    # device stages (delegating to the shared value-based pipeline; the
+    # legacy fused-pair experiment keeps its per-instance path)
     # ------------------------------------------------------------------
-    def _sample_and_bp_impl(self, key, batch_size: int):
+    def _cfg(self, batch_size: int):
+        return (batch_size, self.N, self.eval_logical_type,
+                self.decoder_x.device_static, self.decoder_z.device_static)
+
+    def _sample_and_bp(self, key, batch_size: int):
+        if self._fused is not None:
+            return self._sample_and_bp_fused(key, batch_size)
+        return _sample_and_bp_jit(self._cfg(batch_size), self._dev_state, key)
+
+    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
+    def _sample_and_bp_fused(self, key, batch_size: int):
         probs = tuple(self.channel_probs)
         error_x, error_z = depolarizing_xz(key, (batch_size, self.N), probs)
-        synd_z = self._hx_par(error_z)             # src/Simulators.py:127
-        synd_x = self._hz_par(error_x)             # src/Simulators.py:131
-        if self._fused is not None:
-            cor_x, cor_z = self._fused.decode_pair_device(synd_x, synd_z)
-            return error_x, error_z, synd_x, synd_z, cor_x, cor_z, {}, {}
-        cor_z, aux_z = self.decoder_z.decode_batch_device(synd_z)
-        cor_x, aux_x = self.decoder_x.decode_batch_device(synd_x)
-        return error_x, error_z, synd_x, synd_z, cor_x, cor_z, aux_x, aux_z
+        synd_z = self._hx_par(error_z)
+        synd_x = self._hz_par(error_x)
+        cor_x, cor_z = self._fused.decode_pair_device(synd_x, synd_z)
+        return error_x, error_z, synd_x, synd_z, cor_x, cor_z, {}, {}
 
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
-    def _sample_and_bp(self, key, batch_size: int):
-        return self._sample_and_bp_impl(key, batch_size)
-
-    def _check_failures_impl(self, error_x, error_z, cor_x, cor_z):
-        """Residual stabilizer/logical checks (src/Simulators.py:135-168)."""
-        residual_x = error_x ^ cor_x
-        residual_z = error_z ^ cor_z
-        x_stab = self._hz_par(residual_x).any(axis=-1)
-        x_log = gf2_matmul(residual_x, self._lz_t).any(axis=-1)
-        z_stab = self._hx_par(residual_z).any(axis=-1)
-        z_log = gf2_matmul(residual_z, self._lx_t).any(axis=-1)
-        x_failure = x_stab | x_log
-        z_failure = z_stab | z_log
-        if self.eval_logical_type == "X":
-            fail = x_failure
-        elif self.eval_logical_type == "Z":
-            fail = z_failure
-        else:
-            fail = x_failure | z_failure
-        # min residual weight among logical failures (min_logical_weight track)
-        wx = jnp.where(x_log, residual_x.sum(axis=-1), self.N)
-        wz = jnp.where(z_log, residual_z.sum(axis=-1), self.N)
-        return fail, jnp.minimum(wx.min(), wz.min())
-
-    @functools.partial(jax.jit, static_argnames=("self",))
     def _check_failures(self, error_x, error_z, cor_x, cor_z):
-        return self._check_failures_impl(error_x, error_z, cor_x, cor_z)
+        return _check_jit(self._cfg(error_x.shape[0]), self._dev_state,
+                          error_x, error_z, cor_x, cor_z)
 
     # ------------------------------------------------------------------
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _device_batch_stats(self, key, batch_size: int):
         """One batch fully on device: (failure count, min logical weight).
         No host transfer — callers accumulate these device scalars across
         batches and read back once per sweep (the tunneled TPU pays ~100ms
         latency per device->host transfer; per-batch syncs would dominate)."""
-        ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp_impl(key, batch_size)
-        fail, min_w = self._check_failures_impl(ex, ez, cx, cz)
-        return fail.sum(dtype=jnp.int32), min_w
+        return _batch_stats(self._cfg(batch_size), self._dev_state, key)
 
     # default batches per compiled scan dispatch (``scan_chunk`` ctor arg):
     # large enough that the ~40-60ms per-dispatch tunnel overhead is
@@ -140,35 +210,16 @@ class CodeSimulator_DataError:
     # whole run is one dispatch
     _SCAN_CHUNK = 8
 
-    @functools.partial(
-        jax.jit, static_argnames=("self", "batch_size", "chunk")
-    )
-    def _chunk_stats(self, key, offset, batch_size: int, chunk: int):
-        """``chunk`` batches as one dispatch: ``lax.scan`` over batch index,
-        failure count and min logical weight accumulated on device.  The
-        batch offset is a traced argument so every chunk of a run (and every
-        run) reuses one compilation."""
-
-        def body(carry, j):
-            k = jax.random.fold_in(key, offset + j)
-            ex, ez, _, _, cx, cz, _, _ = self._sample_and_bp_impl(k, batch_size)
-            fail, min_w = self._check_failures_impl(ex, ez, cx, cz)
-            cnt, mw = carry
-            return (cnt + fail.sum(dtype=jnp.int32), jnp.minimum(mw, min_w)), ()
-
-        init = (jnp.zeros((), jnp.int32), jnp.asarray(self.N, jnp.int32))
-        (cnt, mw), _ = jax.lax.scan(body, init, jnp.arange(chunk))
-        return cnt, mw
-
     def _device_run_stats(self, key, batch_size: int, n_batches: int):
         """Run ``n_batches`` batches in fixed-size scan chunks; device scalars
         accumulate across the (async) chunk dispatches.  Returns device
         scalars — the caller's materialization is the only host sync."""
         chunk = min(n_batches, self._scan_chunk)
+        cfg = self._cfg(batch_size)
         cnt, mw = 0, jnp.asarray(self.N, jnp.int32)
         for start in range(0, n_batches, chunk):
-            c, w = self._chunk_stats(
-                key, jnp.asarray(start, jnp.int32), batch_size, chunk
+            c, w = _chunk_stats(
+                cfg, self._dev_state, key, jnp.asarray(start, jnp.int32), chunk
             )
             cnt, mw = cnt + c, jnp.minimum(mw, w)
         return cnt, mw

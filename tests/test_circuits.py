@@ -365,3 +365,42 @@ def test_dem_measurement_collapse_conjugate_plane():
     c.append("DETECTOR", [target_rec(-1)])
     dem = detector_error_model(c)
     assert dem.errors == []
+
+
+def test_sampler_structure_cache_shares_compile_but_not_probs():
+    """Two memory circuits differing only in error rate share one compiled
+    sampler (structure_key equal) yet sample from their own probabilities:
+    the noise rides in as a traced argument, never baked."""
+    import jax
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.circuits import FrameSampler
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.sim.circuit import build_memory_circuit
+    from qldpc_fault_tolerance_tpu.circuits import ColorationCircuit
+
+    code = hgp(rep_code(3), rep_code(3))
+    sx, sz = ColorationCircuit(code.hx), ColorationCircuit(code.hz)
+
+    def sampler(p):
+        ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
+              "p_idling_gate": 0}
+        circ = build_memory_circuit(code, 3, ep, sx, sz, spacetime=False)
+        return FrameSampler(circ)
+
+    lo, hi = sampler(0.001), sampler(0.2)
+    assert lo._structure_key == hi._structure_key
+    assert lo == hi and hash(lo) == hash(hi)
+    key = jax.random.PRNGKey(0)
+    d_lo, _ = lo.sample(key, 512)
+    d_hi, _ = hi.sample(key, 512)
+    # same compiled program, different probs -> very different detector rates
+    r_lo = float(np.asarray(d_lo).mean())
+    r_hi = float(np.asarray(d_hi).mean())
+    assert r_lo < 0.02 < r_hi
+    # different structure (cycle count) -> different key
+    ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 0.001,
+          "p_idling_gate": 0}
+    other = FrameSampler(build_memory_circuit(code, 5, ep, sx, sz,
+                                              spacetime=False))
+    assert other._structure_key != lo._structure_key

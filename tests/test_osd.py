@@ -105,3 +105,30 @@ def test_osd_order_improves_or_matches():
     d0 = osd_decode_batch(h, s[None], llr[None], p, osd_method="osd_0")
     d10 = osd_decode_batch(h, s[None], llr[None], p, osd_method="osd_e", osd_order=10)
     assert cost @ d10[0] <= cost @ d0[0] + 1e-9
+
+
+def test_osd_prior_above_half_prefers_setting_bit():
+    """A channel prior > 1/2 gives a *negative* flip cost: the most probable
+    coset element sets that bit even when a cheaper-weight alternative
+    exists.  (A clamp-to-positive cost would silently invert this.)"""
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.decoders.osd import osd_decode_batch
+
+    h = np.array([[1, 1, 0], [0, 1, 1]], dtype=np.uint8)
+    # bit 2 prior 0.9: for syndrome (0,1) candidates are {e2} (cost
+    # log(.1/.9) < 0) and {e0, e1}; the negative-cost single bit must win
+    probs = np.array([0.01, 0.01, 0.9])
+    out = osd_decode_batch(
+        h, np.array([[0, 1]], np.uint8), np.zeros((1, 3), np.float32), probs,
+        osd_method="osd_e", osd_order=3,
+    )
+    assert out[0].tolist() == [0, 0, 1]
+    # and for syndrome (0,0): setting bit 2 alone violates check 2, but the
+    # all-zero word costs MORE than {e1, e2}? cost(e1)+cost(e2) =
+    # log(99)+log(1/9) > 0 -> all-zero still wins
+    out0 = osd_decode_batch(
+        h, np.array([[0, 0]], np.uint8), np.zeros((1, 3), np.float32), probs,
+        osd_method="osd_e", osd_order=3,
+    )
+    assert out0[0].tolist() == [0, 0, 0]

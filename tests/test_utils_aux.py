@@ -68,3 +68,36 @@ def test_code_family_resumes_from_checkpoint(tmp_path):
                        checkpoint=SweepCheckpoint(path))
     assert wer2[0, 0] == 0.424242
     assert wer2[0, 1] == wer1[0, 1]
+
+
+def test_engine_stage_timings_populate():
+    """After a BPOSD sweep, timings() must show the per-stage breakdown
+    (launch / finish / osd_host) so "what fraction is OSD" is answerable
+    without external profiling."""
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+    from qldpc_fault_tolerance_tpu.utils.observability import (
+        reset_timings,
+        timings,
+    )
+
+    reset_timings()
+    code = hgp(rep_code(3), rep_code(3))
+    p = 0.08  # high enough that some shots fail BP and reach OSD
+    dec_x = BPOSD_Decoder(code.hz, np.full(code.N, p), max_iter=4)
+    dec_z = BPOSD_Decoder(code.hx, np.full(code.N, p), max_iter=4)
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3, p / 3, p / 3], batch_size=64, seed=0,
+    )
+    sim.WordErrorRate(256)
+    t = timings()
+    assert "launch" in t and "finish" in t
+    assert t["launch"]["count"] >= 4
+    # OSD stage appears whenever any shot failed BP (overwhelmingly likely
+    # at p=0.08 over 256 shots; tolerate the alternative)
+    if "osd_host" in t:
+        assert t["osd_host"]["total_s"] >= 0
