@@ -35,11 +35,30 @@ class _Shim:
 
 
 class _PermissiveUnpickler(pickle.Unpickler):
+    """Shims unresolvable classes — and classes the compat layer stubs with
+    *functions* (bposd.hgp.hgp): NEWOBJ needs a type.  Stub packages are
+    recognized by the ``__qldpc_stub__`` marker compat.install() sets on
+    them (single source of truth); everything else resolvable passes
+    through untouched (numpy's ``_reconstruct`` is a function legitimately
+    used via REDUCE and must not be shimmed)."""
+
+    @staticmethod
+    def _is_stub_module(module: str) -> bool:
+        import sys as _sys
+
+        top = _sys.modules.get(module.split(".")[0])
+        return bool(getattr(top, "__qldpc_stub__", False))
+
     def find_class(self, module, name):
         try:
-            return super().find_class(module, name)
+            obj = super().find_class(module, name)
         except Exception:
-            return type(name, (_Shim,), {"__module__": module})
+            obj = None
+        if obj is not None and (
+            isinstance(obj, type) or not self._is_stub_module(module)
+        ):
+            return obj
+        return type(name, (_Shim,), {"__module__": module})
 
 
 def load_object(filename: str):

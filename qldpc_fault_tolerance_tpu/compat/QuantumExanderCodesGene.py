@@ -16,7 +16,8 @@ from ..codes import (
     random_biregular_tanner,
     tanner_girth,
 )
-from ..codes.loaders import load_object, save_object
+from ..codes.loaders import save_object
+from ._paths import load_object_compat as load_object
 
 __all__ = [
     "Girth", "QuantumExpanderFromCheckMat", "save_object", "load_object",

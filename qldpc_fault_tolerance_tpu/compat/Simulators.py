@@ -1,5 +1,6 @@
 """Reference ``src/Simulators.py`` API, backed by the TPU engines."""
-from ..codes.loaders import load_object, save_object
+from ..codes.loaders import save_object
+from ._paths import load_object_compat as load_object
 from ..sim import (
     CodeSimulator_Circuit,
     CodeSimulator_DataError,

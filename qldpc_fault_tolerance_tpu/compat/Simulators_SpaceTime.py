@@ -4,7 +4,8 @@ The reference file duplicates the plain-stack classes verbatim (SURVEY §1
 note); the shim re-exports the unified implementations under both names.
 """
 from ..circuits import GenCorrecHyperGraph, GenFaultHyperGraph
-from ..codes.loaders import load_object, save_object
+from ..codes.loaders import save_object
+from ._paths import load_object_compat as load_object
 from ..sim import (
     CodeSimulator_Circuit_SpaceTime,
     CodeSimulator_DataError,

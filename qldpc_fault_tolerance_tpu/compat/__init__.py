@@ -59,6 +59,7 @@ def _install_ldpc_stub() -> None:
     from ..decoders import BPDecoder
 
     ldpc = types.ModuleType("ldpc")
+    ldpc.__qldpc_stub__ = True  # marks function-valued stand-ins for pickle
     ldpc.bp_decoder = BPDecoder  # same ctor keywords + .decode contract
     codes_mod = types.ModuleType("ldpc.codes")
     codes_mod.rep_code = rep_code
@@ -88,6 +89,7 @@ def _install_bposd_stub() -> None:
     from ..decoders import BPOSD_Decoder
 
     bposd = types.ModuleType("bposd")
+    bposd.__qldpc_stub__ = True  # marks function-valued stand-ins for pickle
     bposd.bposd_decoder = BPOSD_Decoder  # same ctor keywords + .decode
     hgp_mod = types.ModuleType("bposd.hgp")
     hgp_mod.hgp = hgp

@@ -36,9 +36,18 @@ matplotlib.use("Agg")
 """
 
 
-def run(path: str, out_dir: str, timeout: int = 3600):
+def run(path: str, out_dir: str, timeout: int = 3600, cells=None,
+        append_source: str | None = None):
+    """``cells``: optional list of cell indices to keep (a "trimmed" run —
+    cells are untouched, just selected).  ``append_source``: optional extra
+    driver cell appended at the end."""
     nb = nbformat.read(path, as_version=4)
     executed = copy.deepcopy(nb)
+    if cells is not None:
+        keep = set(cells)
+        executed.cells = [c for i, c in enumerate(executed.cells) if i in keep]
+    if append_source:
+        executed.cells.append(nbformat.v4.new_code_cell(append_source))
     boot = nbformat.v4.new_code_cell(BOOTSTRAP)
     # nbformat >=5.1 requires ids; new_code_cell provides one
     executed.cells.insert(0, boot)
@@ -89,9 +98,15 @@ def main():
     ap.add_argument("notebook")
     ap.add_argument("--out", default=os.path.join(REPO, "examples", "executed"))
     ap.add_argument("--timeout", type=int, default=3600)
+    ap.add_argument("--cells", type=int, nargs="*", default=None,
+                    help="cell indices to keep (trimmed run)")
+    ap.add_argument("--append-cell", default=None,
+                    help="extra driver cell source appended at the end")
     args = ap.parse_args()
-    executed = run(args.notebook, args.out, args.timeout)
-    if re.search(r"SpaceTimeDecodingDemo", args.notebook):
+    cells = args.cells if args.cells else None  # bare --cells = full run
+    executed = run(args.notebook, args.out, args.timeout, cells=cells,
+                   append_source=args.append_cell)
+    if re.search(r"SpaceTimeDecodingDemo", args.notebook) and cells is None:
         check_demo_wer(executed)
 
 
