@@ -194,13 +194,14 @@ EXPERIMENTS = {
 }
 
 
-def run_experiment(name, cycles_list, seeds, scale, batch_size):
+def run_experiment(name, cycles_list, seeds, scale, batch_size,
+                   seed_start=0):
     exp = EXPERIMENTS[name]
     codes = exp["codes"]()
     for cycles in cycles_list:
         published = exp["published"].get(cycles)
         samples = int(exp["samples_base"] * 3 / cycles * scale)
-        for seed in range(seeds):
+        for seed in range(seed_start, seed_start + seeds):
             t0 = time.time()
             wer = np.zeros((len(codes), len(exp["p_list"])))
             for ci, code in enumerate(codes):
@@ -237,11 +238,12 @@ def main():
     ap.add_argument("--seeds", type=int, default=2)
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--batch-size", type=int, default=2048)
+    ap.add_argument("--seed-start", type=int, default=0)
     args = ap.parse_args()
     exp = EXPERIMENTS[args.experiment]
     cycles_list = args.cycles or sorted(exp["published"])
     run_experiment(args.experiment, cycles_list, args.seeds, args.scale,
-                   args.batch_size)
+                   args.batch_size, seed_start=args.seed_start)
 
 
 if __name__ == "__main__":
