@@ -101,8 +101,9 @@ def mode_bposd():
         pauli_error_probs=[p / 3, p / 3, p / 3], batch_size=2048, seed=0,
     )
     key = jax.random.PRNGKey(7)
-    sim.WordErrorRate(2048, key=jax.random.fold_in(key, 0))  # warmup/compile
     shots = 8192
+    # warmup at the SAME shot count: the scan-chunk length is a static shape
+    sim.WordErrorRate(shots, key=jax.random.fold_in(key, 0))
     t0 = time.perf_counter()
     sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
     rate = shots / (time.perf_counter() - t0)

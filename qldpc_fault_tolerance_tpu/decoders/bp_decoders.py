@@ -71,6 +71,62 @@ def decode_device(static, state, syndromes):
     ``decoder.decode_batch_device(syndromes)``.
     """
     kind = static[0]
+    if kind == "bposd_dev":
+        _, bp_static, n, rank, osd_order = static
+        err, aux = decode_device(bp_static, state, syndromes)
+        from ..ops.osd_device import osd_decode_values
+
+        cfg = (n, rank, osd_order, 256)
+        B = syndromes.shape[0]
+        conv = aux["converged"]
+        bad = ~conv
+        if B < 64:
+            def run_small(_):
+                osd_err = osd_decode_values(
+                    cfg, state["osd_packed"], state["osd_cost"],
+                    syndromes, aux["posterior_llr"],
+                )
+                return jnp.where(conv[:, None], err, osd_err)
+
+            # skip the elimination entirely when every shot converged (the
+            # host path's conv.all() early return)
+            out = jax.lax.cond(bad.any(), run_small, lambda _: err,
+                               operand=None)
+            return out, aux
+
+        # straggler compaction (same trick as bp_decode_two_phase): OSD only
+        # the BP-failed shots, gathered into a half-capacity sub-batch; if
+        # more than half the batch failed, fall back to the full batch —
+        # results never depend on the capacity
+        capacity = B // 2
+        idx = jnp.nonzero(bad, size=capacity, fill_value=B)[0]
+        idx_c = jnp.minimum(idx, B - 1)
+
+        def compacted(_):
+            sub = osd_decode_values(
+                cfg, state["osd_packed"], state["osd_cost"],
+                syndromes[idx_c], aux["posterior_llr"][idx_c],
+            )
+            # out-of-range pad indices are dropped by the scatter
+            return err.at[idx].set(sub, mode="drop")
+
+        def full(_):
+            osd_err = osd_decode_values(
+                cfg, state["osd_packed"], state["osd_cost"],
+                syndromes, aux["posterior_llr"],
+            )
+            return jnp.where(conv[:, None], err, osd_err)
+
+        def none(_):
+            return err
+
+        n_bad = bad.sum()
+        out = jax.lax.cond(
+            n_bad == 0, none,
+            lambda o: jax.lax.cond(n_bad <= capacity, compacted, full, o),
+            operand=None,
+        )
+        return out, aux
     if kind == "st_syndrome":
         _, num_rep, m, n, inner = static
         b = syndromes.shape[0]
@@ -103,6 +159,9 @@ def decode_device(static, state, syndromes):
     return res.error, {
         "converged": res.converged, "posterior_llr": res.posterior_llr
     }
+
+
+_decode_device_jit = jax.jit(decode_device, static_argnums=0)
 
 
 class FusedBPPair:
@@ -257,17 +316,76 @@ class BPDecoder:
 class BPOSD_Decoder(BPDecoder):
     """BP + OSD (reference BPOSD_Decoder, src/Decoders.py:26-41).
 
-    BP runs on TPU for the whole batch; OSD post-processing runs in native
-    C++ on host only for the shots whose BP output misses the syndrome.
+    BP runs on TPU for the whole batch.  OSD post-processing runs either
+
+      * **on device** (ops/osd_device.py: batched bit-packed GF(2)
+        elimination + MXU-scored OSD-E reprocessing) — the default on TPU,
+        where it removes the host round-trip entirely and keeps BPOSD
+        pipelines pure device code (mesh-shardable, scan-chunkable); or
+      * **on host** (native C++, _native/osd.cpp) for the shots whose BP
+        output misses the syndrome — the default on CPU backends and for
+        osd_cs (not implemented on device).
+
+    ``device_osd``: True / False / "auto" (TPU => device).  Both paths
+    implement identical semantics (pinned against the same numpy oracle).
     """
 
-    needs_host_postprocess = True
-
     def __init__(self, h, channel_probs, max_iter, bp_method="minimum_sum",
-                 ms_scaling_factor=0.625, osd_method="osd_e", osd_order=10):
+                 ms_scaling_factor=0.625, osd_method="osd_e", osd_order=10,
+                 device_osd="auto"):
         super().__init__(h, channel_probs, max_iter, bp_method, ms_scaling_factor)
         self.osd_method = str(osd_method)
         self.osd_order = int(osd_order)
+        _DEVICE_METHODS = ("osd_e", "osd0", "osd_0", "exhaustive")
+        if device_osd == "auto":
+            env = os.environ.get("QLDPC_DEVICE_OSD", "1")
+            try:
+                on_tpu = jax.default_backend() == "tpu"
+            except Exception:
+                on_tpu = False
+            device_osd = (env != "0" and on_tpu
+                          and self.osd_method in _DEVICE_METHODS)
+        elif device_osd and self.osd_method not in _DEVICE_METHODS:
+            raise NotImplementedError(
+                f"device OSD implements OSD-0/OSD-E only, not "
+                f"{self.osd_method!r}; use device_osd=False"
+            )
+        self.device_osd = bool(device_osd)
+        self._osd_plan = None
+        if self.device_osd:
+            from ..ops.osd_device import build_osd_plan
+
+            self._osd_plan = build_osd_plan(self._h01, self.channel_probs)
+
+    @property
+    def needs_host_postprocess(self):
+        return not self.device_osd
+
+    @property
+    def device_static(self):
+        bp_static = super().device_static
+        if not self.device_osd:
+            return bp_static
+        order = 0 if self.osd_method in ("osd0", "osd_0") else self.osd_order
+        return ("bposd_dev", bp_static, self._osd_plan.n,
+                self._osd_plan.rank, order)
+
+    @property
+    def device_state(self):
+        state = dict(super().device_state)
+        if self.device_osd:
+            state["osd_packed"] = self._osd_plan.packed
+            state["osd_cost"] = self._osd_plan.cost
+        return state
+
+    def decode_batch_device(self, syndromes):
+        if not self.device_osd:
+            return super().decode_batch_device(syndromes)
+        # jitted entry: called eagerly this wraps the whole dispatch in one
+        # program (an eager lax.cond would re-trace its branches per call);
+        # called inside a simulator's trace it simply inlines
+        return _decode_device_jit(self.device_static, self.device_state,
+                                  syndromes)
 
     def host_postprocess(self, syndromes, corrections, aux):
         return self.osd_host(
@@ -279,6 +397,9 @@ class BPOSD_Decoder(BPDecoder):
 
     def decode_batch(self, syndromes) -> np.ndarray:
         syndromes = np.atleast_2d(np.asarray(syndromes))
+        if self.device_osd:
+            out, _ = self.decode_batch_device(jnp.asarray(syndromes))
+            return np.asarray(out)
         res = self.bp_batch_device(jnp.asarray(syndromes))
         return self.osd_host(
             syndromes, np.asarray(res.error), np.asarray(res.converged),
