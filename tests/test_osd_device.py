@@ -122,3 +122,14 @@ def test_bposd_device_inside_engine_matches_host_engine():
     # identical shot streams; OSD ties can flip individual corrections but
     # the corrected-vs-failed outcome distribution must agree closely
     assert abs(wer_host - wer_dev) < 0.05
+
+
+def test_bposd_device_all_converged_skips_osd():
+    """B >= 64 batch where every shot converges must return BP's output
+    (the n_bad == 0 cond branch) — trivially true for zero syndromes."""
+    h = rep_code(9)
+    n = h.shape[1]
+    dec = BPOSD_Decoder(h, np.full(n, 0.1), max_iter=4, device_osd=True)
+    out, aux = dec.decode_batch_device(jnp.zeros((128, h.shape[0]), jnp.uint8))
+    assert np.asarray(aux["converged"]).all()
+    assert not np.asarray(out).any()
