@@ -1,0 +1,49 @@
+"""Physics-parity regression pins.
+
+PARITY_r2.md establishes agreement with the reference's published
+thresholds by multi-seed Monte-Carlo on TPU; re-running that is far too
+slow for CI.  Instead this pins one *deterministic* notebook-convention
+cell (fixed PRNG keys -> bit-reproducible counts on the CPU test backend):
+any future change to the samplers, BP kernel, OSD, or engine round
+structure that alters physics shifts this value and fails loudly.
+
+The pinned value was computed with the exact code that produced the
+round-2 parity results (toric d5, Threshold-cell-25 conventions: q=0,
+BP(N/30) ext dec1, BPOSD(N/10, osd_e-10) dec2, msf 0.625).
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "scripts"))
+
+
+def test_toric_phenl_cell_pinned():
+    import parity
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, ring_code
+
+    code = hgp(ring_code(5), ring_code(5), name="toric_d5")
+    wer = parity.phenl_cell_wer(code, 0.016, 15, 2048, seed=42,
+                                batch_size=1024)
+    # deterministic on THE SUITE BACKEND (8-virtual-device CPU, conftest):
+    # fixed fold_in streams, f32 BP, deterministic OSD tie-breaking.  The
+    # value is backend-specific (XLA codegen changes with the virtual
+    # device flag); the statistical-band test below is the env-robust one.
+    np.testing.assert_allclose(wer, 0.005333239320124417, rtol=1e-12)
+
+
+def test_toric_phenl_cell_statistical_band():
+    """Same cell, independent seed: the WER must stay inside a generous
+    binomial band around the pinned estimate — a backend-robust check that
+    survives platform-dependent tie-breaking."""
+    import parity
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, ring_code
+
+    code = hgp(ring_code(5), ring_code(5), name="toric_d5")
+    wer = parity.phenl_cell_wer(code, 0.016, 15, 2048, seed=1042,
+                                batch_size=1024)
+    assert 0.003 < wer < 0.008, wer
