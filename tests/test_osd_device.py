@@ -133,3 +133,27 @@ def test_bposd_device_all_converged_skips_osd():
     out, aux = dec.decode_batch_device(jnp.zeros((128, h.shape[0]), jnp.uint8))
     assert np.asarray(aux["converged"]).all()
     assert not np.asarray(out).any()
+
+
+def test_pallas_elimination_matches_xla_interpret():
+    """The experimental Pallas RREF (interpret mode on CPU) must be
+    bit-identical to the XLA elimination on every output."""
+    import jax
+
+    from qldpc_fault_tolerance_tpu.ops import osd_device as od
+
+    rng = np.random.default_rng(3)
+    h = (rng.random((12, 24)) < 0.22).astype(np.uint8)
+    h[:, h.sum(0) == 0] = 1
+    plan = od.build_osd_plan(h, rng.uniform(0.01, 0.3, 24))
+    synds = ((rng.random((8, 24)) < 0.1).astype(np.uint8) @ h.T % 2).astype(
+        np.uint8)
+    llrs = rng.normal(0, 2, (8, 24)).astype(np.float32)
+    perm = jnp.argsort(jnp.asarray(llrs), axis=1, stable=True).astype(
+        jnp.int32)
+    ref = od._eliminate(plan, perm, jnp.asarray(synds))
+    pal = od._eliminate_pallas(plan, perm, jnp.asarray(synds), bt=8,
+                               interpret=True)
+    for a, b in zip(ref, pal):
+        a = np.asarray(a)
+        assert np.array_equal(a, np.asarray(b).astype(a.dtype))
