@@ -22,6 +22,46 @@ Layers (bottom to top):
 
 __version__ = "0.1.0"
 
+import os as _os
+import sys as _sys
+
+
+def _enable_compilation_cache():
+    """Turn on JAX's persistent compilation cache process-wide.
+
+    Every sweep/parity/notebook subprocess otherwise pays a fresh 20-45s XLA
+    compile per (code-shape, pipeline) pair; with the cache, only the first
+    process ever does.  Opt out with QLDPC_TPU_NO_COMPILE_CACHE=1; relocate
+    with QLDPC_TPU_COMPILE_CACHE=<dir>.
+    """
+    if _os.environ.get("QLDPC_TPU_NO_COMPILE_CACHE", "").lower() in ("1", "true", "yes"):
+        return
+    cache_dir = _os.environ.get(
+        "QLDPC_TPU_COMPILE_CACHE",
+        _os.path.expanduser("~/.cache/qldpc_tpu/jax"),
+    )
+    try:
+        _os.makedirs(cache_dir, exist_ok=True)
+    except OSError:
+        return
+    # env vars so merely importing this package does not import jax; they are
+    # the documented equivalents of the jax.config names
+    _os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+    _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    if "jax" in _sys.modules:  # jax imported first: env defaults already read
+        import jax
+
+        # never override a cache the user already configured (env var read
+        # at jax import, or an explicit jax.config.update)
+        if jax.config.jax_compilation_cache_dir is None:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+
+_enable_compilation_cache()
+
 from . import codes
 
 __all__ = ["codes", "__version__"]

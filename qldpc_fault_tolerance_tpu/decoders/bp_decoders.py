@@ -224,6 +224,7 @@ class BPDecoder:
                  ms_scaling_factor=0.625, two_phase: bool = True):
         self.h = np.asarray(h)
         self._h01 = gf2.to_gf2(h)
+        self._graph_host = bp.build_tanner_graph_host(self._h01)
         self.graph = bp.build_tanner_graph(self._h01)
         self.channel_probs = np.broadcast_to(
             np.asarray(channel_probs, np.float64), (self._h01.shape[1],)
@@ -252,7 +253,7 @@ class BPDecoder:
             if on_tpu:
                 from ..ops.bp_pallas import build_pallas_head
 
-                pg = build_pallas_head(self.graph)
+                pg = build_pallas_head(self._graph_host)
                 if pg.fits_vmem():
                     self._pallas_head = pg
 

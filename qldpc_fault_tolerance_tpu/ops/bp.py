@@ -33,6 +33,7 @@ import numpy as np
 __all__ = [
     "TannerGraph",
     "build_tanner_graph",
+    "build_tanner_graph_host",
     "bp_decode",
     "bp_decode_two_phase",
     "BPResult",
@@ -57,8 +58,71 @@ class TannerGraph(NamedTuple):
     h_t: jnp.ndarray              # (n, m) uint8 — transpose kept for host-side uses
 
 
+class _LruCache:
+    """Tiny bounded memo for per-H build artifacts.
+
+    Sweeps rebuild decoders per (code, p) cell; the Tanner graph, Pallas
+    incidence stack, and OSD packing depend only on H, so memoizing them
+    turns per-cell decoder construction from seconds (host rebuild + device
+    uploads over a tunneled chip) into a dict hit.  Bounded so long-lived
+    multi-circuit sweeps don't pin retired structures (per advisor note on
+    the FrameSampler cache)."""
+
+    def __init__(self, maxsize: int = 128):
+        from collections import OrderedDict
+
+        self._d = OrderedDict()
+        self.maxsize = maxsize
+
+    def get(self, key, make):
+        try:
+            self._d.move_to_end(key)
+            return self._d[key]
+        except KeyError:
+            val = self._d[key] = make()
+            if len(self._d) > self.maxsize:
+                self._d.popitem(last=False)
+            return val
+
+
+_graph_host_cache = _LruCache()
+_graph_dev_cache = _LruCache()
+
+
+def _h_key(h: np.ndarray):
+    return (h.shape, h.tobytes())
+
+
 def build_tanner_graph(h: np.ndarray) -> TannerGraph:
-    """Compile H (host 0/1 matrix) into padded adjacency index maps."""
+    """Host-build + one async device upload (no construction-time syncs).
+
+    Memoized on H's contents: repeated decoder constructions against the
+    same parity-check matrix (every p-sweep cell) reuse the device-resident
+    graph."""
+    h = (np.asarray(h) != 0).astype(np.uint8)
+    return _graph_dev_cache.get(
+        _h_key(h), lambda: jax.device_put(build_tanner_graph_host(h))
+    )
+
+
+def build_tanner_graph_host(h: np.ndarray) -> TannerGraph:
+    """Compile H (host 0/1 matrix) into padded adjacency index maps.
+
+    Returns numpy-leaved ``TannerGraph`` — callers that need host access
+    (e.g. the Pallas incidence-stack builder) use this form to avoid
+    device->host round-trips at decoder-construction time.  Memoized on H."""
+    h = (np.asarray(h) != 0).astype(np.uint8)
+
+    def make():
+        g = _build_tanner_graph_host(h)
+        for leaf in g:  # shared across callers — guard against mutation
+            leaf.setflags(write=False)
+        return g
+
+    return _graph_host_cache.get(_h_key(h), make)
+
+
+def _build_tanner_graph_host(h: np.ndarray) -> TannerGraph:
     h = (np.asarray(h) != 0).astype(np.uint8)
     m, n = h.shape
     rows = [np.nonzero(h[i])[0] for i in range(m)]
@@ -87,13 +151,13 @@ def build_tanner_graph(h: np.ndarray) -> TannerGraph:
             var_fill[j] += 1
 
     return TannerGraph(
-        chk_nbr=jnp.asarray(chk_nbr),
-        chk_nbr_slot=jnp.asarray(chk_nbr_slot),
-        var_nbr=jnp.asarray(var_nbr),
-        var_nbr_slot=jnp.asarray(var_nbr_slot),
-        chk_mask=jnp.asarray(chk_mask),
-        var_mask=jnp.asarray(var_mask),
-        h_t=jnp.asarray(h.T),
+        chk_nbr=chk_nbr,
+        chk_nbr_slot=chk_nbr_slot,
+        var_nbr=var_nbr,
+        var_nbr_slot=var_nbr_slot,
+        chk_mask=chk_mask,
+        var_mask=var_mask,
+        h_t=np.ascontiguousarray(h.T),
     )
 
 
@@ -105,9 +169,13 @@ class BPResult(NamedTuple):
 
 
 def llr_from_probs(channel_probs) -> jnp.ndarray:
-    """Channel log-likelihood ratios log((1-p)/p), clipped away from p=0."""
-    p = jnp.clip(jnp.asarray(channel_probs, dtype=jnp.float32), 1e-12, 1.0 - 1e-7)
-    return jnp.log1p(-p) - jnp.log(p)
+    """Channel log-likelihood ratios log((1-p)/p), clipped away from p=0.
+
+    Computed in numpy and uploaded with one async ``device_put``: decoder
+    construction must not dispatch tiny device ops (each costs a full
+    round-trip on a tunneled chip)."""
+    p = np.clip(np.asarray(channel_probs, dtype=np.float32), 1e-12, 1.0 - 1e-7)
+    return jax.device_put(np.log1p(-p) - np.log(p))
 
 
 def _check_update_minsum(v2c, synd_sign, graph, scale):

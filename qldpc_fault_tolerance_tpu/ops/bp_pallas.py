@@ -87,19 +87,34 @@ class PallasHeadGraph(NamedTuple):
         return self.scat_bytes <= _SCAT_VMEM_LIMIT
 
 
+from .bp import _LruCache  # noqa: E402  (shared bounded memo)
+
+_head_cache = _LruCache()
+
+
 def build_pallas_head(graph: TannerGraph) -> PallasHeadGraph:
-    """Build the slot-major one-hot incidence stack from a TannerGraph."""
+    """Build the slot-major one-hot incidence stack from a TannerGraph.
+
+    Pass a numpy-leaved graph (``build_tanner_graph_host``) to avoid
+    device->host round-trips.  Memoized on the adjacency contents."""
     chk_nbr = np.asarray(graph.chk_nbr)
     chk_mask = np.asarray(graph.chk_mask)
-    m, rw = chk_nbr.shape
     n = graph.var_nbr.shape[0]
+    key = (chk_nbr.shape, n, chk_nbr.tobytes(), chk_mask.tobytes())
+    return _head_cache.get(key, lambda: _build_pallas_head(chk_nbr, chk_mask, n))
+
+
+def _build_pallas_head(chk_nbr, chk_mask, n: int) -> PallasHeadGraph:
+    m, rw = chk_nbr.shape
     scat = np.zeros((rw, m, n), dtype=np.float32)
     for s in range(rw):
         rows = np.nonzero(chk_mask[:, s])[0]
         scat[s, rows, chk_nbr[rows, s]] = 1.0
+    import ml_dtypes
+
     return PallasHeadGraph(
-        scat=jnp.asarray(scat, jnp.bfloat16),
-        mask=jnp.asarray(chk_mask.T.astype(np.float32)),
+        scat=jax.device_put(scat.astype(ml_dtypes.bfloat16)),
+        mask=jax.device_put(chk_mask.T.astype(np.float32)),
     )
 
 

@@ -38,21 +38,38 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["OsdPlan", "build_osd_plan", "osd_decode_device"]
 
 
+from .bp import _LruCache  # shared bounded memo (see ops/bp.py)
+
+_pack_cache = _LruCache()
+
+
+def _pack_h(h: np.ndarray):
+    """(rank, device bit-packed rows) of H — p-independent, memoized so
+    p-sweeps rebuilding BPOSD decoders per cell don't re-rank/re-upload."""
+    from ..codes import gf2
+
+    def make():
+        m, n = h.shape
+        words = (n + 31) // 32
+        hp = np.pad(h, ((0, 0), (0, words * 32 - n)))
+        packed = (
+            hp.reshape(m, words, 32).astype(np.uint64)
+            << np.arange(32, dtype=np.uint64)
+        ).sum(axis=2).astype(np.uint32)
+        return int(gf2.rank(h)), jax.device_put(packed)
+
+    return _pack_cache.get((h.shape, h.tobytes()), make)
+
+
 class OsdPlan:
     """Static per-H data for device OSD (hashable: used in jit cache keys)."""
 
     def __init__(self, h: np.ndarray, channel_cost: np.ndarray):
-        from ..codes import gf2
-
         h = (np.asarray(h) != 0).astype(np.uint8)
         self.m, self.n = h.shape
-        self.rank = int(gf2.rank(h))
         self.words = (self.n + 31) // 32
-        packed = np.zeros((self.m, self.words), dtype=np.uint32)
-        for j in range(self.n):
-            packed[:, j >> 5] |= (h[:, j].astype(np.uint32)) << (j & 31)
-        self.packed = jnp.asarray(packed)
-        self.cost = jnp.asarray(np.asarray(channel_cost, np.float32))
+        self.rank, self.packed = _pack_h(h)
+        self.cost = jax.device_put(np.asarray(channel_cost, np.float32))
         self._key = (self.m, self.n, self.rank,
                      h.tobytes(), np.asarray(channel_cost).tobytes())
 
