@@ -161,26 +161,40 @@ def mode_st_circuit():
     }
 
 
-def mode_phenl_cell():
-    """Wall-clock of one toric phenl threshold point (Threshold ckpt cell 25,
-    cycles=10): 18 (code, p) cells x 3000 samples with BP(N/30) rounds and a
-    BPOSD(N/10) final round.  Reference: 111.3 s (cell 25 second output)."""
+def _warm_sweep_elapsed(experiment: str, cycles: int) -> float:
+    """Run one parity sweep in a subprocess with --warmup and return the
+    recorded warm elapsed_s (see mode_phenl_cell for the protocol)."""
     import subprocess
     import sys as _sys
 
-    t0 = time.perf_counter()
+    script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "parity.py")
     try:
-        subprocess.run(
-            [_sys.executable,
-             os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          "scripts", "parity.py"),
-             "toric_phenl", "--cycles", "10", "--seeds", "1"],
+        proc = subprocess.run(
+            [_sys.executable, script, experiment, "--cycles", str(cycles),
+             "--seeds", "1", "--warmup"],
             check=True, capture_output=True, text=True,
         )
     except subprocess.CalledProcessError as e:
         _sys.stderr.write(e.stderr or "")
         raise
-    elapsed = time.perf_counter() - t0
+    recs = [json.loads(line) for line in proc.stdout.splitlines()
+            if line.startswith("{")]
+    return recs[-1]["elapsed_s"]
+
+
+def mode_phenl_cell():
+    """Wall-clock of one toric phenl threshold point (Threshold ckpt cell 25,
+    cycles=10): 18 (code, p) cells x 3000 samples with BP(N/30) rounds and a
+    BPOSD(N/10) final round.  Reference: 111.3 s (cell 25 second output).
+
+    Timing protocol mirrors the reference's: the 111.3 s notebook entry is a
+    warm-process measurement (cell 25 sweeps cycles {6,10,...} sequentially
+    in one kernel session, so the cycles-10 timer starts with everything
+    already imported/constructed/hot).  ``--warmup`` runs a tiny-scale pass
+    of the same cells first, then the recorded ``elapsed_s`` measures the
+    warm sweep alone."""
+    elapsed = _warm_sweep_elapsed("toric_phenl", 10)
     return {
         "metric": "toric phenl threshold point wall-clock (Threshold cell 25, cycles=10)",
         "value": round(elapsed, 1),
@@ -189,11 +203,27 @@ def mode_phenl_cell():
     }
 
 
+def mode_circuit_cell():
+    """Wall-clock of one hgp circuit-level threshold point (Threshold ckpt
+    cell 29, cycles=10): 18 (code, p) cells x 1800 samples, full circuit
+    synthesis + Pauli-frame detector sampling + per-round BP decoding with
+    a BPOSD final round.  Reference: 318.2 s (cell 29 third output).  Same
+    warm-process protocol as mode_phenl_cell."""
+    elapsed = _warm_sweep_elapsed("hgp_circuit", 10)
+    return {
+        "metric": "hgp circuit threshold point wall-clock (Threshold cell 29, cycles=10)",
+        "value": round(elapsed, 1),
+        "unit": "s",
+        "vs_baseline": round(318.2 / elapsed, 2),
+    }
+
+
 MODES = {
     "bp": mode_bp,
     "bposd": mode_bposd,
     "st_circuit": mode_st_circuit,
     "phenl_cell": mode_phenl_cell,
+    "circuit_cell": mode_circuit_cell,
 }
 
 
@@ -201,10 +231,11 @@ def main():
     mode = os.environ.get("BENCH_MODE", "bp")
     if mode == "all":
         results = {}
-        # phenl_cell first: it spawns a subprocess that needs the (single,
-        # exclusively-held) TPU chip, so it must run before this process's
-        # own JAX initialization claims it for the other modes
-        for name in ("phenl_cell", "bp", "bposd", "st_circuit"):
+        # subprocess modes first: they need the (single, exclusively-held)
+        # TPU chip, so they must run before this process's own JAX
+        # initialization claims it for the other modes
+        for name in ("phenl_cell", "circuit_cell", "bp", "bposd",
+                     "st_circuit"):
             results[name] = MODES[name]()
             print(json.dumps(results[name]))
         here = os.path.dirname(os.path.abspath(__file__))

@@ -49,6 +49,10 @@ def _enable_compilation_cache():
     _os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", cache_dir)
     _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
     _os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+    # traceback frames embedded in MLIR locations depend on process history
+    # (what was traced earlier); they leak into Mosaic kernel payloads and
+    # change the cache key of otherwise-identical programs — strip them
+    _os.environ.setdefault("JAX_TRACEBACK_IN_LOCATIONS_LIMIT", "0")
     if "jax" in _sys.modules:  # jax imported first: env defaults already read
         import jax
 
@@ -58,6 +62,7 @@ def _enable_compilation_cache():
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
             jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            jax.config.update("jax_traceback_in_locations_limit", 0)
 
 
 _enable_compilation_cache()

@@ -12,7 +12,7 @@ src/Simulators_SpaceTime.py:672-1077) and its host-side schedulers
                 over REPEAT blocks)
   dem           detector-error-model derivation + fault-hypergraph extraction
 """
-from .scheduling import ColorationCircuit, RandomCircuit, validate_schedule
+from .scheduling import ColorationCircuit, ColorationCircuitHK, RandomCircuit, validate_schedule
 from .ir import Circuit, target_rec
 from .error_plugin import (
     AddCXError,
@@ -32,6 +32,7 @@ from .dem import (
 
 __all__ = [
     "ColorationCircuit",
+    "ColorationCircuitHK",
     "RandomCircuit",
     "validate_schedule",
     "Circuit",

@@ -159,7 +159,11 @@ def _mergeable(into: Op, op: Op) -> bool:
     if into.kind != op.kind:
         return False
     if into.kind in ("dep1", "dep2", "perr"):
-        return into.p == op.p and into.fx == op.fx and into.fz == op.fz
+        # disjoint support required: the scatter-free sampler applies fused
+        # noise via membership masks, which would collapse a repeated qubit's
+        # k independent channel applications into one
+        return (into.p == op.p and into.fx == op.fx and into.fz == op.fz
+                and not (into.support() & op.support()))
     if into.kind in ("cx", "cz"):
         # one side may repeat, but no qubit may sit on both sides of the
         # fused op (that would reorder a read-after-write)

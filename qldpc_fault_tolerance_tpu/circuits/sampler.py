@@ -39,28 +39,99 @@ def _pad_cols(cols_list: list[list[int]], pad: int) -> np.ndarray:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Scatter-free op application.  Index scatters (``x.at[:, idx].add``) lower
+# to serial per-index updates on TPU — at hgp_34_n1600 scale (2320 qubits,
+# ~200 scatters per sampled batch) they made the sampler ~40 s/batch.  Every
+# gate/noise op is instead expressed with per-op STATIC full-width index
+# maps and masks (compile-time numpy, memoized): one lane-axis gather plus
+# masked XORs over the whole (B, nq) plane, which XLA tiles efficiently.
+@functools.lru_cache(maxsize=8192)
+def _pairmap(a: tuple, b: tuple, nq: int):
+    """Rounds of (src[t]=c / src[c]=t index maps + membership masks).
+
+    The two sides are disjoint (lowering splits cross-side chains), so the
+    pairs commute and any decomposition into rounds with per-round-unique
+    qubits reproduces the simultaneous (accumulating-scatter) semantics —
+    duplicates within a side (one control driving several targets in a
+    fused op) land in later rounds."""
+    cnt: dict[int, int] = {}
+    rounds: dict[int, list[tuple[int, int]]] = {}
+    for qa, qb in zip(a, b):
+        r = max(cnt.get(qa, 0), cnt.get(qb, 0))
+        cnt[qa] = r + 1
+        cnt[qb] = r + 1
+        rounds.setdefault(r, []).append((qa, qb))
+    out = []
+    for r in sorted(rounds):
+        ra = [p[0] for p in rounds[r]]
+        rb = [p[1] for p in rounds[r]]
+        ident = np.arange(nq, dtype=np.int32)
+        src_t = ident.copy()
+        src_t[rb] = ra
+        tmask = np.zeros(nq, np.uint8)
+        tmask[rb] = 1
+        src_c = ident.copy()
+        src_c[ra] = rb
+        cmask = np.zeros(nq, np.uint8)
+        cmask[ra] = 1
+        out.append((src_t, tmask, src_c, cmask))
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=8192)
+def _qmask(q: tuple, nq: int):
+    assert len(set(q)) == len(q), (
+        "noise/gate op with a repeated qubit — lowering must keep "
+        "overlapping ops separate (see _mergeable)"
+    )
+    m = np.zeros(nq, np.uint8)
+    m[list(q)] = 1
+    return m
+
+
+@functools.lru_cache(maxsize=8192)
+def _pair_expand(a: tuple, b: tuple, nq: int):
+    """pairidx[q] = index of q's pair (0 for uninvolved qubits) plus role
+    masks — expands per-pair draws to full qubit width with one gather."""
+    qs = list(a) + list(b)
+    assert len(set(qs)) == len(qs), (
+        "dep2 op with a repeated qubit — lowering must keep overlapping "
+        "noise ops separate (see _mergeable)"
+    )
+    pairidx = np.zeros(nq, np.int32)
+    rolea = np.zeros(nq, np.uint8)
+    roleb = np.zeros(nq, np.uint8)
+    for i, (qa, qb) in enumerate(zip(a, b)):
+        pairidx[qa] = i
+        rolea[qa] = 1
+        pairidx[qb] = i
+        roleb[qb] = 1
+    return pairidx, rolea, roleb
+
+
 def _apply_gate(op: Op, x, z):
+    nq = x.shape[1]
     if op.kind == "cx":
-        c = jnp.asarray(op.a)
-        t = jnp.asarray(op.b)
-        x = x.at[:, t].add(x[:, c]) & 1
-        z = z.at[:, c].add(z[:, t]) & 1
+        for src_t, tmask, src_c, cmask in _pairmap(tuple(op.a), tuple(op.b),
+                                                   nq):
+            x = x ^ (x[:, src_t] & tmask)
+            z = z ^ (z[:, src_c] & cmask)
         return x, z
     if op.kind == "cz":
-        a = jnp.asarray(op.a)
-        b = jnp.asarray(op.b)
-        z = z.at[:, b].add(x[:, a]) & 1
-        z = z.at[:, a].add(x[:, b]) & 1
+        # z_b ^= x_a and z_a ^= x_b: cross-pair gathers on the x plane only
+        # (reads x, writes z — rounds are trivially order-independent)
+        for src_t, tmask, src_c, cmask in _pairmap(tuple(op.a), tuple(op.b),
+                                                   nq):
+            z = z ^ (x[:, src_t] & tmask) ^ (x[:, src_c] & cmask)
         return x, z
     if op.kind == "h":
-        q = jnp.asarray(op.a)
-        xq = x[:, q]
-        x = x.at[:, q].set(z[:, q])
-        z = z.at[:, q].set(xq)
-        return x, z
+        m = _qmask(tuple(op.a), nq)
+        d = (x ^ z) & m
+        return x ^ d, z ^ d
     if op.kind == "reset":
-        q = jnp.asarray(op.a)
-        return x.at[:, q].set(0), z.at[:, q].set(0)
+        keep = 1 - _qmask(tuple(op.a), nq)
+        return x & keep, z & keep
     raise AssertionError(op.kind)
 
 
@@ -68,28 +139,27 @@ def _apply_noise(op: Op, key, x, z, p):
     """``p`` is a traced scalar (probs[op.noise_id]) so probability changes
     don't retrace — only the circuit structure is baked into the program."""
     kop = jax.random.fold_in(key, op.noise_id)
+    nq = x.shape[1]
     if op.kind == "perr":
-        q = jnp.asarray(op.a)
-        u = jax.random.uniform(kop, (x.shape[0], len(op.a)))
-        flips = (u < p).astype(jnp.uint8)
+        # full-width draw + membership mask (scatter-free; see _apply_gate)
+        m = _qmask(tuple(op.a), nq)
+        u = jax.random.uniform(kop, (x.shape[0], nq))
+        flips = (u < p).astype(jnp.uint8) & m
         if op.fx:
-            x = x.at[:, q].add(flips) & 1
+            x = x ^ flips
         if op.fz:
-            z = z.at[:, q].add(flips) & 1
+            z = z ^ flips
         return x, z
     if op.kind == "dep1":
-        q = jnp.asarray(op.a)
-        u = jax.random.uniform(kop, (x.shape[0], len(op.a)))
+        m = _qmask(tuple(op.a), nq)
+        u = jax.random.uniform(kop, (x.shape[0], nq))
         event = u < p
         comp = jnp.clip((u * (3.0 / p)).astype(jnp.int32), 0, 2)
-        fx = (event & (comp <= 1)).astype(jnp.uint8)  # X or Y
-        fz = (event & (comp >= 1)).astype(jnp.uint8)  # Y or Z
-        x = x.at[:, q].add(fx) & 1
-        z = z.at[:, q].add(fz) & 1
-        return x, z
+        fx = (event & (comp <= 1)).astype(jnp.uint8) & m  # X or Y
+        fz = (event & (comp >= 1)).astype(jnp.uint8) & m  # Y or Z
+        return x ^ fx, z ^ fz
     if op.kind == "dep2":
-        a = jnp.asarray(op.a)
-        b = jnp.asarray(op.b)
+        pairidx, rolea, roleb = _pair_expand(tuple(op.a), tuple(op.b), nq)
         u = jax.random.uniform(kop, (x.shape[0], len(op.a)))
         event = u < p
         comp = jnp.clip((u * (15.0 / p)).astype(jnp.int32), 0, 14) + 1
@@ -99,32 +169,38 @@ def _apply_noise(op: Op, key, x, z, p):
         fza = (event & ((p1 == 2) | (p1 == 3))).astype(jnp.uint8)
         fxb = (event & ((p2 == 1) | (p2 == 2))).astype(jnp.uint8)
         fzb = (event & ((p2 == 2) | (p2 == 3))).astype(jnp.uint8)
-        x = x.at[:, a].add(fxa) & 1
-        z = z.at[:, a].add(fza) & 1
-        x = x.at[:, b].add(fxb) & 1
-        z = z.at[:, b].add(fzb) & 1
-        return x, z
+        # expand per-pair flips to full width with one gather per plane-pair
+        fx = (fxa[:, pairidx] & rolea) ^ (fxb[:, pairidx] & roleb)
+        fz = (fza[:, pairidx] & rolea) ^ (fzb[:, pairidx] & roleb)
+        return x ^ fx, z ^ fz
     raise AssertionError(op.kind)
 
 
 def _apply_measure(op: Op, key, x, z, buf, rec_cols):
     """Record measurement flips into buf at rec_cols, then collapse/reset."""
+    nq = x.shape[1]
     q = jnp.asarray(op.a)
     bits = z[:, q] if op.basis == "x" else x[:, q]
-    buf = buf.at[:, jnp.asarray(rec_cols)].set(bits)
+    rc = np.asarray(rec_cols)
+    if rc.size and np.all(np.diff(rc) == 1):
+        buf = jax.lax.dynamic_update_slice(buf, bits, (0, int(rc[0])))
+    else:
+        buf = buf.at[:, jnp.asarray(rec_cols)].set(bits)
     if op.reset_after:
-        x = x.at[:, q].set(0)
-        z = z.at[:, q].set(0)
+        keep = 1 - _qmask(tuple(op.a), nq)
+        x = x & keep
+        z = z & keep
     elif op.collapse:
         # measurement collapse: the conjugate frame plane becomes irrelevant;
         # randomize it so later (anti)commuting ops see no spurious signal
+        m = _qmask(tuple(op.a), nq)
         r = jax.random.bernoulli(
-            jax.random.fold_in(key, op.noise_id), 0.5, bits.shape
-        ).astype(jnp.uint8)
+            jax.random.fold_in(key, op.noise_id), 0.5, (x.shape[0], nq)
+        ).astype(jnp.uint8) & m
         if op.basis == "x":
-            x = x.at[:, q].add(r) & 1
+            x = x ^ r
         else:
-            z = z.at[:, q].add(r) & 1
+            z = z ^ r
     return x, z, buf
 
 
@@ -224,16 +300,18 @@ class FrameSampler:
     # compiled sampler cache: (structure_key, shots) -> jitted (key, probs)
     # closure.  Closing over ONE sampler instance is sound because the
     # structure key digests every array/flag the trace bakes in (only op.p —
-    # routed through the traced probs vector — is excluded).
-    _CACHE: dict = {}
+    # routed through the traced probs vector — is excluded).  Bounded so
+    # long-lived sweeps over many circuit structures don't pin retired
+    # structures' jitted closures (advisor finding, round 2).
+    from ..ops.bp import _LruCache as _LRU
+
+    _CACHE = _LRU(maxsize=64)
 
     def sample(self, key, shots: int):
-        fn = FrameSampler._CACHE.get((self._structure_key, shots))
-        if fn is None:
-            fn = jax.jit(
-                functools.partial(self._sample_impl, shots=shots)
-            )
-            FrameSampler._CACHE[(self._structure_key, shots)] = fn
+        fn = FrameSampler._CACHE.get(
+            (self._structure_key, shots),
+            lambda: jax.jit(functools.partial(self._sample_impl, shots=shots)),
+        )
         return fn(key, self._probs)
 
     # Samplers hash/compare by circuit structure so they can serve as static

@@ -78,6 +78,78 @@ def ColorationCircuit(H) -> list[dict[int, int]]:
     ]
 
 
+def ColorationCircuitHK(H) -> list[dict[int, int]]:
+    """The reference's exact coloration schedule (src/CircuitScheduling.py:
+    8-110): pad the Tanner graph to a Δ-regular bipartite graph (dummy check
+    nodes, then greedy dummy edges in node-insertion order), then repeatedly
+    peel Hopcroft–Karp maximum matchings off the padded graph, keeping each
+    matching's real-check pairs as one timestep.
+
+    This reproduces the reference's *timestep structure*, which is
+    physics-relevant at circuit level (it fixes which CX hook errors align
+    across checks).  Two behavioral quirks are preserved deliberately:
+
+      * matchings are peeled until the PADDED graph is empty, so the depth
+        can exceed Δ of the real graph and timesteps can be sparse;
+      * a real check with degree < Δ receives dummy edges to real qubits,
+        and a matching may pair it through such a dummy edge — the resulting
+        {check: qubit} entry is NOT a Tanner edge (the reference schedules
+        this spurious CX too; ``validate_schedule`` therefore does not apply
+        to this generator for irregular H).
+
+    Determinism: node/edge insertion orders and the greedy padding loop
+    mirror the reference exactly; ``hopcroft_karp_matching`` and small-int
+    set iteration are deterministic, so the schedule is reproducible.
+    """
+    import networkx as nx
+    from networkx.algorithms import bipartite as nx_bipartite
+
+    H = np.asarray(H)
+    num_checks, num_bits = H.shape
+    g = nx.Graph()
+    c_nodes = [-(i + 1) for i in range(num_checks)]
+    v_nodes = [j + 1 for j in range(num_bits)]
+    g.add_nodes_from(c_nodes, bipartite=0)
+    g.add_nodes_from(v_nodes, bipartite=1)
+    g.add_edges_from(
+        (-(i + 1), j + 1)
+        for i in range(num_checks)
+        for j in range(num_bits)
+        if H[i][j] == 1
+    )
+
+    # pad: dummy check nodes up to the qubit count, then greedy dummy edges
+    # (first open check x first open qubit, re-scanned in insertion order)
+    # until every node reaches Δ = max degree
+    gs = g.copy()
+    gs.add_nodes_from(
+        (-(i + 1) for i in range(num_checks, num_bits)), bipartite=0)
+    delta = max(d for _, d in gs.degree)
+    open_deg = {node: deg for node, deg in dict(gs.degree()).items()
+                if deg < delta}
+    while open_deg:
+        for c in [n for n in open_deg if n < 0]:
+            for v in [n for n in open_deg if n > 0]:
+                if not gs.has_edge(c, v):
+                    gs.add_edge(c, v)
+                    for node in (c, v):
+                        if open_deg[node] + 1 == delta:
+                            open_deg.pop(node)
+                        else:
+                            open_deg[node] += 1
+                    break
+
+    # peel maximum matchings; keep real-check pairs per timestep
+    real_c = {n for n, d in g.nodes(data=True) if d["bipartite"] == 0}
+    all_c = {n for n, d in gs.nodes(data=True) if d["bipartite"] == 0}
+    schedule = []
+    while gs.number_of_edges() > 0:
+        bm = nx_bipartite.matching.hopcroft_karp_matching(gs, list(all_c))
+        schedule.append({-c - 1: bm[c] - 1 for c in bm if c in real_c})
+        gs.remove_edges_from([(c, bm[c]) for c in bm if c in all_c])
+    return schedule
+
+
 def RandomCircuit(H) -> list[dict[int, int]]:
     """Shuffled-neighborhood schedule (reference src/CircuitScheduling.py:116-131).
 

@@ -72,11 +72,11 @@ def decode_device(static, state, syndromes):
     """
     kind = static[0]
     if kind == "bposd_dev":
-        _, bp_static, n, rank, osd_order = static
+        _, bp_static, n, rank, osd_order, elim = static
         err, aux = decode_device(bp_static, state, syndromes)
         from ..ops.osd_device import osd_decode_values
 
-        cfg = (n, rank, osd_order, 256)
+        cfg = (n, rank, osd_order, 256, elim)
         B = syndromes.shape[0]
         conv = aux["converged"]
         bad = ~conv
@@ -95,20 +95,23 @@ def decode_device(static, state, syndromes):
             return out, aux
 
         # straggler compaction (same trick as bp_decode_two_phase): OSD only
-        # the BP-failed shots, gathered into a half-capacity sub-batch; if
-        # more than half the batch failed, fall back to the full batch —
-        # results never depend on the capacity
-        capacity = B // 2
-        idx = jnp.nonzero(bad, size=capacity, fill_value=B)[0]
-        idx_c = jnp.minimum(idx, B - 1)
+        # the BP-failed shots, gathered into a fixed-capacity sub-batch
+        # (one mid tier at B/4, then full batch): OSD cost is linear in the
+        # compacted size, so when most shots converge the tier wins;
+        # results never depend on which tier runs.  Tiers stay multiples of
+        # 128 (the Pallas elimination's batch-tile width).
+        def compacted_fn(capacity):
+            def run(_):
+                idx = jnp.nonzero(bad, size=capacity, fill_value=B)[0]
+                idx_c = jnp.minimum(idx, B - 1)
+                sub = osd_decode_values(
+                    cfg, state["osd_packed"], state["osd_cost"],
+                    syndromes[idx_c], aux["posterior_llr"][idx_c],
+                )
+                # out-of-range pad indices are dropped by the scatter
+                return err.at[idx].set(sub, mode="drop")
 
-        def compacted(_):
-            sub = osd_decode_values(
-                cfg, state["osd_packed"], state["osd_cost"],
-                syndromes[idx_c], aux["posterior_llr"][idx_c],
-            )
-            # out-of-range pad indices are dropped by the scatter
-            return err.at[idx].set(sub, mode="drop")
+            return run
 
         def full(_):
             osd_err = osd_decode_values(
@@ -121,11 +124,15 @@ def decode_device(static, state, syndromes):
             return err
 
         n_bad = bad.sum()
-        out = jax.lax.cond(
-            n_bad == 0, none,
-            lambda o: jax.lax.cond(n_bad <= capacity, compacted, full, o),
-            operand=None,
-        )
+        # one mid tier: each tier instantiates the full OSD program (pallas
+        # elimination + scoring) in the traced pipeline, so more tiers cost
+        # real trace/compile/cache-load time per (code, p) sweep shape
+        tiers = [c for c in (B // 4,) if c >= 128 and c % 128 == 0]
+        out = full
+        for cap in reversed(tiers):
+            out = (lambda cap, nxt: lambda o: jax.lax.cond(
+                n_bad <= cap, compacted_fn(cap), nxt, o))(cap, out)
+        out = jax.lax.cond(n_bad == 0, none, out, operand=None)
         return out, aux
     if kind == "st_syndrome":
         _, num_rep, m, n, inner = static
@@ -368,8 +375,12 @@ class BPOSD_Decoder(BPDecoder):
         if not self.device_osd:
             return bp_static
         order = 0 if self.osd_method in ("osd0", "osd_0") else self.osd_order
+        # the elimination strategy is resolved HERE (construction-time env)
+        # and travels in the static config, so it participates in every jit
+        # cache key — a mid-process env change affects new decoders only
+        elim = os.environ.get("QLDPC_OSD_ELIM", "pallas")
         return ("bposd_dev", bp_static, self._osd_plan.n,
-                self._osd_plan.rank, order)
+                self._osd_plan.rank, order, elim)
 
     @property
     def device_state(self):

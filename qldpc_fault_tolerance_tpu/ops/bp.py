@@ -420,13 +420,15 @@ def bp_decode_two_phase(
         and method == "minimum_sum"
         and b % pallas_block == 0
         and np.ndim(channel_llr) == 1
+        and pallas_head.max_block_b(b, want=pallas_block) > 0
     )
     if use_pallas:
         from .bp_pallas import bp_head_pallas
 
         head = bp_head_pallas(
             pallas_head, syndromes, channel_llr, head_iters=head_iters,
-            ms_scaling_factor=float(ms_scaling_factor), block_b=pallas_block,
+            ms_scaling_factor=float(ms_scaling_factor),
+            block_b=pallas_head.max_block_b(b, want=pallas_block),
         )
     else:
         head = bp_decode(
@@ -442,49 +444,65 @@ def bp_decode_two_phase(
             ms_scaling_factor=ms_scaling_factor, sectors=sectors,
         )
 
-    def compacted(_):
-        # pad the gather with an out-of-range sentinel (b): padded rows read
-        # a zero scratch syndrome (row b of the extended arrays) and their
-        # scatters land in a scratch row sliced off below — no duplicate
-        # writes to real shots, so nothing depends on scatter ordering
-        idx = jnp.nonzero(bad, size=tail_capacity, fill_value=b)[0]
-        synd_ext = jnp.concatenate(
-            [syndromes, jnp.zeros((1,) + syndromes.shape[1:], syndromes.dtype)]
-        )
-        llr_ext = jnp.concatenate([llr0, llr0[:1]])
-        if use_pallas:
-            # tail in the same VMEM-resident kernel, as one wide tile with
-            # early exit (the XLA while-loop pays ~0.15ms of sequential
-            # latency per iteration at straggler batch sizes)
-            from .bp_pallas import bp_head_pallas
-
-            tail = bp_head_pallas(
-                pallas_head, synd_ext[idx],
-                jnp.asarray(channel_llr, jnp.float32),
-                head_iters=max_iter,
-                ms_scaling_factor=float(ms_scaling_factor),
-                block_b=min(tail_capacity, 512), early_stop=True,
+    def compacted_fn(capacity):
+        def compacted(_):
+            # pad the gather with an out-of-range sentinel (b): padded rows
+            # read a zero scratch syndrome (row b of the extended arrays) and
+            # their scatters land in a scratch row sliced off below — no
+            # duplicate writes to real shots, so nothing depends on scatter
+            # ordering
+            idx = jnp.nonzero(bad, size=capacity, fill_value=b)[0]
+            synd_ext = jnp.concatenate(
+                [syndromes,
+                 jnp.zeros((1,) + syndromes.shape[1:], syndromes.dtype)]
             )
-        else:
-            tail = bp_decode(
-                graph, synd_ext[idx], llr_ext[idx], max_iter=max_iter,
-                method=method, ms_scaling_factor=ms_scaling_factor,
-                sectors=sectors,
+            llr_ext = jnp.concatenate([llr0, llr0[:1]])
+            if use_pallas and pallas_head.max_block_b(capacity) > 0:
+                # tail in the same VMEM-resident kernel, as one wide tile
+                # with early exit (the XLA while-loop pays ~0.15ms of
+                # sequential latency per iteration at straggler batch sizes)
+                from .bp_pallas import bp_head_pallas
+
+                tail = bp_head_pallas(
+                    pallas_head, synd_ext[idx],
+                    jnp.asarray(channel_llr, jnp.float32),
+                    head_iters=max_iter,
+                    ms_scaling_factor=float(ms_scaling_factor),
+                    block_b=pallas_head.max_block_b(capacity),
+                    early_stop=True,
+                )
+            else:
+                tail = bp_decode(
+                    graph, synd_ext[idx], llr_ext[idx], max_iter=max_iter,
+                    method=method, ms_scaling_factor=ms_scaling_factor,
+                    sectors=sectors,
+                )
+
+            def merge(head_arr, tail_arr):
+                scratch = jnp.zeros((1,) + head_arr.shape[1:], head_arr.dtype)
+                ext = jnp.concatenate([head_arr, scratch])
+                return ext.at[idx].set(tail_arr)[:b]
+
+            return BPResult(
+                error=merge(head.error, tail.error),
+                converged=merge(head.converged, tail.converged),
+                posterior_llr=merge(head.posterior_llr, tail.posterior_llr),
+                iterations=merge(head.iterations, tail.iterations),
             )
 
-        def merge(head_arr, tail_arr):
-            scratch = jnp.zeros((1,) + head_arr.shape[1:], head_arr.dtype)
-            ext = jnp.concatenate([head_arr, scratch])
-            return ext.at[idx].set(tail_arr)[:b]
+        return compacted
 
-        return BPResult(
-            error=merge(head.error, tail.error),
-            converged=merge(head.converged, tail.converged),
-            posterior_llr=merge(head.posterior_llr, tail.posterior_llr),
-            iterations=merge(head.iterations, tail.iterations),
-        )
-
-    return jax.lax.cond(n_bad > tail_capacity, full, compacted, operand=None)
+    # tiered capacities (tail_capacity, 4x, full): tail cost is linear in
+    # the compacted size, and near threshold the straggler fraction can
+    # exceed B/16 — the 4x tier keeps those batches off the full-batch path
+    tiers = [tail_capacity]
+    if tail_capacity * 4 < b:
+        tiers.append(tail_capacity * 4)
+    out = full
+    for cap in reversed(tiers):
+        out = (lambda cap, nxt: lambda o: jax.lax.cond(
+            n_bad <= cap, compacted_fn(cap), nxt, o))(cap, out)
+    return out(None)
 
 
 @functools.partial(jax.jit, static_argnames=("max_restarts",))

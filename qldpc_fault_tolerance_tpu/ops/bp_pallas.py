@@ -86,6 +86,22 @@ class PallasHeadGraph(NamedTuple):
     def fits_vmem(self) -> bool:
         return self.scat_bytes <= _SCAT_VMEM_LIMIT
 
+    def max_block_b(self, b: int, want: int = 512) -> int:
+        """Largest batch tile <= ``want`` that divides ``b`` and keeps the
+        kernel's scoped-VMEM stack under the 32MB compiler limit; 0 when no
+        feasible tile exists (callers fall back to the XLA path).
+
+        Per-shot bytes are an empirical fit (~1.7x the naive array-plane
+        sum — mosaic stacks temporaries) with 2x slack; too-small estimates
+        fail at COMPILE time with a scoped-vmem OOM, so err conservative."""
+        per_shot = 2 * (4 * self.rw * self.m + 20 * self.n + 16 * self.m)
+        budget = 30 * 1024 * 1024 - self.scat_bytes
+        top = min(want, b)
+        for bt in [top] + [1 << k for k in range(9, 2, -1)]:
+            if bt <= top and b % bt == 0 and bt * per_shot <= budget:
+                return bt
+        return 0
+
 
 from .bp import _LruCache  # noqa: E402  (shared bounded memo)
 
@@ -273,8 +289,15 @@ def bp_head_pallas(
         early_stop=early_stop,
     )
     grid = (b // block_b,)
+    # a unique deterministic kernel name per instantiation: mosaic's
+    # name-uniquing of same-named kernels is process-history-dependent,
+    # which perturbs the serialized payload and breaks the persistent
+    # compilation cache's key stability
+    kname = (f"bp_head_{m}x{n}r{pgraph.rw}_i{head_iters}_b{b}x{block_b}"
+             f"{'_es' if early_stop else ''}")
     err, conv, llr, iters = pl.pallas_call(
         kernel,
+        name=kname,
         grid=grid,
         in_specs=[
             pl.BlockSpec((m, block_b), lambda t: (0, t)),       # syndromes.T

@@ -95,18 +95,164 @@ def _permute_and_pack(h01, perm):
     Batch-last mirrors the BP kernel's layout lesson: every elimination-loop
     tensor keeps the shot batch on the 128-lane minor axis (full vector
     utilization), and the loop's column extraction is a contiguous
-    ``dynamic_slice`` on the leading word axis — no per-shot gathers."""
+    ``dynamic_slice`` on the leading word axis — no per-shot gathers.
+
+    Implementation: gather COLUMN-packed words (each permuted column's bits
+    over rows, (B, n, mW) — the smallest gatherable representation, ~8x less
+    traffic than gathering unpacked (m, B, n) bytes), then convert to
+    row-packed with a vectorized 32x32 bit-matrix transpose (5 masked
+    shift/combine rounds, Hacker's Delight 7-3)."""
     B, n = perm.shape
     m = h01.shape[0]
     W = (n + 31) // 32
-    cols = h01[:, perm]                                       # (m, B, n) u8
+    mW = (m + 31) // 32
+    # column-packed H: colpack[t, rw] = bits of column t at rows rw*32..+31
+    ht = jnp.pad(h01.T, ((0, 0), (0, mW * 32 - m)))           # (n, mW*32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    colpack = jnp.sum(
+        ht.reshape(n, mW, 32).astype(jnp.uint32) << shifts, axis=2,
+        dtype=jnp.uint32)                                     # (n, mW)
+    g = colpack[perm]                                         # (B, n, mW)
     pad = W * 32 - n
     if pad:
-        cols = jnp.pad(cols, ((0, 0), (0, 0), (0, pad)))
-    lanes = cols.reshape(m, B, W, 32).astype(jnp.uint32)
+        g = jnp.pad(g, ((0, 0), (0, pad), (0, 0)))
+    x = jnp.moveaxis(g, 0, -1).reshape(W, 32, mW, B)          # j-axis = 1
+    # 32x32 bit transpose of (word-index j, bit-index r) -> (r, j); the
+    # shift network transposes the bit-reversed orientation, so reverse the
+    # j-axis going in and the r-axis coming out
+    x = x[:, ::-1]
+    for sh in (16, 8, 4, 2, 1):
+        mask = jnp.uint32(sum(((1 << sh) - 1) << off
+                              for off in range(0, 32, 2 * sh)))
+        x2 = x.reshape(W, 32 // (2 * sh), 2, sh, mW, B)
+        lo, hi = x2[:, :, 0], x2[:, :, 1]
+        t = (lo ^ (hi >> jnp.uint32(sh))) & mask
+        lo = lo ^ t
+        hi = hi ^ (t << jnp.uint32(sh))
+        x = jnp.stack([lo, hi], axis=2).reshape(W, 32, mW, B)
+    x = x[:, ::-1]                                            # (W, r, rw, B)
+    out = jnp.moveaxis(x, 1, 2).reshape(W, mW * 32, B)        # row = rw*32+r
+    return out[:, :m]
+
+
+def _eliminate_blocked(plan, perm, syndromes):
+    """All-shots RREF processing 32 reliability-ordered columns per loop step.
+
+    Same contract and results as ``_eliminate`` (same first-available-row
+    pivoting in the same column order), restructured for TPU wall-clock:
+
+      * **Phase A** (per 32-column word block): a micro-elimination runs on
+        the current word slice ``cw`` (m, B) only, unrolled over its 32 bit
+        positions.  Alongside the slice it maintains ``aug`` (m, B) uint32 —
+        bit j of ``aug[r]`` says "block-start pivot row j is XORed into row
+        r by this block's row ops".  The augmented bookkeeping linearizes
+        the cascade: row updates inside the block compose as
+        ``aug_r ^= aug_piv ^ (1 << j)``, so the block's total effect on ANY
+        word of the matrix is a plain GF(2) combination of block-start
+        pivot rows.
+      * **Phase B**: gather the 32 block-start pivot rows G0 (32, W, B)
+        once, then update the whole packed matrix in ONE fused pass:
+        ``packed ^= XOR_j bit_j(aug) & G0[j]``.
+
+    The per-column variant touches the full (W, m, B) matrix once per
+    column; this touches it ~twice per 32 columns — an order of magnitude
+    less HBM traffic — and runs ~n/32 while-loop iterations instead of ~n
+    (each XLA loop iteration costs fixed dispatch latency).
+    """
+    B = perm.shape[0]
+    m, n, r_star = plan.m, plan.n, plan.rank
+    W = (n + 31) // 32
+    h01 = _unpack_rows(plan.packed, n)
+    rows_m = jnp.arange(m, dtype=jnp.int32)[:, None]          # (m, 1)
+    slots = jnp.arange(r_star, dtype=jnp.int32)[:, None]      # (r*, 1)
+    one = jnp.uint32(1)
+
+    def cond(state):
+        t_word, packed, synd, used, rank, pr, pc, ipw = state
+        return (t_word < W) & jnp.any(rank < r_star)
+
+    def step(state):
+        t_word, packed, synd, used, rank, pr, pc, ipw = state
+        cw = jax.lax.dynamic_slice(
+            packed, (t_word, 0, 0), (1, m, B))[0]              # (m, B) u32
+        aug = jnp.zeros((m, B), jnp.uint32)
+        pivword = jnp.zeros((m, B), jnp.uint32)
+        # block-local per-step records, stacked for the post-block updates
+        pivs, hass, ranks = [], [], []
+        for j in range(32):
+            bits = ((cw >> jnp.uint32(j)) & one).astype(bool)  # (m, B)
+            avail = bits & ~used & (rank < r_star)[None, :]
+            has = avail.any(axis=0)                            # (B,)
+            piv = jnp.argmax(avail, axis=0).astype(jnp.int32)  # first True
+            onehot = (rows_m == piv[None, :]) & has[None, :]   # (m, B)
+            prow_w = jnp.sum(jnp.where(onehot, cw, one * 0), axis=0,
+                             dtype=jnp.uint32)                 # (B,)
+            ps = jnp.sum(jnp.where(onehot, synd, jnp.uint8(0)), axis=0,
+                         dtype=jnp.uint8)                      # (B,)
+            paug = jnp.sum(jnp.where(onehot, aug, one * 0), axis=0,
+                           dtype=jnp.uint32)                   # (B,)
+            clear = (bits & ~onehot & has[None, :]).astype(jnp.uint32)
+            cw = cw ^ (clear * prow_w[None, :])
+            synd = synd ^ (clear.astype(jnp.uint8) * ps[None, :])
+            aug = aug ^ (clear * ((paug ^ (one << jnp.uint32(j)))[None, :]))
+            pivword = pivword | (onehot.astype(jnp.uint32) << jnp.uint32(j))
+            used = used | onehot
+            pivs.append(piv)
+            hass.append(has)
+            ranks.append(rank)
+            rank = rank + has.astype(jnp.int32)
+        pivs = jnp.stack(pivs)                                 # (32, B)
+        hass = jnp.stack(hass)                                 # (32, B)
+        ranks = jnp.stack(ranks)                               # (32, B)
+        # slot bookkeeping: each slot is written at most once over the whole
+        # elimination (rank strictly increases), so the block's contribution
+        # is a masked sum over its 32 steps — one fused reduction instead of
+        # 32 full-array writes
+        match = (ranks[:, None, :] == slots[None, :, :]) & hass[:, None, :]
+        pr = pr + jnp.sum(jnp.where(match, pivs[:, None, :], 0), axis=0,
+                          dtype=jnp.int32)                     # (r*, B)
+        t0 = t_word * 32
+        tcols = t0 + jnp.arange(32, dtype=jnp.int32)[:, None, None]
+        pc = pc + jnp.sum(jnp.where(match, tcols, 0), axis=0,
+                          dtype=jnp.int32)                     # (r*, B)
+        # pivot-column bitmap, packed a word per block (unpacked by caller)
+        hasword = jnp.sum(
+            hass.astype(jnp.uint32)
+            << jnp.arange(32, dtype=jnp.uint32)[:, None],
+            axis=0, dtype=jnp.uint32,
+        )                                                      # (B,)
+        ipw = jax.lax.dynamic_update_slice(ipw, hasword[None, :], (t_word, 0))
+        # Phase B: gather the 32 block-start pivot rows in one pass, then one
+        # fused 32-term XOR applies the whole block to every word.  Rows at
+        # steps with no pivot (has=False) gather row piv=0 — harmless, their
+        # aug bit is never set so the mask zeroes them.
+        idx = jnp.broadcast_to(pivs[None], (W, 32, B))
+        g0 = jnp.take_along_axis(packed, idx, axis=1)          # (W, 32, B)
+        delta = jnp.zeros((W, m, B), jnp.uint32)
+        for j in range(32):
+            sel = 0 - ((aug >> jnp.uint32(j)) & one)           # (m, B) mask
+            delta = delta ^ (sel[None, :, :] & g0[:, j, None, :])
+        packed = packed ^ delta
+        return (t_word + 1, packed, synd, used, rank, pr, pc, ipw)
+
+    state = (
+        jnp.int32(0),
+        _permute_and_pack(h01, perm),
+        syndromes.astype(jnp.uint8).T,                         # (m, B)
+        jnp.zeros((m, B), bool),
+        jnp.zeros((B,), jnp.int32),
+        jnp.zeros((r_star, B), jnp.int32),
+        jnp.zeros((r_star, B), jnp.int32),
+        jnp.zeros((W, B), jnp.uint32),
+    )
+    _, packed, synd, used, rank, pr, pc, ipw = jax.lax.while_loop(
+        cond, step, state)
+    # unpack the pivot-column bitmap to (n, B) bool
     shifts = jnp.arange(32, dtype=jnp.uint32)
-    packed = jnp.sum(lanes << shifts, axis=3, dtype=jnp.uint32)  # (m, B, W)
-    return jnp.transpose(packed, (2, 0, 1))                   # (W, m, B)
+    ip = ((ipw[:, None, :] >> shifts[:, None]) & one).astype(bool)
+    ip = ip.reshape(W * 32, B)[:n]
+    u_piv = jnp.take_along_axis(synd, pr, axis=0)              # (r*, B)
+    return u_piv, pr, pc, ip, packed
 
 
 def _eliminate(plan, perm, syndromes):
@@ -282,6 +428,7 @@ def _eliminate_pallas(plan, perm, syndromes, bt: int = 128,
     grid = (B // bt,)
     packed, synd, pr, pc, ip = pl.pallas_call(
         kernel,
+        name=f"osd_elim_percol_{m}x{n}_r{r_star}_B{B}x{bt}",
         grid=grid,
         in_specs=[
             pl.BlockSpec((W, m, bt), lambda t: (0, 0, t)),
@@ -315,13 +462,198 @@ def _eliminate_pallas(plan, perm, syndromes, bt: int = 128,
     return (u_piv, pr, pc, ip.astype(bool), packed.astype(jnp.uint32))
 
 
+# ---------------------------------------------------------------------------
+# Blocked Pallas elimination (the default on TPU): the _eliminate_blocked
+# algorithm with all per-block state VMEM-resident.  One kernel launch per
+# batch tile runs the whole elimination; the only HBM traffic is the initial
+# permuted-matrix read.  Additionally maintains the "free panel" F — for
+# every row, the bits at the first ``fcap`` pivotless (free) columns — so the
+# caller needs neither the reduced matrix nor a post-loop T extraction:
+# OSD-E's T is F gathered at the pivot rows.
+def _elim_blocked_kernel(packed_ref, synd_ref,
+                         synd_out_ref, pr_ref, pc_ref, fword_ref, fpos_ref,
+                         work_ref, used_ref, rank_ref, fcnt_ref,
+                         *, W: int, m: int, n: int, r_star: int, fcap: int,
+                         bt: int):
+    i32 = jnp.int32
+    rows_m = jax.lax.broadcasted_iota(i32, (m, bt), 0)
+    slots = jax.lax.broadcasted_iota(i32, (r_star, bt), 0)
+    k32 = jax.lax.broadcasted_iota(i32, (32, bt), 0)
+    srl = jax.lax.shift_right_logical
+
+    work_ref[:] = packed_ref[:]
+    synd_out_ref[:] = synd_ref[:]
+    used_ref[:] = jnp.zeros((m, bt), i32)
+    rank_ref[:] = jnp.zeros((8, bt), i32)
+    fcnt_ref[:] = jnp.zeros((8, bt), i32)
+    pr_ref[:] = jnp.zeros((r_star, bt), i32)
+    pc_ref[:] = jnp.zeros((r_star, bt), i32)
+    fword_ref[:] = jnp.zeros((m, bt), i32)
+    fpos_ref[:] = jnp.zeros((32, bt), i32)
+
+    def cond(t_word):
+        more_rank = jnp.min(rank_ref[0, :]) < r_star
+        more_free = jnp.min(fcnt_ref[0, :]) < fcap
+        return (t_word < W) & (more_rank | more_free)
+
+    def body(t_word):
+        cw0 = work_ref[pl.ds(t_word, 1)][0]                    # (m, bt)
+
+        # phase A: 32 micro-elimination steps as a fori_loop (a traced bit
+        # index keeps the kernel ~30x smaller to trace/lower than a python
+        # unroll, which matters: every (tier, sector, shape) instantiates
+        # this kernel inside the simulators' jitted pipelines)
+        def stepA(j, c):
+            (cw, synd, used, fword, rank, fcnt, aug, pivword, pr, pc,
+             fpos) = c
+            t = t_word * 32 + j
+            bits = srl(cw, j) & 1
+            active = jnp.where(rank < r_star, 1, 0)            # (bt,)
+            avail = bits * (1 - used) * active[None, :]
+            cand = jnp.where(avail == 1, rows_m, m)
+            piv = jnp.min(cand, axis=0)                        # first avail
+            has = jnp.where((piv < m) & (t < n), 1, 0)
+            piv = jnp.where(piv < m, piv, 0)
+            onehot = jnp.where(rows_m == piv[None, :], has[None, :], 0)
+            prow = jnp.sum(onehot * cw, axis=0)                # (bt,)
+            ps = jnp.sum(onehot * synd, axis=0)
+            paug = jnp.sum(onehot * aug, axis=0)
+            pf = jnp.sum(onehot * fword, axis=0)
+            clear = bits * (1 - onehot) * has[None, :]
+            cw = cw ^ (clear * prow[None, :])
+            synd = synd ^ (clear * ps[None, :])
+            jbit = jax.lax.shift_left(jnp.int32(1), j)
+            aug = aug ^ (clear * ((paug ^ jbit)[None, :]))
+            fword = fword ^ (clear * pf[None, :])
+            pivword = pivword | jax.lax.shift_left(onehot, j)
+            # free-column panel: no pivot at a real column -> record its
+            # (current, reduced) bits at free slot fcnt
+            grow = (1 - has) * jnp.where((fcnt < fcap) & (t < n), 1, 0)
+            kshift = jnp.minimum(fcnt, 31)
+            fword = fword ^ (jax.lax.shift_left(bits, kshift[None, :])
+                             * grow[None, :])
+            fpos = jnp.where((k32 == fcnt[None, :]) & (grow[None, :] == 1),
+                             t, fpos)
+            # pivot slot bookkeeping (each slot written at most once ever)
+            at = jnp.where((slots == rank[None, :]) & (has[None, :] == 1),
+                           1, 0)
+            pr = jnp.where(at == 1, piv[None, :], pr)
+            pc = jnp.where(at == 1, t, pc)
+            used = used | onehot
+            rank = rank + has
+            fcnt = fcnt + grow
+            return (cw, synd, used, fword, rank, fcnt, aug, pivword, pr,
+                    pc, fpos)
+
+        init = (cw0, synd_out_ref[:], used_ref[:], fword_ref[:],
+                rank_ref[0, :], fcnt_ref[0, :],
+                jnp.zeros((m, bt), i32), jnp.zeros((m, bt), i32),
+                pr_ref[:], pc_ref[:], fpos_ref[:])
+        (_, synd, used, fword, rank, fcnt, aug, pivword, pr, pc,
+         fpos) = jax.lax.fori_loop(0, 32, stepA, init)
+        synd_out_ref[:] = synd
+        used_ref[:] = used
+        fword_ref[:] = fword
+        rank_ref[:] = jnp.broadcast_to(rank[None, :], (8, bt))
+        fcnt_ref[:] = jnp.broadcast_to(fcnt[None, :], (8, bt))
+        pr_ref[:] = pr
+        pc_ref[:] = pc
+        fpos_ref[:] = fpos
+
+        # phase B: per word, gather the 32 block-start pivot-row words and
+        # apply the fused 32-term combination.  ``row`` is read before the
+        # writeback, so every g0 is a block-start value as the aug
+        # bookkeeping requires — including the current word (its delta
+        # reproduces the phase-A cascade exactly).
+        def stepB(w_i, _):
+            row = work_ref[pl.ds(w_i, 1)][0]                   # (m, bt)
+
+            def term(j, acc):
+                oh = srl(pivword, j) & 1
+                g0 = jnp.sum(oh * row, axis=0)                 # (bt,)
+                sel = 0 - (srl(aug, j) & 1)
+                return acc ^ (sel & g0[None, :])
+
+            acc = jax.lax.fori_loop(0, 32, term,
+                                    jnp.zeros((m, bt), i32))
+            work_ref[pl.ds(w_i, 1)] = (row ^ acc)[None]
+            return 0
+
+        jax.lax.fori_loop(0, W, stepB, 0)
+        return t_word + 1
+
+    jax.lax.while_loop(cond, body, jnp.int32(0))
+
+
+def _elim_blocked_pallas_ok(W, m, n, r_star, bt):
+    words = (2 * W * m + 5 * m + 2 * r_star + 2 * 32 + 16) * bt
+    return words * 4 <= _ELIM_VMEM_LIMIT
+
+
+def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
+                              bt: int = 128, interpret: bool = False):
+    """VMEM-resident blocked RREF.  Returns (synd (m, B) fully reduced,
+    pivot_rows (r*, B), pivot_cols_perm (r*, B), fword (m, B) free-panel
+    words, fpos (32, B) permuted free-column positions)."""
+    B = perm.shape[0]
+    m, n, r_star = plan.m, plan.n, plan.rank
+    W = (n + 31) // 32
+    h01 = _unpack_rows(plan.packed, n)
+    packed0 = _permute_and_pack(h01, perm).astype(jnp.int32)   # (W, m, B)
+    synd0 = syndromes.astype(jnp.int32).T                      # (m, B)
+
+    kernel = functools.partial(
+        _elim_blocked_kernel, W=W, m=m, n=n, r_star=r_star,
+        fcap=int(fcap), bt=bt)
+    grid = (B // bt,)
+    # unique deterministic name per instantiation (see bp_pallas: mosaic's
+    # same-name uniquing is process-history-dependent and breaks the
+    # persistent compilation cache)
+    kname = f"osd_elim_{m}x{n}_r{r_star}_f{int(fcap)}_B{B}x{bt}"
+    synd, pr, pc, fword, fpos = pl.pallas_call(
+        kernel,
+        name=kname,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((W, m, bt), lambda t: (0, 0, t)),
+            pl.BlockSpec((m, bt), lambda t: (0, t)),
+        ],
+        out_specs=[
+            pl.BlockSpec((m, bt), lambda t: (0, t)),
+            pl.BlockSpec((r_star, bt), lambda t: (0, t)),
+            pl.BlockSpec((r_star, bt), lambda t: (0, t)),
+            pl.BlockSpec((m, bt), lambda t: (0, t)),
+            pl.BlockSpec((32, bt), lambda t: (0, t)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((m, B), jnp.int32),
+            jax.ShapeDtypeStruct((r_star, B), jnp.int32),
+            jax.ShapeDtypeStruct((r_star, B), jnp.int32),
+            jax.ShapeDtypeStruct((m, B), jnp.int32),
+            jax.ShapeDtypeStruct((32, B), jnp.int32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((W, m, bt), jnp.int32),
+            pltpu.VMEM((m, bt), jnp.int32),
+            pltpu.VMEM((8, bt), jnp.int32),
+            pltpu.VMEM((8, bt), jnp.int32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            vmem_limit_bytes=_ELIM_VMEM_LIMIT,
+        ),
+        interpret=interpret,
+    )(packed0, synd0)
+    return synd, pr, pc, fword, fpos
+
+
 def osd_decode_device(plan: OsdPlan, syndromes, posterior_llrs,
                       osd_order: int = 10, pat_chunk: int = 256):
     """OSD-E decode a batch on device. Returns (B, n) uint8 errors.
 
     ``osd_order=0`` gives OSD-0.  Matches _native/osd.cpp semantics."""
     return osd_decode_values(
-        (plan.n, plan.rank, int(osd_order), int(pat_chunk)),
+        (plan.n, plan.rank, int(osd_order), int(pat_chunk),
+         os.environ.get("QLDPC_OSD_ELIM", "pallas")),
         plan.packed, plan.cost, syndromes, posterior_llrs,
     )
 
@@ -329,10 +661,12 @@ def osd_decode_device(plan: OsdPlan, syndromes, posterior_llrs,
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     """Value-based entry (composable inside the simulators' shared jitted
-    pipelines): ``cfg`` = (n, rank, osd_order, pat_chunk) is static, the
-    bit-packed rows and signed costs are traced arguments — a p-sweep
+    pipelines): ``cfg`` = (n, rank, osd_order, pat_chunk[, elim]) is static,
+    the bit-packed rows and signed costs are traced arguments — a p-sweep
     changes only ``cost`` and reuses the executable."""
-    n, r_star, osd_order, pat_chunk = cfg
+    n, r_star, osd_order, pat_chunk = cfg[:4]
+    elim = cfg[4] if len(cfg) > 4 else os.environ.get("QLDPC_OSD_ELIM",
+                                                      "pallas")
     B = syndromes.shape[0]
 
     class _P:  # adapt values to the plan-shaped helpers below
@@ -346,48 +680,70 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     perm = jnp.argsort(posterior_llrs, axis=1, stable=True).astype(jnp.int32)
     W = (n + 31) // 32
     bt = 128
-    # experimental opt-in: the Pallas elimination is bit-exact but measured
-    # op-bound under mosaic (1.16s vs 0.59s XLA for B=2048 on hgp n625) —
-    # kept for future tuning, off by default
-    use_pallas = (
-        os.environ.get("QLDPC_PALLAS_OSD", "0") == "1"
-        and B % bt == 0
-        and _elim_pallas_ok(W, plan.m, n, r_star, bt)
+    w = min(int(osd_order), n - r_star, 20)
+    # elimination strategy (QLDPC_OSD_ELIM): "pallas" (default) = the
+    # VMEM-resident blocked kernel, falling back to XLA when infeasible;
+    # "blocked" / "percol" = the XLA variants; "pallas_percol" = the
+    # original per-column experimental kernel.
+    if elim == "pallas" and not (
+        B % bt == 0
+        and r_star >= 1
+        and _elim_blocked_pallas_ok(W, plan.m, n, r_star, bt)
         and jax.default_backend() == "tpu"
-    )
-    if use_pallas:
-        u_piv_t, piv_rows_t, piv_cols_perm_t, is_pivot_perm_t, packed = \
-            _eliminate_pallas(plan, perm, syndromes, bt=bt)
+    ):
+        elim = "blocked"
+
+    if elim == "pallas":
+        synd_r, piv_rows_t, piv_cols_perm_t, fword_r, fpos = \
+            _eliminate_pallas_blocked(plan, perm, syndromes, fcap=max(w, 0),
+                                      bt=bt)
+        u_piv_t = jnp.take_along_axis(synd_r, piv_rows_t, axis=0)  # (r*, B)
+        free_perm = fpos[:w] if w > 0 else None                # (w, B)
+        if w > 0:
+            fw_piv = jnp.take_along_axis(fword_r, piv_rows_t, axis=0)
+            T = (
+                (fw_piv.T[:, :, None] >> jnp.arange(w, dtype=jnp.int32)
+                 [None, None, :]) & 1
+            ).astype(jnp.float32)                              # (B, r*, w)
     else:
-        u_piv_t, piv_rows_t, piv_cols_perm_t, is_pivot_perm_t, packed = \
-            _eliminate(plan, perm, syndromes)
+        if elim == "pallas_percol":
+            u_piv_t, piv_rows_t, piv_cols_perm_t, is_pivot_perm_t, packed = \
+                _eliminate_pallas(plan, perm, syndromes, bt=bt)
+        elif elim == "percol":
+            u_piv_t, piv_rows_t, piv_cols_perm_t, is_pivot_perm_t, packed = \
+                _eliminate(plan, perm, syndromes)
+        else:
+            u_piv_t, piv_rows_t, piv_cols_perm_t, is_pivot_perm_t, packed = \
+                _eliminate_blocked(plan, perm, syndromes)
+        if w > 0:
+            # free columns in reliability order = non-pivot PERMUTED
+            # positions in ascending order
+            free_perm = jnp.argsort(
+                is_pivot_perm_t, axis=0, stable=True)[:w].astype(jnp.int32)
+            # T[b, i, k]: bit of reduced pivot row i at free column k
+            rows = jnp.take_along_axis(
+                packed,
+                jnp.broadcast_to(piv_rows_t[None], (W, r_star, B)), axis=1
+            )                                                  # (W, r*, B)
+            fword = jnp.broadcast_to(
+                (free_perm >> 5)[:, None, :], (w, r_star, B))
+            fbit = (free_perm & 31).astype(jnp.uint32)[:, None, :]
+            T = ((jnp.take_along_axis(rows, fword, axis=0) >> fbit) & 1)
+            T = jnp.transpose(T, (2, 1, 0)).astype(jnp.float32)  # (B, r*, w)
+
     u_piv = u_piv_t.T                                         # (B, r*)
     # permuted -> original column ids
     piv_cols = jnp.take_along_axis(perm, piv_cols_perm_t.T, axis=1)
 
     cost_piv = plan.cost[piv_cols]                            # (B, r*)
     batch_idx = jnp.arange(B)[:, None]
-    w = min(int(osd_order), n - r_star, 20)
     if w <= 0:
         return (
             jnp.zeros((B, n), jnp.uint8)
             .at[batch_idx, piv_cols].set(u_piv.astype(jnp.uint8))
         )
 
-    # free columns in reliability order = non-pivot PERMUTED positions in
-    # ascending order (positions are already reliability-sorted)
-    free_perm = jnp.argsort(is_pivot_perm_t, axis=0, stable=True)[:w]
-    free_perm = free_perm.astype(jnp.int32)                   # (w, B)
     free = jnp.take_along_axis(perm, free_perm.T, axis=1)     # (B, w) orig
-    # T[b, i, k]: bit of reduced pivot row i at free (permuted) column k
-    W = (n + 31) // 32
-    rows = jnp.take_along_axis(
-        packed, jnp.broadcast_to(piv_rows_t[None], (W, r_star, B)), axis=1
-    )                                                         # (W, r*, B)
-    fword = jnp.broadcast_to((free_perm >> 5)[:, None, :], (w, r_star, B))
-    fbit = (free_perm & 31).astype(jnp.uint32)[:, None, :]    # (w, 1, B)
-    T = ((jnp.take_along_axis(rows, fword, axis=0) >> fbit) & 1)
-    T = jnp.transpose(T, (2, 1, 0)).astype(jnp.float32)       # (B, r*, w)
 
     cost_free = plan.cost[free]                               # (B, w)
     n_pat = 1 << w
@@ -401,11 +757,14 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     def score_chunk(carry, start):
         best_cost, best_pat = carry
         pchunk = jax.lax.dynamic_slice_in_dim(pmat, start, pat_chunk, axis=1)
-        # pivot bits for every candidate: (u + T @ P) mod 2.  HIGHEST
-        # precision: default TPU matmuls round operands to bf16, enough to
-        # mis-rank near-tied candidates under non-uniform (DEM) priors
+        # pivot bits for every candidate: (u + T @ P) mod 2.  The T matmul
+        # runs at default (bf16-operand) precision: operands are exact 0/1
+        # and sums are <= w <= 20, all exactly representable — only the
+        # real-valued COST contractions below need HIGHEST (bf16 rounding
+        # there can mis-rank near-tied candidates under DEM priors)
         hi = jax.lax.Precision.HIGHEST
-        s = jnp.einsum("brw,wp->brp", T, pchunk, precision=hi)  # (B, r*, C)
+        s = jnp.einsum("brw,wp->brp", T, pchunk,
+                       preferred_element_type=jnp.float32)      # (B, r*, C)
         bits = jnp.mod(u_piv[:, :, None].astype(jnp.float32) + s, 2.0)
         c = (
             jnp.einsum("brp,br->bp", bits, cost_piv, precision=hi)

@@ -23,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..circuits import AddCXError, Circuit, ColorationCircuit, FrameSampler, \
+from ..circuits import AddCXError, Circuit, ColorationCircuit, \
+    ColorationCircuitHK, FrameSampler, \
     RandomCircuit, target_rec
 from ..decoders.bp_decoders import decode_device
 from ..ops.linalg import gf2_matmul
@@ -207,6 +208,19 @@ def _rounds_decode(cfg, state, key):
     final (host-assisted) decode stage needs."""
     batch_size, num_cycles, n, m, sampler, d1_static, d2_static = cfg
     dets, obs = sampler._sample_impl(key, state["probs"], batch_size)
+    return _decode_rounds_given(cfg, state, dets, obs)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _decode_rounds_given(cfg, state, dets, obs):
+    """Per-round decode of an already-sampled detector batch.
+
+    Kept dispatchable on its own: on the current libtpu the fully fused
+    sampler+decode program hits a TPU-worker kernel fault for the larger
+    hgp circuits (n625/n1600 — reproducible with the round-2 code too), so
+    the single-chip paths dispatch the sampler separately and feed its
+    on-device output here (two async dispatches, no host round-trip)."""
+    batch_size, num_cycles, n, m, sampler, d1_static, d2_static = cfg
     hist = dets.reshape(batch_size, num_cycles, m)
 
     def round_step(carry, synd_j):
@@ -243,9 +257,20 @@ def _check(state, obs, correction, corrected_final, final_cor):
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _batch_count(cfg, state, key):
-    """Whole batch on device -> failure count scalar (no host sync)."""
+    """Whole batch on device -> failure count scalar (no host sync).
+
+    Fully fused (sampler included) — the unit the mesh path shards."""
     obs, correction, corrected_final, final_cor, _ = _rounds_decode(
         cfg, state, key)
+    return _check(state, obs, correction, corrected_final,
+                  final_cor).sum(dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_count_given(cfg, state, dets, obs):
+    """Failure count for an already-sampled batch (split-dispatch path)."""
+    _, correction, corrected_final, final_cor, _ = _decode_rounds_given(
+        cfg, state, dets, obs)
     return _check(state, obs, correction, corrected_final,
                   final_cor).sum(dtype=jnp.int32)
 
@@ -286,6 +311,10 @@ class CodeSimulator_Circuit:
         elif circuit_type == "coloration":
             self.scheduling_X = ColorationCircuit(code.hx)
             self.scheduling_Z = ColorationCircuit(code.hz)
+        elif circuit_type == "coloration_hk":
+            # the reference's exact padded-graph Hopcroft-Karp coloring
+            self.scheduling_X = ColorationCircuitHK(code.hx)
+            self.scheduling_Z = ColorationCircuitHK(code.hz)
         else:
             raise ValueError(f"unknown circuit_type {circuit_type!r}")
 
@@ -323,7 +352,11 @@ class CodeSimulator_Circuit:
 
     def _sample_and_decode_rounds(self, key, batch_size: int):
         self._ensure_circuit()
-        return _rounds_decode(self._cfg(batch_size), self._dev_state, key)
+        # split dispatch (see _decode_rounds_given): sampler output stays on
+        # device; only the dispatch boundary differs from the fused program
+        dets, obs = self._sampler.sample(key, batch_size)
+        return _decode_rounds_given(self._cfg(batch_size), self._dev_state,
+                                    dets, obs)
 
     def _check_failures(self, obs, correction, corrected_final, final_cor):
         return _check(self._dev_state, obs, correction, corrected_final,
@@ -363,7 +396,9 @@ class CodeSimulator_Circuit:
         return int(self.run_batch(sub, 1)[0])
 
     def _device_batch_count(self, key, batch_size: int):
-        return _batch_count(self._cfg(batch_size), self._dev_state, key)
+        dets, obs = self._sampler.sample(key, batch_size)
+        return _batch_count_given(self._cfg(batch_size), self._dev_state,
+                                  dets, obs)
 
     def _device_batch_stats(self, key, batch_size: int):
         """Mesh-shardable unit.  The reference tracks no min_logical_weight

@@ -26,6 +26,7 @@ import numpy as np
 from ..circuits import (
     AddCXError,
     ColorationCircuit,
+    ColorationCircuitHK,
     FrameSampler,
     GenCorrecHyperGraph,
     GenFaultHyperGraph,
@@ -146,6 +147,10 @@ class CodeSimulator_Circuit_SpaceTime:
         elif circuit_type == "coloration":
             self.scheduling_X = ColorationCircuit(code.hx)
             self.scheduling_Z = ColorationCircuit(code.hz)
+        elif circuit_type == "coloration_hk":
+            # the reference's exact padded-graph Hopcroft-Karp coloring
+            self.scheduling_X = ColorationCircuitHK(code.hx)
+            self.scheduling_Z = ColorationCircuitHK(code.hz)
         else:
             raise ValueError(f"unknown circuit_type {circuit_type!r}")
 

@@ -130,7 +130,8 @@ def phenl_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
     return wer_notebook(count, total, code.K, cycles)
 
 
-def circuit_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
+def circuit_cell_wer(code, eval_p, cycles, samples, seed, batch_size,
+                     circuit_type="coloration"):
     """CodeFamilyCircuitThreshold inner loop (Threshold ckpt cell 4)."""
     p = eval_p
     error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
@@ -151,7 +152,7 @@ def circuit_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
     sim = CodeSimulator_Circuit(
         code=code, decoder1_z=dec1_z, decoder2_z=dec2_z, p=p,
         num_cycles=cycles, error_params=error_params,
-        seed=seed, batch_size=batch_size,
+        circuit_type=circuit_type, seed=seed, batch_size=batch_size,
     )
     sim._generate_circuit()
     count, total = sim._count_failures(samples)
@@ -195,9 +196,12 @@ EXPERIMENTS = {
 
 
 def run_experiment(name, cycles_list, seeds, scale, batch_size,
-                   seed_start=0):
+                   seed_start=0, circuit_type=None):
     exp = EXPERIMENTS[name]
     codes = exp["codes"]()
+    cell_kwargs = {}
+    if circuit_type is not None:
+        cell_kwargs["circuit_type"] = circuit_type
     for cycles in cycles_list:
         published = exp["published"].get(cycles)
         samples = int(exp["samples_base"] * 3 / cycles * scale)
@@ -209,7 +213,7 @@ def run_experiment(name, cycles_list, seeds, scale, batch_size,
                     wer[ci, pi] = exp["cell"](
                         code, p, cycles, samples,
                         seed=seed * 7919 + ci * 101 + pi,
-                        batch_size=batch_size,
+                        batch_size=batch_size, **cell_kwargs,
                     )
             try:
                 pc, A, d_list = notebook_threshold_est(exp["p_list"], wer)
@@ -218,6 +222,7 @@ def run_experiment(name, cycles_list, seeds, scale, batch_size,
                 print(f"fit failed: {e}")
             rec = {
                 "experiment": name, "cycles": cycles, "seed": seed,
+                "circuit_type": circuit_type,
                 "samples_per_cell": samples, "p_c": pc, "A": A,
                 "d_eff": d_list, "published_p_c": published,
                 "wer": wer.tolist(), "p_list": list(map(float, exp["p_list"])),
@@ -239,11 +244,36 @@ def main():
     ap.add_argument("--scale", type=float, default=1.0)
     ap.add_argument("--batch-size", type=int, default=2048)
     ap.add_argument("--seed-start", type=int, default=0)
+    ap.add_argument("--no-record", action="store_true",
+                    help="don't append to PARITY_results.jsonl (warmup runs)")
+    ap.add_argument("--circuit-type", default=None,
+                    choices=["coloration", "coloration_hk", "random"],
+                    help="override the circuit engines' CX scheduler (A/B "
+                         "experiments for schedule sensitivity)")
+    ap.add_argument("--warmup", action="store_true",
+                    help="run a tiny-scale pass of the same cells first so "
+                         "the recorded elapsed_s measures the warm-process "
+                         "sweep (the reference's notebook timings are also "
+                         "warm: each cycles entry runs after the previous "
+                         "one in the same kernel session)")
     args = ap.parse_args()
+    global RESULTS
+    if args.no_record:
+        RESULTS = os.devnull
+    if args.warmup:
+        real_results = RESULTS
+        RESULTS = os.devnull
+        run_experiment(args.experiment,
+                       (args.cycles or sorted(EXPERIMENTS[args.experiment]
+                                              ["published"]))[:1],
+                       1, 0.003, args.batch_size, seed_start=args.seed_start,
+                       circuit_type=args.circuit_type)
+        RESULTS = real_results
     exp = EXPERIMENTS[args.experiment]
     cycles_list = args.cycles or sorted(exp["published"])
     run_experiment(args.experiment, cycles_list, args.seeds, args.scale,
-                   args.batch_size, seed_start=args.seed_start)
+                   args.batch_size, seed_start=args.seed_start,
+                   circuit_type=args.circuit_type)
 
 
 if __name__ == "__main__":
