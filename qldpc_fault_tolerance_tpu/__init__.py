@@ -67,6 +67,45 @@ def _enable_compilation_cache():
 
 _enable_compilation_cache()
 
+
+def reset_device_state():
+    """Recover from a TPU-worker crash/restart without restarting Python.
+
+    The tunneled worker occasionally dies mid-run (kernel fault /
+    infrastructure flake); after its automatic restart, every cached
+    device buffer is dead.  This drops all device-resident memos (Tanner
+    graphs, Pallas incidence stacks, OSD packings, compiled samplers) and
+    jax's jit caches, so the next dispatch rebuilds/re-uploads — with the
+    persistent compilation cache absorbing the recompiles.  Long sweeps
+    wrap per-cell work in try/except JaxRuntimeError -> reset -> retry
+    (see scripts/parity.py)."""
+    import jax
+
+    from .ops import bp as _bp
+
+    _bp._graph_host_cache.clear()
+    _bp._graph_dev_cache.clear()
+    try:
+        from .ops import bp_pallas as _bpp
+
+        _bpp._head_cache.clear()
+    except Exception:
+        pass
+    try:
+        from .ops import osd_device as _osd
+
+        _osd._pack_cache.clear()
+    except Exception:
+        pass
+    try:
+        from .circuits.sampler import FrameSampler
+
+        FrameSampler._CACHE.clear()
+    except Exception:
+        pass
+    jax.clear_caches()
+
+
 from . import codes
 
 __all__ = ["codes", "__version__"]

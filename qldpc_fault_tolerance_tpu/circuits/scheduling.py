@@ -128,16 +128,27 @@ def ColorationCircuitHK(H) -> list[dict[int, int]]:
     open_deg = {node: deg for node, deg in dict(gs.degree()).items()
                 if deg < delta}
     while open_deg:
+        added = 0
         for c in [n for n in open_deg if n < 0]:
             for v in [n for n in open_deg if n > 0]:
                 if not gs.has_edge(c, v):
                     gs.add_edge(c, v)
+                    added += 1
                     for node in (c, v):
                         if open_deg[node] + 1 == delta:
                             open_deg.pop(node)
                         else:
                             open_deg[node] += 1
                     break
+        if not added:
+            # every open check already touches every open qubit; the greedy
+            # padding cannot reach Δ-regularity (the reference's loop spins
+            # forever here) — fail loudly instead
+            raise ValueError(
+                "coloration_hk: Δ-regular padding is infeasible for this H "
+                "(greedy dummy-edge pass made no progress); use "
+                "circuit_type='coloration'"
+            )
 
     # peel maximum matchings; keep real-check pairs per timestep
     real_c = {n for n, d in g.nodes(data=True) if d["bipartite"] == 0}

@@ -127,7 +127,10 @@ def decode_device(static, state, syndromes):
         # one mid tier: each tier instantiates the full OSD program (pallas
         # elimination + scoring) in the traced pipeline, so more tiers cost
         # real trace/compile/cache-load time per (code, p) sweep shape
-        tiers = [c for c in (B // 4,) if c >= 128 and c % 128 == 0]
+        # one mid tier at B//4 (floored at 128, the Pallas batch-tile width,
+        # so small batches still compact — the Pallas elimination needs the
+        # multiple-of-128 capacity; non-conforming sizes fall back to XLA)
+        tiers = [c for c in (max(B // 4, 128),) if c < B and c % 128 == 0]
         out = full
         for cap in reversed(tiers):
             out = (lambda cap, nxt: lambda o: jax.lax.cond(

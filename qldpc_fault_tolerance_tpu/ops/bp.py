@@ -84,6 +84,9 @@ class _LruCache:
                 self._d.popitem(last=False)
             return val
 
+    def clear(self):
+        self._d.clear()
+
 
 _graph_host_cache = _LruCache()
 _graph_dev_cache = _LruCache()

@@ -692,6 +692,13 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
         and jax.default_backend() == "tpu"
     ):
         elim = "blocked"
+    if elim == "pallas_percol" and not (
+        B % bt == 0
+        and r_star >= 1
+        and _elim_pallas_ok(W, plan.m, n, r_star, bt)
+        and jax.default_backend() == "tpu"
+    ):
+        elim = "blocked"  # same fallback the old opt-in guard provided
 
     if elim == "pallas":
         synd_r, piv_rows_t, piv_cols_perm_t, fword_r, fpos = \
@@ -747,9 +754,14 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
 
     cost_free = plan.cost[free]                               # (B, w)
     n_pat = 1 << w
-    # powers of two: min(256, n_pat) always divides n_pat, so chunk starts
-    # never clamp (a clamped dynamic_slice would mis-attribute pattern ids)
+    # chunk starts must never clamp (a clamped dynamic_slice would
+    # mis-attribute chunk-local argmin indices to wrong global pattern ids):
+    # round a non-dividing caller-supplied chunk down to a power of two,
+    # which always divides the power-of-two n_pat (advisor finding, round 2)
     pat_chunk = min(int(pat_chunk), n_pat)
+    if n_pat % pat_chunk:
+        pat_chunk = 1 << (pat_chunk.bit_length() - 1)
+    assert n_pat % pat_chunk == 0
     pats = jnp.arange(n_pat, dtype=jnp.int32)
     pmat = ((pats[None, :] >> jnp.arange(w)[:, None]) & 1).astype(
         jnp.float32)                                          # (w, n_pat)

@@ -267,9 +267,23 @@ class CodeSimulator_Phenon:
 
     def _device_batch_stats(self, key, num_rounds: int, batch_size: int):
         """Whole batch on device -> (failure count, min weight) scalars (no
-        host sync) — the unit the mesh path shards (parallel/shots.py)."""
-        return _batch_stats(self._cfg(batch_size), self._dev_state, key,
-                            num_rounds)
+        host sync).
+
+        Dispatched as three programs (rounds / final / check) rather than
+        the fused ``_batch_stats``: on the current libtpu the fused program
+        hits a TPU-worker kernel fault for hgp_34_n1600-sized phenom
+        pipelines (same environment regression as the circuit engine —
+        see sim/circuit.py).  Intermediate arrays stay on device and the
+        key split matches ``_batch_stats`` exactly, so results are
+        identical.  The mesh path still shards the fused program."""
+        cfg = self._cfg(batch_size)
+        state = self._dev_state
+        k_rounds, k_final = jax.random.split(key)
+        data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
+        cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
+            cfg, state, k_final, data_x, data_z)
+        fail, min_w = _check(cfg, state, cur_x, cur_z, dx, dz)
+        return fail.sum(dtype=jnp.int32), min_w
 
     def _count_failures(self, num_rounds, num_samples, key=None):
         if key is None:

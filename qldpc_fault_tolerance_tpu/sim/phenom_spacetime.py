@@ -255,9 +255,20 @@ class CodeSimulator_Phenon_SpaceTime:
 
     def _device_batch_stats(self, key, num_rounds: int, batch_size: int):
         """Whole batch on device -> (failure count, min weight) scalars (no
-        host sync) — the unit the mesh path shards (parallel/shots.py)."""
-        return _batch_stats(self._cfg(batch_size), self._dev_state, key,
-                            num_rounds)
+        host sync) — the unit the mesh path shards (parallel/shots.py).
+
+        Dispatched as three programs instead of the fused ``_batch_stats``
+        (same key split, identical results): the fused form hits a
+        TPU-worker kernel fault on hgp-sized pipelines on the current
+        libtpu — see sim/phenom.py."""
+        cfg = self._cfg(batch_size)
+        state = self._dev_state
+        k_rounds, k_final = jax.random.split(key)
+        data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
+        cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
+            cfg, state, k_final, data_x, data_z)
+        fail, min_w = _check(cfg, state, cur_x, cur_z, dx, dz)
+        return fail.sum(dtype=jnp.int32), min_w
 
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into

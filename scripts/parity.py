@@ -195,6 +195,28 @@ EXPERIMENTS = {
 }
 
 
+def _run_cell_with_retry(cell, *args, retries: int = 3, **kwargs):
+    """The tunneled TPU worker intermittently crashes mid-dispatch on large
+    programs (infrastructure flake — it auto-restarts).  Retry the cell
+    after dropping all device-resident caches; results are unaffected
+    (cells are deterministic in their seed)."""
+    import jax
+
+    import qldpc_fault_tolerance_tpu as q
+
+    for attempt in range(retries):
+        try:
+            return cell(*args, **kwargs)
+        except jax.errors.JaxRuntimeError as e:
+            if attempt == retries - 1:
+                raise
+            print(f"TPU worker error ({str(e).splitlines()[0][:90]}); "
+                  f"resetting device caches and retrying "
+                  f"({attempt + 1}/{retries})", file=sys.stderr)
+            q.reset_device_state()
+            time.sleep(10)
+
+
 def run_experiment(name, cycles_list, seeds, scale, batch_size,
                    seed_start=0, circuit_type=None):
     exp = EXPERIMENTS[name]
@@ -210,8 +232,8 @@ def run_experiment(name, cycles_list, seeds, scale, batch_size,
             wer = np.zeros((len(codes), len(exp["p_list"])))
             for ci, code in enumerate(codes):
                 for pi, p in enumerate(exp["p_list"]):
-                    wer[ci, pi] = exp["cell"](
-                        code, p, cycles, samples,
+                    wer[ci, pi] = _run_cell_with_retry(
+                        exp["cell"], code, p, cycles, samples,
                         seed=seed * 7919 + ci * 101 + pi,
                         batch_size=batch_size, **cell_kwargs,
                     )
