@@ -46,6 +46,34 @@ def install(include_third_party_stubs: bool = True) -> None:
         _install_bposd_stub()
         _install_stim_stub()
         _install_graph_tools_stub()
+        _install_loadmat_redirect()
+
+
+def _install_loadmat_redirect() -> None:
+    """Route ``scipy.io.loadmat`` through the author-path redirection.
+
+    The checkpoint notebooks call ``loadmat`` directly on absolute paths
+    from the author's laptop (Single-Shot cells 16/21, Threshold cells
+    7/8); the basenames (LP_*.mat, GenBicycleA*.mat) exist in the mounted
+    reference codes_lib/.  Idempotent; leaves existing paths untouched."""
+    import os
+
+    import scipy.io as sio
+
+    if getattr(sio.loadmat, "__qldpc_redirect__", False):
+        return
+    orig = sio.loadmat
+    ref_lib = "/root/reference/codes_lib"
+
+    def loadmat(file_name, *args, **kwargs):
+        if isinstance(file_name, str) and not os.path.exists(file_name):
+            cand = os.path.join(ref_lib, os.path.basename(file_name))
+            if os.path.exists(cand):
+                file_name = cand
+        return orig(file_name, *args, **kwargs)
+
+    loadmat.__qldpc_redirect__ = True
+    sio.loadmat = loadmat
 
 
 def _install_ldpc_stub() -> None:

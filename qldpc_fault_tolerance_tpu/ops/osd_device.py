@@ -766,20 +766,27 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     pmat = ((pats[None, :] >> jnp.arange(w)[:, None]) & 1).astype(
         jnp.float32)                                          # (w, n_pat)
 
+    # pivot bit of candidate p: u_i XOR parity(T_i . p).  Linearized for one
+    # fewer (B, r*, C) pass:  sum_i c_i*(u_i ^ par_i)
+    #   = sum_i c_i*u_i + sum_i c_i*(1-2u_i)*par_i   (exact for u in {0,1})
+    # so the per-candidate cost needs only the parity tensor, contracted
+    # against the precomputed signed costs.
+    signed_piv = cost_piv * (1.0 - 2.0 * u_piv.astype(jnp.float32))
+
     def score_chunk(carry, start):
         best_cost, best_pat = carry
         pchunk = jax.lax.dynamic_slice_in_dim(pmat, start, pat_chunk, axis=1)
-        # pivot bits for every candidate: (u + T @ P) mod 2.  The T matmul
-        # runs at default (bf16-operand) precision: operands are exact 0/1
-        # and sums are <= w <= 20, all exactly representable — only the
-        # real-valued COST contractions below need HIGHEST (bf16 rounding
-        # there can mis-rank near-tied candidates under DEM priors)
+        # the T matmul runs at default (bf16-operand) precision: operands
+        # are exact 0/1 and sums are <= w <= 20, all exactly representable
+        # — only the real-valued COST contractions need HIGHEST (bf16
+        # rounding there can mis-rank near-tied candidates under DEM priors)
         hi = jax.lax.Precision.HIGHEST
         s = jnp.einsum("brw,wp->brp", T, pchunk,
                        preferred_element_type=jnp.float32)      # (B, r*, C)
-        bits = jnp.mod(u_piv[:, :, None].astype(jnp.float32) + s, 2.0)
+        par = s - 2.0 * jnp.floor(s * 0.5)                      # exact ints
         c = (
-            jnp.einsum("brp,br->bp", bits, cost_piv, precision=hi)
+            base_cost[:, None]
+            + jnp.einsum("brp,br->bp", par, signed_piv, precision=hi)
             + jnp.matmul(cost_free, pchunk, precision=hi)       # (B, C)
         )
         idx = jnp.argmin(c, axis=1)                           # first min

@@ -114,7 +114,10 @@ class CodeSimulator_Circuit_SpaceTime:
                  decoder2_z=None, decoder2_x=None, p=0, num_cycles=1,
                  num_rep=1, error_params=None, eval_logical_type="Z",
                  circuit_type="coloration", rand_scheduling_seed=0,
-                 seed: int = 0, batch_size: int = 256, mesh=None):
+                 seed: int = 0, batch_size: int = 256, mesh=None, pz=None):
+        if pz is not None:
+            # notebook-era keyword alias (see sim/circuit.py)
+            p = pz
         if eval_logical_type == "X":
             _swap_xz_inplace(code)
             decoder1_z = decoder1_x

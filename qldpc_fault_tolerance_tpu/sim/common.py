@@ -122,18 +122,29 @@ def wer_single_shot(error_count: int, num_run: int, K: int):
 def wer_per_cycle(error_count: int, num_samples: int, K: int, num_cycles: int):
     """Per-qubit-per-cycle WER inversion (src/Simulators.py:353-361).
 
-    Requires odd num_cycles so the inversion is well-defined.
+    The current reference asserts odd num_cycles (the (1-2P)^(1/cycles)
+    inversion is sign-ambiguous above P=1/2 for even counts), but the
+    published checkpoint notebooks predate that assert and sweep EVEN cycle
+    counts throughout (Single-Shot cells 9/18/22, Threshold cells 12/25/...).
+    To run those notebooks unmodified we keep the notebook-era behavior:
+    apply the two-branch inversion for any cycle count (the P>1/2 branch is
+    the one the even-count assert was guarding; it only engages far above
+    threshold, where the notebooks' own published values carry the same
+    convention).
     """
-    assert int(num_cycles) % 2 == 1, (
-        "the number of cycles has to be odd for an invertible wer mapping"
-    )
     logical_error_rate = error_count / num_samples
     per_qubit = 1.0 - (1 - logical_error_rate) ** (1 / K)
     if per_qubit <= 0.5:
         wer = (1.0 - (1 - 2 * per_qubit) ** (1 / num_cycles)) / 2
     else:
         wer = (1.0 + (-1 + 2 * per_qubit) ** (1 / num_cycles)) / 2
-    return wer, None
+    # binomial error bar on the per-cycle rate: the current reference
+    # returns None here (the eb computation is commented out at
+    # src/Simulators.py:340-351), but the notebook-era version returned one
+    # and the Single-Shot checkpoint's own executed plotting cells multiply
+    # eval_wer_std_list by scalars — a None would (and did) TypeError
+    wer_eb = np.sqrt(max(wer * (1 - wer), 0.0) / num_samples)
+    return wer, wer_eb
 
 
 @dataclasses.dataclass

@@ -50,10 +50,13 @@ def test_failure_rate_grows_with_rounds():
     assert f7 >= f1
 
 
-def test_wer_requires_odd_cycles():
+def test_wer_accepts_even_cycles():
+    """The published checkpoint notebooks sweep EVEN cycle counts (they
+    predate the reference's odd-cycles assert); the inversion must accept
+    them so the notebooks run unmodified (sim/common.wer_per_cycle)."""
     sim = _phenom_sim(_surface(3), 0.02, 0.02, batch_size=16)
-    with pytest.raises(AssertionError):
-        sim.WordErrorRate(num_rounds=4, num_samples=16)
+    wer, _ = sim.WordErrorRate(num_rounds=4, num_samples=16)
+    assert 0.0 <= wer <= 1.0
 
 
 def test_word_error_probability_in_range():
