@@ -38,9 +38,9 @@ def _bp_utilization(dec_x, dec_z, code, p, rate, key):
     iteration count, then converts the measured shots/s into modelled
     bandwidth and FLOP rates:
 
-      * edges E = nnz(H); one XLA BP iteration streams the (m, rw, B) and
-        (n, cw, B) f32 message planes ~3x each ->
-        bytes/shot/iter ~= 3 * 4 * (m*rw + n*cw) per sector;
+      * each sector's padded message planes are (m_s, rw_s, B) and
+        (n, cw_s, B) f32; one XLA BP iteration streams each ~3x ->
+        bytes/shot/iter ~= sum over sectors of 3 * 4 * (m_s*rw_s + n*cw_s);
       * min-sum compute is ~8 flops per edge per iteration (abs/sign/two
         mins/select/scale/sum/sub) -> flops/shot/iter ~= 8E per sector;
       * mfu_proxy = modelled FLOP rate / 197e12 (v5e bf16 peak).  BP is a
@@ -53,17 +53,17 @@ def _bp_utilization(dec_x, dec_z, code, p, rate, key):
     import numpy as np
 
     iters = []
+    planes = 0  # padded message-plane elements per shot, summed per sector
     for dec, h in ((dec_x, code.hz), (dec_z, code.hx)):
         err = jax.random.bernoulli(key, 2 * p / 3, (4096, code.N))
         synd = (err.astype(jnp.uint8) @ jnp.asarray(h.T)) % 2
         res = dec.bp_batch_device(synd.astype(jnp.uint8))
         iters.append(float(np.mean(np.asarray(res.iterations))))
+        m_s, n_s = h.shape
+        planes += m_s * int(h.sum(1).max()) + n_s * int(h.sum(0).max())
     edges = int(code.hx.sum() + code.hz.sum())
-    rw = max(int(code.hx.sum(1).max()), int(code.hz.sum(1).max()))
-    cw = max(int(code.hx.sum(0).max()), int(code.hz.sum(0).max()))
-    m = code.hx.shape[0] + code.hz.shape[0]
     iters_mean = float(np.mean(iters))
-    bytes_per_shot = 3 * 4 * (m * rw + 2 * code.N * cw) * iters_mean
+    bytes_per_shot = 3 * 4 * planes * iters_mean
     flops_per_shot = 8 * edges * iters_mean
     return {
         "bp_iters_per_shot": round(iters_mean, 2),

@@ -55,8 +55,13 @@ def _install_loadmat_redirect() -> None:
     The checkpoint notebooks call ``loadmat`` directly on absolute paths
     from the author's laptop (Single-Shot cells 16/21, Threshold cells
     7/8); the basenames (LP_*.mat, GenBicycleA*.mat) exist in the mounted
-    reference codes_lib/.  Idempotent; leaves existing paths untouched."""
+    reference codes_lib/.  Scoped to those known notebook basename
+    patterns so a genuinely missing/mistyped user path still raises, and
+    each redirect emits a one-line warning.  Idempotent; leaves existing
+    paths untouched."""
+    import fnmatch
     import os
+    import warnings
 
     import scipy.io as sio
 
@@ -64,12 +69,19 @@ def _install_loadmat_redirect() -> None:
         return
     orig = sio.loadmat
     ref_lib = "/root/reference/codes_lib"
+    known_patterns = ("LP_*.mat", "GenBicycleA*.mat")
 
     def loadmat(file_name, *args, **kwargs):
         if isinstance(file_name, str) and not os.path.exists(file_name):
-            cand = os.path.join(ref_lib, os.path.basename(file_name))
-            if os.path.exists(cand):
-                file_name = cand
+            base = os.path.basename(file_name)
+            if any(fnmatch.fnmatch(base, pat) for pat in known_patterns):
+                cand = os.path.join(ref_lib, base)
+                if os.path.exists(cand):
+                    warnings.warn(
+                        f"compat: loadmat({file_name!r}) redirected to {cand}",
+                        stacklevel=2,
+                    )
+                    file_name = cand
         return orig(file_name, *args, **kwargs)
 
     loadmat.__qldpc_redirect__ = True

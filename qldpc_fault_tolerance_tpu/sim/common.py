@@ -3,8 +3,10 @@
 The estimator contracts follow the reference exactly:
   * code-capacity WER: 1-(1-P_L)^(1/K) with binomial error bar
     (src/Simulators.py:170-188)
-  * per-qubit-per-cycle WER inversion requiring odd cycle counts
-    (src/Simulators.py:334-362)
+  * per-qubit-per-cycle WER inversion (src/Simulators.py:334-362); we keep
+    the notebook-era relaxations (even cycle counts, an error bar instead of
+    None) — see wer_per_cycle's docstring and API_PARITY.md "conscious
+    divergences"
 """
 from __future__ import annotations
 
@@ -138,12 +140,21 @@ def wer_per_cycle(error_count: int, num_samples: int, K: int, num_cycles: int):
         wer = (1.0 - (1 - 2 * per_qubit) ** (1 / num_cycles)) / 2
     else:
         wer = (1.0 + (-1 + 2 * per_qubit) ** (1 / num_cycles)) / 2
-    # binomial error bar on the per-cycle rate: the current reference
-    # returns None here (the eb computation is commented out at
-    # src/Simulators.py:340-351), but the notebook-era version returned one
-    # and the Single-Shot checkpoint's own executed plotting cells multiply
-    # eval_wer_std_list by scalars — a None would (and did) TypeError
-    wer_eb = np.sqrt(max(wer * (1 - wer), 0.0) / num_samples)
+    # Error bar: the current reference returns None here (the eb computation
+    # is commented out at src/Simulators.py:340-351), but the notebook-era
+    # version returned one and the Single-Shot checkpoint's executed plotting
+    # cells multiply eval_wer_std_list by scalars — a None would (and did)
+    # TypeError.  We reproduce the notebook-era propagation exactly
+    # (src/Simulators.py:340-351, commented block): binomial eb on the
+    # per-CYCLE logical rate (cycle inversion applied to the total rate
+    # first), then the (1-eb)^(1/K-1)/K factor as in wer_single_shot.
+    # One divergence from that block: for total rates above 1/2 (far above
+    # threshold) the inversion base 1-2L goes negative and the reference
+    # expression turns complex; we clamp it at 0, which saturates the eb at
+    # the binomial worst case per_cycle=1/2 instead of crashing.
+    per_cycle = (1.0 - max(1 - 2 * logical_error_rate, 0.0) ** (1 / num_cycles)) / 2
+    per_cycle_eb = np.sqrt(max((1 - per_cycle) * per_cycle, 0.0) / num_samples)
+    wer_eb = per_cycle_eb * ((1 - per_cycle_eb) ** (1 / K - 1)) / K
     return wer, wer_eb
 
 

@@ -88,8 +88,16 @@ def test_wer_math_matches_reference_formulas():
     w, _ = wer_per_cycle(100, 1000, K=4, num_cycles=5)
     per_qubit = 1 - (1 - 0.1) ** (1 / 4)
     assert np.isclose(w, (1 - (1 - 2 * per_qubit) ** (1 / 5)) / 2)
-    with pytest.raises(AssertionError):
-        wer_per_cycle(1, 10, K=2, num_cycles=4)  # even cycles rejected
+    # Even cycle counts are accepted (notebook-era behavior kept so the
+    # published checkpoint sweeps run unmodified — sim/common.py docstring);
+    # the current reference asserts odd at src/Simulators.py:353.
+    w_even, eb_even = wer_per_cycle(1, 10, K=2, num_cycles=4)
+    assert 0.0 <= w_even <= 1.0 and eb_even >= 0.0
+    # notebook-era eb propagation (src/Simulators.py:340-351 commented block)
+    plc = (1 - (1 - 2 * 0.1) ** (1 / 5)) / 2
+    plc_eb = np.sqrt((1 - plc) * plc / 1000)
+    w5, eb5 = wer_per_cycle(100, 1000, K=4, num_cycles=5)
+    assert np.isclose(eb5, plc_eb * ((1 - plc_eb) ** (1 / 4 - 1)) / 4)
 
 
 def test_reproducible_with_same_key():
