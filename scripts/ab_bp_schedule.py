@@ -1,0 +1,214 @@
+"""BP message-schedule A/B for the circuit-level p_c offset (VERDICT r3 #2c).
+
+The reference decodes with `ldpc.bp_decoder` binaries whose exact min-sum
+variant we cannot install in this image (tests/test_golden.py:1-19).  The
+era-appropriate ldpc v1 is a FLOODING (parallel) normalized min-sum — the
+same schedule our ops/bp.py implements — but ldpc v2 added a serial
+schedule, and serial vs flooding min-sum have different fixed points at
+the tiny iteration counts the notebooks use (dec1 max_iter = int(N/30) = 1
+for the d5 toric code).  This experiment bounds the schedule effect: decode
+ONE fixed detector sample set through the reference's round-chain with
+
+  arm flood:      numpy flooding min-sum dec1 + flooding BP+OSD final
+  arm serial1:    serial dec1, flooding final
+  arm serial_all: serial dec1 AND serial BP stage of the final BPOSD
+  arm production: the framework's own device decode chain (cross-checks
+                  numpy flooding == production flooding)
+
+All arms share the OSD-E(order 10) postprocess (decoders/osd.py) on their
+BP-failed shots.  If serial arms move WER by ~the observed p_c offset
+(~20%), decoder schedule is a live explanation; if not, it is eliminated.
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/ab_bp_schedule.py --d 5 --cycles 20 \
+      --p 2e-3 --shots 20000
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# numpy normalized min-sum, flooding and check-serial schedules
+def _check_supports(h):
+    return [np.flatnonzero(h[c]).astype(np.int64) for c in range(h.shape[0])]
+
+
+def _msgs_for_check(T, s_c, msf):
+    """T: (B, w) extrinsic inputs for one check; returns (B, w) messages."""
+    sgn = np.where(T < 0, -1.0, 1.0)
+    parity = sgn.prod(axis=1) * (1.0 - 2.0 * s_c)  # (B,)
+    absT = np.abs(T)
+    # min excluding self: min1/min2 trick
+    order = np.argsort(absT, axis=1)
+    min1 = np.take_along_axis(absT, order[:, :1], 1)[:, 0]
+    min2 = np.take_along_axis(absT, order[:, 1:2], 1)[:, 0]
+    amin = np.where(absT == min1[:, None], min2[:, None], min1[:, None])
+    # tie care: when several entries equal min1, excluding one still leaves
+    # min1; the == test above handles only the argmin — fix via count
+    ties = (absT == min1[:, None]).sum(1) > 1
+    amin = np.where(ties[:, None], min1[:, None], amin)
+    return msf * parity[:, None] * sgn * amin
+
+
+def bp_numpy(h, synd, llr0, max_iter, msf=0.625, schedule="flood"):
+    """Returns (error, converged, posterior_llr)."""
+    m, n = h.shape
+    B = synd.shape[0]
+    sup = _check_supports(h)
+    s = synd.astype(np.float64)
+    M = np.zeros((B, m, n), np.float64)
+    L = np.broadcast_to(llr0, (B, n)).copy()
+    e = np.zeros((B, n), np.uint8)
+    conv = np.zeros(B, bool)
+    for _ in range(max_iter):
+        if schedule == "flood":
+            newM = np.zeros_like(M)
+            for c in range(m):
+                S = sup[c]
+                T = (L[:, S] - M[:, c, S]) if False else \
+                    (np.broadcast_to(llr0[S], (B, len(S)))
+                     + M[:, :, S].sum(1) - M[:, c, S])
+                newM[:, c, S] = _msgs_for_check(T, s[:, c], msf)
+            M = newM
+            L = llr0 + M.sum(1)
+        else:  # check-serial
+            for c in range(m):
+                S = sup[c]
+                T = L[:, S] - M[:, c, S]
+                new = _msgs_for_check(T, s[:, c], msf)
+                L[:, S] += new - M[:, c, S]
+                M[:, c, S] = new
+        e = (L <= 0).astype(np.uint8)
+        syn_hat = (e @ h.T) % 2
+        conv = (syn_hat == synd).all(1)
+        if conv.all():
+            break
+    return e, conv, L
+
+
+def bposd_numpy(h, synd, llr0, channel_probs, max_iter, msf=0.625,
+                schedule="flood", osd_order=10):
+    from qldpc_fault_tolerance_tpu.decoders.osd import osd_postprocess
+
+    e, conv, L = bp_numpy(h, synd, llr0, max_iter, msf, schedule)
+    return osd_postprocess(h, synd, e, conv, L, channel_probs,
+                           osd_method="osd_e", osd_order=osd_order)
+
+
+# ---------------------------------------------------------------------------
+def run_chain(code, dets, obs, cycles, p, dec1_schedule, dec2_schedule,
+              chunk=5000):
+    """The reference's per-round residual feed-forward chain
+    (src/Simulators.py:612-641) in numpy, with selectable BP schedules."""
+    hx = code.hx.astype(np.uint8)
+    m, N = hx.shape
+    ext = np.hstack([hx, np.eye(m, dtype=np.uint8)])
+    p_data = 3 * 6 * (8 / 15) * p
+    p_synd = 7 * (8 / 15) * p
+    probs1 = np.hstack([np.full(N, p_data), np.full(m, p_synd)])
+    llr1 = np.log((1 - probs1) / probs1)
+    probs2 = np.full(N, p)
+    llr2 = np.log((1 - probs2) / probs2)
+    mi1 = max(1, int(N / 30))
+    mi2 = int(N / 10)
+    lx = code.lx.astype(np.uint8)
+    B = dets.shape[0]
+    fails = np.zeros(B, bool)
+    for i0 in range(0, B, chunk):
+        d = dets[i0:i0 + chunk]
+        o = obs[i0:i0 + chunk]
+        b = d.shape[0]
+        hist = d.reshape(b, cycles, m)
+        correction = np.zeros((b, N), np.uint8)
+        residual = np.zeros((b, m), np.uint8)
+        for j in range(cycles - 1):
+            corrected = hist[:, j] ^ residual
+            e1, _, _ = bp_numpy(ext, corrected, llr1, mi1,
+                                schedule=dec1_schedule)
+            data_cor = e1[:, :N]
+            correction ^= data_cor
+            residual = (corrected ^ (data_cor @ hx.T % 2)).astype(np.uint8)
+        corrected_final = hist[:, -1] ^ residual
+        final_cor = bposd_numpy(hx, corrected_final, llr2, probs2, mi2,
+                                schedule=dec2_schedule)
+        total = correction ^ final_cor
+        res_syn = corrected_final ^ (final_cor @ hx.T % 2).astype(np.uint8)
+        log_cor = (total @ lx.T % 2).astype(np.uint8)
+        res_log = o ^ log_cor
+        fails[i0:i0 + chunk] = res_syn.any(1) | res_log.any(1)
+    return int(fails.sum())
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--d", type=int, default=5)
+    ap.add_argument("--cycles", type=int, default=20)
+    ap.add_argument("--p", type=float, default=2e-3)
+    ap.add_argument("--shots", type=int, default=20000)
+    args = ap.parse_args()
+
+    from ab_frame_sim import NaiveFrameSim, build_toric_circuit
+
+    code, circ = build_toric_circuit(args.d, args.cycles, args.p)
+    naive = NaiveFrameSim(circ)
+    rng = np.random.default_rng(42)
+    parts = [naive.run(min(10000, args.shots - i), rng)
+             for i in range(0, args.shots, 10000)]
+    dets = np.concatenate([x[0] for x in parts])
+    obs = np.concatenate([x[1] for x in parts])
+    print(f"toric d{args.d} cycles={args.cycles} p={args.p} "
+          f"shots={args.shots} (one fixed sample set for all arms)")
+
+    for name, s1, s2 in (("flood", "flood", "flood"),
+                         ("serial1", "serial", "flood"),
+                         ("serial_all", "serial", "serial")):
+        f = run_chain(code, dets, obs, args.cycles, args.p, s1, s2)
+        print(f"arm {name:11s}: failures {f:6d}  rate {f / args.shots:.5f}")
+
+    # production arm: same dets through the framework's device chain
+    import jax.numpy as jnp
+
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.sim import CodeSimulator_Circuit
+    from qldpc_fault_tolerance_tpu.sim.circuit import _decode_rounds_given
+
+    m, N = code.hx.shape
+    error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": args.p,
+                    "p_idling_gate": 0}
+    ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+    p_data = 3 * 6 * (8 / 15) * args.p
+    p_synd = 7 * (8 / 15) * args.p
+    dec1 = BPDecoder(ext, np.hstack([p_data * np.ones(N),
+                                     p_synd * np.ones(m)]),
+                     max_iter=int(N / 30), bp_method="minimum_sum",
+                     ms_scaling_factor=0.625)
+    dec2 = BPOSD_Decoder(code.hx, args.p * np.ones(N),
+                         max_iter=int(N / 10), bp_method="minimum_sum",
+                         ms_scaling_factor=0.625, osd_method="osd_e",
+                         osd_order=10)
+    sim = CodeSimulator_Circuit(code=code, decoder1_z=dec1, decoder2_z=dec2,
+                                p=args.p, num_cycles=args.cycles,
+                                error_params=error_params, seed=0)
+    sim._generate_circuit()
+    f_prod = 0
+    for i in range(0, args.shots, 5000):
+        b = min(5000, args.shots - i)
+        pending = _decode_rounds_given(
+            sim._cfg(b), sim._dev_state,
+            jnp.asarray(dets[i:i + b]), jnp.asarray(obs[i:i + b]))
+        f_prod += int(np.asarray(sim._finish_batch(pending)).sum())
+    print(f"arm production : failures {f_prod:6d}  "
+          f"rate {f_prod / args.shots:.5f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,116 @@
+"""Per-code decoder-variant sensitivity for the circuit-level p_c offset.
+
+The fit-sensitivity analysis (PARITY_r4.md) shows the notebook ThresholdEst
+is invariant to uniform WER scaling and to per-code log-log tilts — fitted
+p_c responds ONLY to the relative suppression between family members.  So
+any decoder-implementation difference vs the reference's `ldpc` binaries can
+move p_c only through its CODE-SIZE-DEPENDENT effect (dec1 max_iter =
+int(N/30) = 1/5/11 for toric d5/d9/d13).  This experiment measures, per
+code, how much plausible ldpc-variant hypotheses move the circuit-level WER
+on one fixed detector sample set:
+
+  arm mi-1 / mi+1 : one fewer/more dec1 BP iteration (iteration-count
+                    off-by-one semantics)
+  arm mi2-       : final BPOSD BP stage one fewer iteration
+
+Ratios WER(arm)/WER(base) feed back into the recorded round-3 grids
+(PARITY_results.jsonl) to see whether any hypothesis reproduces the
+published p_c (scripts/ab_fit_propagation.py).
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/ab_iteration.py --cycles 20 --p 2e-3
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def run_code(d: int, cycles: int, p: float, shots: int, arms):
+    import jax
+    import jax.numpy as jnp
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, ring_code
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.sim import CodeSimulator_Circuit
+    from qldpc_fault_tolerance_tpu.sim.circuit import _decode_rounds_given
+
+    code = hgp(ring_code(d), ring_code(d), name=f"toric_d{d}")
+    m, N = code.hx.shape
+    error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
+                    "p_idling_gate": 0}
+    ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+    p_data = 3 * 6 * (8 / 15) * p
+    p_synd = 7 * (8 / 15) * p
+    probs1 = np.hstack([p_data * np.ones(N), p_synd * np.ones(m)])
+    mi1 = int(N / 30)
+    mi2 = int(N / 10)
+
+    def make_sim(mi1_, mi2_):
+        dec1 = BPDecoder(ext, probs1, max_iter=max(mi1_, 1),
+                         bp_method="minimum_sum", ms_scaling_factor=0.625)
+        dec2 = BPOSD_Decoder(code.hx, p * np.ones(N), max_iter=max(mi2_, 1),
+                             bp_method="minimum_sum", ms_scaling_factor=0.625,
+                             osd_method="osd_e", osd_order=10)
+        sim = CodeSimulator_Circuit(
+            code=code, decoder1_z=dec1, decoder2_z=dec2, p=p,
+            num_cycles=cycles, error_params=error_params, seed=0)
+        sim._generate_circuit()
+        return sim
+
+    # one fixed detector sample set per code
+    base = make_sim(mi1, mi2)
+    chunk = 5000
+    dets_all, obs_all = [], []
+    for i in range(0, shots, chunk):
+        b = min(chunk, shots - i)
+        dd, oo = base._sampler.sample(jax.random.PRNGKey(900 + i), b)
+        dets_all.append(np.asarray(dd))
+        obs_all.append(np.asarray(oo))
+    dets = np.concatenate(dets_all)
+    obs = np.concatenate(obs_all)
+
+    out = {}
+    for name, (d1_, d2_) in arms.items():
+        sim = make_sim(mi1 + d1_, mi2 + d2_)
+        f = 0
+        for i in range(0, shots, chunk):
+            b = min(chunk, shots - i)
+            pending = _decode_rounds_given(
+                sim._cfg(b), sim._dev_state,
+                jnp.asarray(dets[i:i + b]), jnp.asarray(obs[i:i + b]))
+            f += int(np.asarray(sim._finish_batch(pending)).sum())
+        out[name] = f
+        print(f"  d{d:<2d} mi1={max(mi1 + d1_, 1):<2d} mi2={mi2 + d2_:<3d} "
+              f"arm {name:6s}: {f:6d}/{shots} = {f / shots:.5f}", flush=True)
+    return {"d": d, "mi1": mi1, "mi2": mi2, "shots": shots,
+            "failures": out}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cycles", type=int, default=20)
+    ap.add_argument("--p", type=float, default=2e-3)
+    ap.add_argument("--out", default=os.path.join(REPO, "AB_ITERATION.json"))
+    args = ap.parse_args()
+    arms = {"base": (0, 0), "mi-1": (-1, 0), "mi+1": (1, 0),
+            "mi2-1": (0, -1)}
+    results = []
+    for d, shots in ((5, 60000), (9, 30000), (13, 15000)):
+        print(f"toric d{d}, cycles={args.cycles}, p={args.p}:", flush=True)
+        results.append(run_code(d, args.cycles, args.p, shots, arms))
+    with open(args.out, "w") as f:
+        json.dump({"cycles": args.cycles, "p": args.p,
+                   "results": results}, f, indent=1)
+    print(f"written to {args.out}")
+
+
+if __name__ == "__main__":
+    main()

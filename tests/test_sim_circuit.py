@@ -145,3 +145,17 @@ def test_st_target_failure_sampling(surface3):
     )
     assert total % 64 == 0 and total <= 8 * 64
     assert wer >= 0
+
+
+def test_pz_alias(surface3):
+    """Notebook-era `pz=` keyword maps onto p for both circuit engines
+    (Threshold ckpt cell 4 passes pz=p; the current reference renamed the
+    parameter at src/Simulators.py:388) — API_PARITY.md divergence #3."""
+    ep = dict(ERROR_PARAMS_CX_ONLY)
+    sim = CodeSimulator_Circuit(code=surface3, num_cycles=3,
+                                error_params=ep, pz=0.0123)
+    assert sim.pz == 0.0123 and sim.synd_prob == 0.0123
+    sim_st = CodeSimulator_Circuit_SpaceTime(code=surface3, num_cycles=7,
+                                             num_rep=3, error_params=ep,
+                                             pz=0.0123)
+    assert sim_st.pz == 0.0123
