@@ -72,11 +72,10 @@ def bp_numpy(h, synd, llr0, max_iter, msf=0.625, schedule="flood"):
     for _ in range(max_iter):
         if schedule == "flood":
             newM = np.zeros_like(M)
+            colsum = M.sum(1)                                  # (B, n)
             for c in range(m):
                 S = sup[c]
-                T = (L[:, S] - M[:, c, S]) if False else \
-                    (np.broadcast_to(llr0[S], (B, len(S)))
-                     + M[:, :, S].sum(1) - M[:, c, S])
+                T = llr0[S] + colsum[:, S] - M[:, c, S]
                 newM[:, c, S] = _msgs_for_check(T, s[:, c], msf)
             M = newM
             L = llr0 + M.sum(1)
@@ -177,24 +176,13 @@ def main():
     # production arm: same dets through the framework's device chain
     import jax.numpy as jnp
 
-    from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
+    from parity import make_circuit_decoders
     from qldpc_fault_tolerance_tpu.sim import CodeSimulator_Circuit
     from qldpc_fault_tolerance_tpu.sim.circuit import _decode_rounds_given
 
-    m, N = code.hx.shape
     error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": args.p,
                     "p_idling_gate": 0}
-    ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
-    p_data = 3 * 6 * (8 / 15) * args.p
-    p_synd = 7 * (8 / 15) * args.p
-    dec1 = BPDecoder(ext, np.hstack([p_data * np.ones(N),
-                                     p_synd * np.ones(m)]),
-                     max_iter=int(N / 30), bp_method="minimum_sum",
-                     ms_scaling_factor=0.625)
-    dec2 = BPOSD_Decoder(code.hx, args.p * np.ones(N),
-                         max_iter=int(N / 10), bp_method="minimum_sum",
-                         ms_scaling_factor=0.625, osd_method="osd_e",
-                         osd_order=10)
+    dec1, dec2 = make_circuit_decoders(code, args.p)
     sim = CodeSimulator_Circuit(code=code, decoder1_z=dec1, decoder2_z=dec2,
                                 p=args.p, num_cycles=args.cycles,
                                 error_params=error_params, seed=0)

@@ -1,0 +1,79 @@
+"""Propagate ab_iteration.py's per-code WER ratios through the notebook fit.
+
+For each decoder-variant hypothesis ("the reference's ldpc binaries behave
+like our arm X"), scale the recorded round-3 toric_circuit WER grids by the
+measured per-code ratio WER(arm)/WER(base) and refit with the notebook's
+two-stage ThresholdEst.  If a hypothesis lands the fitted p_c on the
+published value, it quantitatively explains the offset; if none reaches it,
+the bound ("no tested decoder variant moves p_c by more than Y%") is the
+deliverable.
+
+Usage: python scripts/ab_fit_propagation.py [--ab AB_ITERATION.json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from parity import EXPERIMENTS, notebook_threshold_est  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ab", default=os.path.join(REPO, "AB_ITERATION.json"))
+    ap.add_argument("--cycles", type=int, nargs="*", default=[20, 25, 30])
+    args = ap.parse_args()
+    ab = json.load(open(args.ab))
+    arms = list(ab["results"][0]["failures"])
+    ratios = {}
+    for arm in arms:
+        ratios[arm] = [r["failures"][arm] / max(r["failures"]["base"], 1)
+                       for r in ab["results"]]
+    print("measured per-code WER ratios (d5, d9, d13):")
+    for arm, rr in ratios.items():
+        print(f"  {arm:7s}: {[f'{x:.3f}' for x in rr]}")
+
+    recs = [json.loads(l) for l in open(os.path.join(REPO,
+                                                     "PARITY_results.jsonl"))]
+    published = EXPERIMENTS["toric_circuit"]["published"]
+    for cycles in args.cycles:
+        rows = [r for r in recs
+                if r["experiment"] == "toric_circuit"
+                and r["cycles"] == cycles
+                and r.get("circuit_type") in (None, "coloration")
+                # exclude decoder-variant A/B and 4-member d_eff rows (same
+                # filter as parity_report.py) — only msf-0.625 3-member rows
+                # are valid baselines to perturb
+                and r.get("msf") in (None, 0.625)
+                and not r.get("members")]
+        if not rows:
+            continue
+        pcs = {arm: [] for arm in arms}
+        for r in rows:
+            wer = np.array(r["wer"])
+            for arm in arms:
+                w2 = wer * np.array(ratios[arm])[:, None]
+                try:
+                    pc, _, _ = notebook_threshold_est(r["p_list"], w2)
+                except RuntimeError:
+                    continue
+                pcs[arm].append(pc)
+        print(f"\ncycles={cycles} (published p_c = {published[cycles]}):")
+        for arm in arms:
+            if pcs[arm]:
+                mu = float(np.mean(pcs[arm]))
+                print(f"  arm {arm:7s}: mean p_c {mu:.5f} over "
+                      f"{len(pcs[arm])} seeds  "
+                      f"(vs published {mu / published[cycles] - 1:+.1%})")
+
+
+if __name__ == "__main__":
+    main()

@@ -39,10 +39,10 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 from qldpc_fault_tolerance_tpu.circuits.ir import (  # noqa: E402
     Circuit,
-    MEASUREMENT_NAMES,
     RecTarget,
 )
 
@@ -365,7 +365,7 @@ def mode_decode(args):
     import jax
     import jax.numpy as jnp
 
-    from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
+    from parity import make_circuit_decoders
     from qldpc_fault_tolerance_tpu.sim import CodeSimulator_Circuit
 
     p, cycles = args.p, args.cycles
@@ -373,17 +373,7 @@ def mode_decode(args):
     code = hgp(ring_code(args.d), ring_code(args.d), name=f"toric_d{args.d}")
     error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
                     "p_idling_gate": 0}
-    p_data = 3 * 6 * (8 / 15) * p
-    p_synd = 7 * (8 / 15) * p
-    ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
-    dec1 = BPDecoder(ext, np.hstack([p_data * np.ones(code.hx.shape[1]),
-                                     p_synd * np.ones(code.hx.shape[0])]),
-                     max_iter=int(code.N / 30), bp_method="minimum_sum",
-                     ms_scaling_factor=0.625)
-    dec2 = BPOSD_Decoder(code.hx, p * np.ones(code.N),
-                         max_iter=int(code.N / 10), bp_method="minimum_sum",
-                         ms_scaling_factor=0.625, osd_method="osd_e",
-                         osd_order=10)
+    dec1, dec2 = make_circuit_decoders(code, p)
     sim = CodeSimulator_Circuit(code=code, decoder1_z=dec1, decoder2_z=dec2,
                                 p=p, num_cycles=cycles,
                                 error_params=error_params, seed=0,

@@ -31,34 +31,30 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
 
 
 def run_code(d: int, cycles: int, p: float, shots: int, arms):
     import jax
     import jax.numpy as jnp
 
+    from parity import make_circuit_decoders
     from qldpc_fault_tolerance_tpu.codes import hgp, ring_code
-    from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
     from qldpc_fault_tolerance_tpu.sim import CodeSimulator_Circuit
     from qldpc_fault_tolerance_tpu.sim.circuit import _decode_rounds_given
 
     code = hgp(ring_code(d), ring_code(d), name=f"toric_d{d}")
-    m, N = code.hx.shape
+    N = code.hx.shape[1]
     error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
                     "p_idling_gate": 0}
-    ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
-    p_data = 3 * 6 * (8 / 15) * p
-    p_synd = 7 * (8 / 15) * p
-    probs1 = np.hstack([p_data * np.ones(N), p_synd * np.ones(m)])
     mi1 = int(N / 30)
     mi2 = int(N / 10)
 
-    def make_sim(mi1_, mi2_):
-        dec1 = BPDecoder(ext, probs1, max_iter=max(mi1_, 1),
-                         bp_method="minimum_sum", ms_scaling_factor=0.625)
-        dec2 = BPOSD_Decoder(code.hx, p * np.ones(N), max_iter=max(mi2_, 1),
-                             bp_method="minimum_sum", ms_scaling_factor=0.625,
-                             osd_method="osd_e", osd_order=10)
+    def make_sim(mi1_, mi2_, method1="minimum_sum", method2="minimum_sum",
+                 msf1=0.625, msf2=0.625):
+        dec1, dec2 = make_circuit_decoders(
+            code, p, msf1=msf1, msf2=msf2, mi1=mi1_, mi2=mi2_,
+            method1=method1, method2=method2)
         sim = CodeSimulator_Circuit(
             code=code, decoder1_z=dec1, decoder2_z=dec2, p=p,
             num_cycles=cycles, error_params=error_params, seed=0)
@@ -78,8 +74,8 @@ def run_code(d: int, cycles: int, p: float, shots: int, arms):
     obs = np.concatenate(obs_all)
 
     out = {}
-    for name, (d1_, d2_) in arms.items():
-        sim = make_sim(mi1 + d1_, mi2 + d2_)
+    for name, (d1_, d2_, *rest) in arms.items():
+        sim = make_sim(mi1 + d1_, mi2 + d2_, *rest)
         f = 0
         for i in range(0, shots, chunk):
             b = min(chunk, shots - i)
@@ -100,8 +96,8 @@ def main():
     ap.add_argument("--p", type=float, default=2e-3)
     ap.add_argument("--out", default=os.path.join(REPO, "AB_ITERATION.json"))
     args = ap.parse_args()
-    arms = {"base": (0, 0), "mi-1": (-1, 0), "mi+1": (1, 0),
-            "mi2-1": (0, -1)}
+    arms = json.loads(os.environ.get("AB_ARMS", "null")) or {
+        "base": (0, 0), "mi-1": (-1, 0), "mi+1": (1, 0), "mi2-1": (0, -1)}
     results = []
     for d, shots in ((5, 60000), (9, 30000), (13, 15000)):
         print(f"toric d{d}, cycles={args.cycles}, p={args.p}:", flush=True)

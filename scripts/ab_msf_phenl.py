@@ -1,0 +1,62 @@
+"""Consistency check for the msf-1.0 hypothesis (scripts/ab_iteration.py).
+
+The msf=1.0 arm reproduces the published toric_circuit p_c at 20/25/30
+cycles.  But the same `ldpc` binaries decode the phenl experiments, which
+MATCH our msf=0.625 results — so the hypothesis survives only if the phenl
+chain is msf-INsensitive (its window decodes see q=0 clean syndromes and
+its final BPOSD sees iid data noise at 5-10x higher p).  This measures the
+phenl WER under both msf values on the same error stream (same seed ->
+identical sampled errors; only the decoders differ).
+
+Usage: JAX_PLATFORMS=cpu python scripts/ab_msf_phenl.py
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    from qldpc_fault_tolerance_tpu.codes import hgp, ring_code
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.sim import CodeSimulator_Phenon
+
+    p = 1.4e-2
+    cycles = 20
+    for d, shots in ((5, 40000), (9, 20000), (13, 10000)):
+        code = hgp(ring_code(d), ring_code(d), name=f"toric_d{d}")
+        pauli = [p / 3] * 3
+        two_thirds = pauli[0] + pauli[1]
+        m = code.hx.shape[0]
+        ext_x = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+        ext_z = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+        for msf in (0.625, 1.0):
+            kw = dict(bp_method="minimum_sum", ms_scaling_factor=msf)
+            dec1_x = BPDecoder(ext_x, two_thirds * np.ones(ext_x.shape[1]),
+                               max_iter=int(code.N / 30), **kw)
+            dec1_z = BPDecoder(ext_z, two_thirds * np.ones(ext_z.shape[1]),
+                               max_iter=int(code.N / 30), **kw)
+            dec2_x = BPOSD_Decoder(code.hz, two_thirds * np.ones(code.N),
+                                   max_iter=int(code.N / 10),
+                                   osd_method="osd_e", osd_order=10, **kw)
+            dec2_z = BPOSD_Decoder(code.hx, two_thirds * np.ones(code.N),
+                                   max_iter=int(code.N / 10),
+                                   osd_method="osd_e", osd_order=10, **kw)
+            sim = CodeSimulator_Phenon(
+                code=code, decoder1_x=dec1_x, decoder1_z=dec1_z,
+                decoder2_x=dec2_x, decoder2_z=dec2_z,
+                pauli_error_probs=pauli, q=0, seed=77, batch_size=2000,
+            )
+            count, total = sim._count_failures(cycles, shots)
+            print(f"d{d:<2d} msf={msf}: {count:5d}/{total} = "
+                  f"{count / total:.5f}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

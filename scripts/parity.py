@@ -93,10 +93,13 @@ def toric_codes():
             for d in (5, 9, 13)]
 
 
-def hgp_codes():
+def hgp_codes(tags=("n225", "n625", "n1600")):
+    """Threshold ckpt cells 12/29 sweep the 3-member family; pass
+    ``tags=("n225","n625","n1225","n1600")`` for the 4-member variant
+    (Single-Shot cell 4's family) — used for the per-member d_eff table,
+    NOT for published-p_c comparison (the published fits are 3-member)."""
     lib = os.path.join(REPO, "codes_lib_tpu")
-    return [load_code(os.path.join(lib, f"hgp_34_{t}.npz"))
-            for t in ("n225", "n625", "n1600")]
+    return [load_code(os.path.join(lib, f"hgp_34_{t}.npz")) for t in tags]
 
 
 def phenl_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
@@ -130,25 +133,48 @@ def phenl_cell_wer(code, eval_p, cycles, samples, seed, batch_size):
     return wer_notebook(count, total, code.K, cycles)
 
 
+def make_circuit_decoders(code, p, msf1=0.625, msf2=0.625,
+                          mi1=None, mi2=None, method1="minimum_sum",
+                          method2="minimum_sum"):
+    """The notebook's circuit-threshold decoder recipe (Threshold ckpt
+    cell 4) — THE shared single source for every A/B script (ab_bp_schedule,
+    ab_frame_sim, ab_iteration import this so arm comparisons can never
+    drift from the parity baseline): dec1 = BP on [hx|I] with
+    p_data=3*6*(8/15)p / p_synd=7*(8/15)p priors and int(N/30) iterations;
+    dec2 = BPOSD(osd_e, order 10) on hx with int(N/10) iterations."""
+    p_data = 3 * 6 * (8 / 15) * p
+    p_synd = 7 * (8 / 15) * p
+    m = code.hx.shape[0]
+    ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+    dec1 = BPDecoder(
+        ext,
+        np.hstack([p_data * np.ones(code.hx.shape[1]),
+                   p_synd * np.ones(m)]),
+        max_iter=max(1, int(code.N / 30) if mi1 is None else mi1),
+        bp_method=method1, ms_scaling_factor=msf1)
+    dec2 = BPOSD_Decoder(
+        code.hx, p * np.ones(code.N),
+        max_iter=max(1, int(code.N / 10) if mi2 is None else mi2),
+        bp_method=method2, ms_scaling_factor=msf2,
+        osd_method="osd_e", osd_order=10)
+    return dec1, dec2
+
+
 def circuit_cell_wer(code, eval_p, cycles, samples, seed, batch_size,
-                     circuit_type="coloration"):
-    """CodeFamilyCircuitThreshold inner loop (Threshold ckpt cell 4)."""
+                     circuit_type="coloration", msf=0.625, msf1=None,
+                     msf2=None):
+    """CodeFamilyCircuitThreshold inner loop (Threshold ckpt cell 4).
+
+    ``msf`` overrides the min-sum scaling factor of both decoders;
+    ``msf1``/``msf2`` override them separately (the notebook's dec1 is an
+    `ldpc.bp_decoder`, dec2 a `bposd.bposd_decoder` — DIFFERENT binaries
+    that may treat ms_scaling_factor differently; PARITY_r4.md msf A/B)."""
+    msf1 = msf if msf1 is None else msf1
+    msf2 = msf if msf2 is None else msf2
     p = eval_p
     error_params = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": p,
                     "p_idling_gate": 0}
-    p_data = 3 * 6 * (8 / 15) * p
-    p_synd = 7 * (8 / 15) * p
-    ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
-    dec1_z = BPDecoder(
-        ext,
-        np.hstack([p_data * np.ones(code.hx.shape[1]),
-                   p_synd * np.ones(code.hx.shape[0])]),
-        max_iter=int(code.N / 30), bp_method="minimum_sum",
-        ms_scaling_factor=0.625)
-    dec2_z = BPOSD_Decoder(code.hx, p * np.ones(code.N),
-                           max_iter=int(code.N / 10), bp_method="minimum_sum",
-                           ms_scaling_factor=0.625, osd_method="osd_e",
-                           osd_order=10)
+    dec1_z, dec2_z = make_circuit_decoders(code, p, msf1=msf1, msf2=msf2)
     sim = CodeSimulator_Circuit(
         code=code, decoder1_z=dec1_z, decoder2_z=dec2_z, p=p,
         num_cycles=cycles, error_params=error_params,
@@ -218,12 +244,22 @@ def _run_cell_with_retry(cell, *args, retries: int = 3, **kwargs):
 
 
 def run_experiment(name, cycles_list, seeds, scale, batch_size,
-                   seed_start=0, circuit_type=None):
+                   seed_start=0, circuit_type=None, members=None, msf=None):
     exp = EXPERIMENTS[name]
-    codes = exp["codes"]()
+    if members and exp["codes"] is not hgp_codes:
+        raise SystemExit("--members applies only to the hgp experiments")
+    codes = exp["codes"](tuple(members)) if members else exp["codes"]()
     cell_kwargs = {}
     if circuit_type is not None:
         cell_kwargs["circuit_type"] = circuit_type
+    if msf is not None:
+        if exp["cell"] is not circuit_cell_wer:
+            raise SystemExit("--msf applies only to the circuit experiments")
+        cell_kwargs["msf1"] = msf if msf != "d1only" else 1.0
+        if msf == "d1only":
+            cell_kwargs["msf2"] = 0.625
+        else:
+            cell_kwargs["msf2"] = msf
     for cycles in cycles_list:
         published = exp["published"].get(cycles)
         samples = int(exp["samples_base"] * 3 / cycles * scale)
@@ -244,7 +280,9 @@ def run_experiment(name, cycles_list, seeds, scale, batch_size,
                 print(f"fit failed: {e}")
             rec = {
                 "experiment": name, "cycles": cycles, "seed": seed,
-                "circuit_type": circuit_type,
+                "circuit_type": circuit_type, "msf": msf,
+                "members": [c.name or f"code{ci}"
+                            for ci, c in enumerate(codes)] if members else None,
                 "samples_per_cell": samples, "p_c": pc, "A": A,
                 "d_eff": d_list, "published_p_c": published,
                 "wer": wer.tolist(), "p_list": list(map(float, exp["p_list"])),
@@ -272,6 +310,14 @@ def main():
                     choices=["coloration", "coloration_hk", "random"],
                     help="override the circuit engines' CX scheduler (A/B "
                          "experiments for schedule sensitivity)")
+    ap.add_argument("--msf", default=None,
+                    type=lambda v: v if v == "d1only" else float(v),
+                    help="override the circuit cells' ms_scaling_factor "
+                         "(msf-1.0 hypothesis A/B, PARITY_r4.md)")
+    ap.add_argument("--members", nargs="*", default=None,
+                    help="hgp member tags override, e.g. n225 n625 n1225 "
+                         "n1600 (d_eff instrument; published p_c rows are "
+                         "3-member)")
     ap.add_argument("--warmup", action="store_true",
                     help="run a tiny-scale pass of the same cells first so "
                          "the recorded elapsed_s measures the warm-process "
@@ -289,13 +335,15 @@ def main():
                        (args.cycles or sorted(EXPERIMENTS[args.experiment]
                                               ["published"]))[:1],
                        1, 0.003, args.batch_size, seed_start=args.seed_start,
-                       circuit_type=args.circuit_type)
+                       circuit_type=args.circuit_type, members=args.members,
+                       msf=args.msf)
         RESULTS = real_results
     exp = EXPERIMENTS[args.experiment]
     cycles_list = args.cycles or sorted(exp["published"])
     run_experiment(args.experiment, cycles_list, args.seeds, args.scale,
                    args.batch_size, seed_start=args.seed_start,
-                   circuit_type=args.circuit_type)
+                   circuit_type=args.circuit_type, members=args.members,
+                   msf=args.msf)
 
 
 if __name__ == "__main__":

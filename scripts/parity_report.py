@@ -80,6 +80,11 @@ def main():
     groups = defaultdict(list)
     for line in open(args.results):
         r = json.loads(line)
+        if r.get("msf") not in (None, 0.625) or r.get("members"):
+            # decoder-variant A/B rows (msf hypothesis) and 4-member d_eff
+            # runs are analyzed separately (PARITY_r4.md), never mixed into
+            # the published-comparison table
+            continue
         sched = r.get("circuit_type") or "coloration"
         groups[(r["experiment"], r["cycles"], sched)].append(r)
 
