@@ -221,11 +221,14 @@ EXPERIMENTS = {
 }
 
 
-def _run_cell_with_retry(cell, *args, retries: int = 3, **kwargs):
+def _run_cell_with_retry(cell, *args, retries: int = 5, **kwargs):
     """The tunneled TPU worker intermittently crashes mid-dispatch on large
     programs (infrastructure flake — it auto-restarts).  Retry the cell
     after dropping all device-resident caches; results are unaffected
-    (cells are deterministic in their seed)."""
+    (cells are deterministic in their seed).  Backoff grows because a
+    crashed worker can take minutes to come back — three quick retries in
+    ~30 s all land on the dead worker and burn the whole budget (observed
+    round 4, hgp_phenl 4-member run)."""
     import jax
 
     import qldpc_fault_tolerance_tpu as q
@@ -236,11 +239,12 @@ def _run_cell_with_retry(cell, *args, retries: int = 3, **kwargs):
         except jax.errors.JaxRuntimeError as e:
             if attempt == retries - 1:
                 raise
+            wait = 15 * 2 ** attempt  # 15/30/60/120 s
             print(f"TPU worker error ({str(e).splitlines()[0][:90]}); "
-                  f"resetting device caches and retrying "
+                  f"resetting device caches, retrying in {wait}s "
                   f"({attempt + 1}/{retries})", file=sys.stderr)
             q.reset_device_state()
-            time.sleep(10)
+            time.sleep(wait)
 
 
 def run_experiment(name, cycles_list, seeds, scale, batch_size,
