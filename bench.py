@@ -38,16 +38,18 @@ def _bp_utilization(dec_x, dec_z, code, p, rate, key):
     Decodes one diagnostic batch per sector to measure the real iteration
     distribution, then models the HBM traffic the decode ACTUALLY pays:
 
-      * the first ``head`` iterations (3) of every shot run in the
-        VMEM-resident Pallas kernel (ops/bp_pallas.py) — messages never
-        touch HBM; the kernel's HBM cost is its I/O only:
+      * when the decoder's two-phase Pallas path runs (mirrored branch by
+        branch from ops/bp.py, constants imported from there), the head,
+        progressive-deepen segment AND straggler tail are all VMEM-resident
+        — messages never touch HBM and the kernel's HBM cost is its I/O:
         syndromes in (m_s bytes/shot), error out (n), posterior LLRs out
-        (4n), converged/iteration planes (~5) per sector;
-      * only straggler shots (unconverged after the head, measured
-        fraction ``tail_frac``) re-decode through the streaming tail;
-        each of their iterations streams the padded message planes
-        (m_s*rw_s + n*cw_s f32 elements) ~3x ->
-        3 * 4 * planes bytes per tail-iteration;
+        (4n), flags (~8) per sector;
+      * branches that fall off the Pallas path stream the padded message
+        planes (m_s*rw_s + n*cw_s f32 elements) ~3x per iteration: the
+        XLA tail (when the compacted capacity has no feasible Pallas
+        tile), the full-batch fallback (measured straggler count above the
+        big tier even after the deepened head), and plain streaming
+        decode (two_phase disabled / small batch / small max_iter);
       * mfu_proxy uses ~8 flops/edge/iteration over the measured MEAN
         iteration count (head work included — flops are paid in VMEM too).
 
@@ -68,33 +70,59 @@ def _bp_utilization(dec_x, dec_z, code, p, rate, key):
     import jax.numpy as jnp
     import numpy as np
 
-    head_iters = 3  # ops/bp.py bp_decode_two_phase default
+    from qldpc_fault_tolerance_tpu.ops import bp as bp_mod
+
+    diag_b = 4096
     iters_mean_all = []
     bytes_per_shot = 0.0
     edges = int(code.hx.sum() + code.hz.sum())
     for dec, h in ((dec_x, code.hz), (dec_z, code.hx)):
-        err = jax.random.bernoulli(key, 2 * p / 3, (4096, code.N))
+        err = jax.random.bernoulli(key, 2 * p / 3, (diag_b, code.N))
         synd = (err.astype(jnp.uint8) @ jnp.asarray(h.T)) % 2
         res = dec.bp_batch_device(synd.astype(jnp.uint8))
         it = np.asarray(res.iterations, np.float64)
         iters_mean_all.append(float(it.mean()))
         m_s, n_s = h.shape
         planes = m_s * int(h.sum(1).max()) + n_s * int(h.sum(0).max())
-        has_pallas = getattr(dec, "_pallas_head", None) is not None
         io_bytes = m_s + n_s + 4 * n_s + 8  # synd + error + posterior + flags
-        if has_pallas:
-            # head, progressive-deepen segment AND straggler tail all run in
-            # the VMEM-resident Pallas kernel (ops/bp.py two-phase: the tail
-            # reuses bp_head_pallas with early_stop) — NO iteration streams
-            # message planes through HBM; the kernel's HBM cost is its I/O.
-            # The only streaming path is the full-batch XLA fallback, which
-            # engages when stragglers after the deepened head still exceed
-            # B/4 — record its modelled cost separately scaled by the
-            # measured probability of that branch.
-            deep_bad = float((it > max(4 * head_iters, 12)).mean())
-            full_frac = 1.0 if deep_bad > 0.25 else 0.0
-            bytes_per_shot += io_bytes + full_frac * (
-                it.mean() * 3 * 4 * planes)
+        # Mirror bp_batch_device's ACTUAL branch structure (constants
+        # imported from ops/bp.py so this model cannot silently rot):
+        head = bp_mod.TWO_PHASE_HEAD_ITERS
+        pallas = getattr(dec, "_pallas_head", None)
+        two_phase_runs = (getattr(dec, "two_phase", True)
+                          and diag_b >= 64 and dec.max_iter > 8)
+        pallas_runs = (two_phase_runs and pallas is not None
+                       and pallas.max_block_b(diag_b) > 0)
+        if pallas_runs:
+            # head/deepen/tail are VMEM-resident (tail reuses
+            # bp_head_pallas with early_stop): the kernel's HBM cost is its
+            # I/O unless a branch falls off the Pallas path —
+            # (a) straggler tail whose compacted capacity has no feasible
+            #     Pallas tile streams via XLA; (b) the full-batch fallback
+            #     (stragglers exceed the big tier even after the deepened
+            #     head) streams the whole batch.
+            tail_cap = max(1, diag_b // bp_mod.TWO_PHASE_TAIL_DIV)
+            big_tier = tail_cap * bp_mod.TWO_PHASE_BIG_TIER_MULT
+            head2 = bp_mod.two_phase_head2_iters(head, dec.max_iter)
+            stream_per_iter = 3 * 4 * planes
+            tail_streams = pallas.max_block_b(tail_cap) == 0
+            n_bad_head = float((~((it <= head))).mean()) * diag_b
+            n_bad_deep = float((it > head2).mean()) * diag_b
+            if n_bad_deep > big_tier:          # full-batch XLA fallback
+                bytes_per_shot += io_bytes + it.mean() * stream_per_iter
+            elif tail_streams:                 # XLA tail on stragglers
+                tail_frac = min(n_bad_head, big_tier) / diag_b
+                tail_it = float(it[it > head].mean()) if n_bad_head else 0.0
+                bytes_per_shot += io_bytes + tail_frac * tail_it * \
+                    stream_per_iter
+            else:                              # all-VMEM
+                bytes_per_shot += io_bytes
+        elif two_phase_runs:
+            # XLA two-phase: head + compacted tail stream message planes
+            tail_frac = float((it > head).mean())
+            tail_it = float(it[it > head].mean()) if tail_frac else 0.0
+            bytes_per_shot += io_bytes + (
+                min(it.mean(), head) + tail_frac * tail_it) * 3 * 4 * planes
         else:
             bytes_per_shot += io_bytes + it.mean() * 3 * 4 * planes
     iters_mean = float(np.mean(iters_mean_all))

@@ -363,6 +363,20 @@ def bp_decode(
     )
 
 
+# two-phase defaults, exported so auditing tools (bench._bp_utilization's
+# roofline model) derive their branch structure from the SAME constants
+# instead of hard-coding copies that silently rot
+TWO_PHASE_HEAD_ITERS = 3
+TWO_PHASE_TAIL_DIV = 16           # tail_capacity default = b // 16
+TWO_PHASE_BIG_TIER_MULT = 4       # big tier = 4 * tail_capacity
+
+
+def two_phase_head2_iters(head_iters: int, max_iter: int) -> int:
+    """Deepened-head depth used by the progressive branch (shared with the
+    bench roofline model)."""
+    return min(max(4 * head_iters, 12), max_iter - 1)
+
+
 @functools.partial(
     jax.jit,
     static_argnames=(
@@ -378,7 +392,7 @@ def bp_decode_two_phase(
     max_iter: int,
     method: str = "minimum_sum",
     ms_scaling_factor=0.625,
-    head_iters: int = 3,
+    head_iters: int = TWO_PHASE_HEAD_ITERS,
     tail_capacity: int | None = None,
     sectors: tuple | None = None,
     pallas_head=None,
@@ -407,7 +421,7 @@ def bp_decode_two_phase(
     b = syndromes.shape[0]
     n = graph.var_nbr.shape[0]
     if tail_capacity is None:
-        tail_capacity = max(1, b // 16)
+        tail_capacity = max(1, b // TWO_PHASE_TAIL_DIV)
     if head_iters >= max_iter or tail_capacity >= b:
         return bp_decode(
             graph, syndromes, channel_llr, max_iter=max_iter, method=method,
@@ -501,8 +515,8 @@ def bp_decode_two_phase(
     # the compacted size, and near threshold the straggler fraction can
     # exceed B/16 — the 4x tier keeps those batches off the full-batch path
     tiers = [tail_capacity]
-    if tail_capacity * 4 < b:
-        tiers.append(tail_capacity * 4)
+    if tail_capacity * TWO_PHASE_BIG_TIER_MULT < b:
+        tiers.append(tail_capacity * TWO_PHASE_BIG_TIER_MULT)
 
     # Progressive head deepening: when even the largest tier overflows
     # (heavy-noise regimes like the BP+OSD bench point at p=0.05, where
@@ -513,7 +527,7 @@ def bp_decode_two_phase(
     # iteration), and the deeper head typically leaves few enough
     # stragglers for the big tier: cost ~ head2*B + max_iter*B/4 instead
     # of max_iter*B (~2.5x less at the bench point).
-    head2_iters = min(max(4 * head_iters, 12), max_iter - 1)
+    head2_iters = two_phase_head2_iters(head_iters, max_iter)
 
     def deepen(_):
         head2 = run_head(head2_iters)

@@ -191,6 +191,15 @@ class CodeSimulator_Circuit_SpaceTime:
             dem_text, num_rounds=self.num_rounds, num_rep=self.num_rep,
             num_logicals=self.num_logicals,
         )
+        if any(h.shape[1] == 0 for h in H_list):
+            raise ValueError(
+                "the circuit's detector error model has no fault mechanisms "
+                "(all error probabilities are zero?) — the space-time "
+                "decoding graphs would be empty.  Build the graphs from a "
+                "noisy circuit; to evaluate noiseless behavior, zero the "
+                "sampler probabilities instead (detector_sampler._probs), "
+                "as __graft_entry__.dryrun_multichip does."
+            )
         self.circuit_graph = {
             "h1": H_list[0], "L1": L_list[0], "channel_ps1": ps_list[0],
             "h2": H_list[-1], "L2": L_list[-1], "channel_ps2": ps_list[-1],

@@ -163,3 +163,37 @@ def test_two_phase_matches_plain_bp():
                                    head_iters=4, tail_capacity=cap)
         assert np.array_equal(np.asarray(a.error), np.asarray(b.error))
         assert np.array_equal(np.asarray(a.converged), np.asarray(b.converged))
+
+
+def test_two_phase_progressive_deepen_matches_plain_bp():
+    """The progressive head-deepening branch (stragglers after the first
+    head overflow every tail tier, but fit after the deepened head) must be
+    bit-identical to plain bp_decode — the regime the BP+OSD bench point
+    (p=0.05) exercises."""
+    import jax
+    import jax.numpy as jnp
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.ops import bp
+    from qldpc_fault_tolerance_tpu.ops.linalg import gf2_matmul
+
+    code = hgp(rep_code(5), rep_code(5))
+    graph = bp.build_tanner_graph(code.hx)
+    # heavy noise: conv@head(1) is low so n_bad overflows both tiers
+    # (4, 16), engaging the deepen segment; after the 12-iteration deepened
+    # head the stragglers fit tier 16 (measured: 50 bad@1, 15 bad@12)
+    llr0 = bp.llr_from_probs(np.full(code.N, 0.03))
+    err = (jax.random.uniform(jax.random.PRNGKey(9), (128, code.N)) < 0.03
+           ).astype(jnp.uint8)
+    synd = gf2_matmul(err, jnp.asarray(code.hx.T))
+    a = bp.bp_decode(graph, synd, llr0, max_iter=30)
+    b = bp.bp_decode_two_phase(graph, synd, llr0, max_iter=30,
+                               head_iters=1, tail_capacity=4)
+    # the branch structure: n_bad@1 must exceed the big tier (16) but fit
+    # it after the 12-iteration deepened head (sanity of the scenario)
+    it = np.asarray(a.iterations)
+    conv = np.asarray(a.converged)
+    assert int((~(conv & (it <= 1))).sum()) > 16, "scenario must overflow tiers"
+    assert int((~(conv & (it <= 12))).sum()) <= 16, "scenario must fit deepen"
+    for f in ("error", "converged", "iterations", "posterior_llr"):
+        assert np.array_equal(np.asarray(getattr(a, f)),
+                              np.asarray(getattr(b, f))), f
