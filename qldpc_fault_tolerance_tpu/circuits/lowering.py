@@ -26,6 +26,9 @@ p_i = p_state_p = 0, src demo cell 2).
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+import re
+from collections import OrderedDict
 
 import numpy as np
 
@@ -259,7 +262,84 @@ def _lower_instruction(ins: Instruction, rec_base: int):
     raise ValueError(f"cannot lower instruction {name}")
 
 
+_NOISE_ARG_RE = re.compile(
+    r"^(\s*(?:X_ERROR|Y_ERROR|Z_ERROR|DEPOLARIZE1|DEPOLARIZE2))\(([^)]+)\)",
+    re.M,
+)
+
+# digest -> lowered template; keyed on sha256 of the canonical text so the
+# memo does not pin multi-MB circuit strings (hgp-sized circuits are ~70k
+# instruction lines).  functools.lru_cache does not fit: the value is built
+# from the canonical TEXT while the key must be its digest.
+_TEMPLATE_CACHE: "OrderedDict[str, CompiledCircuit]" = OrderedDict()
+_TEMPLATE_CACHE_MAX = 32
+
+
 def compile_circuit(circuit: Circuit) -> CompiledCircuit:
+    """Lower a circuit, memoizing the expensive passes on the circuit's
+    p-CANONICALIZED text.
+
+    A threshold sweep lowers the same memory-circuit layout once per
+    (code, p, seed) cell — seconds of pure Python each for hgp-sized
+    circuits (~70k instructions), differing only in the noise-probability
+    literals.  The canonical form replaces each distinct nonzero
+    probability with its first-occurrence index (1, 2, ...), which
+    preserves BOTH lowering-relevant properties of the probabilities: the
+    zero/nonzero pattern (zero-p ops are dropped) and the equality pattern
+    (_mergeable fuses noise ops only at equal p).  The lowered template is
+    cached on the canonical text's sha256; instantiation rewrites only the
+    fused noise ops' ``p`` values (index -> actual probability), sharing
+    every index array.
+
+    Probability precision: canonicalization reads the probabilities from
+    the circuit's TEXT form, whose fixed-point float format carries 12
+    decimals (ir._fmt_arg) — probabilities are distinguished (and
+    preserved) to 1e-12, far below any physical operating point; a nonzero
+    p that formats to 0 would be dropped like an explicit zero.
+    """
+    text = str(circuit)
+    values: list[float] = []
+    ids: dict[float, int] = {}
+
+    def _sub(m):
+        # the package emits exactly one argument per noise instruction; a
+        # multi-arg line would silently corrupt the index mapping below, so
+        # fail loudly instead of guessing
+        f = float(m.group(2).strip())
+        if f == 0.0:
+            return m.group(0)
+        if f not in ids:
+            ids[f] = len(values) + 1
+            values.append(f)
+        return f"{m.group(1)}({ids[f]})"
+
+    canon = _NOISE_ARG_RE.sub(_sub, text)
+    digest = hashlib.sha256(canon.encode()).hexdigest()
+    template = _TEMPLATE_CACHE.get(digest)
+    if template is None:
+        template = _compile_circuit_impl(Circuit(canon))
+        _TEMPLATE_CACHE[digest] = template
+        if len(_TEMPLATE_CACHE) > _TEMPLATE_CACHE_MAX:
+            _TEMPLATE_CACHE.popitem(last=False)
+    else:
+        _TEMPLATE_CACHE.move_to_end(digest)
+    segs = []
+    for seg in template.segments:
+        ops = []
+        for op in seg.ops:
+            if op.kind in ("dep1", "dep2", "perr"):
+                idx = int(op.p)
+                assert op.p == idx and 1 <= idx <= len(values), (
+                    "template op carries a non-index probability — "
+                    "canonicalization missed a noise instruction"
+                )
+                op = dataclasses.replace(op, p=values[idx - 1])
+            ops.append(op)
+        segs.append(dataclasses.replace(seg, ops=ops))
+    return dataclasses.replace(template, segments=segs)
+
+
+def _compile_circuit_impl(circuit: Circuit) -> CompiledCircuit:
     nq = circuit.num_qubits
 
     # ---- pass 1: resolve record columns for detectors/observables, collect
