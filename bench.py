@@ -285,7 +285,7 @@ def _warm_sweep_elapsed(experiment: str, cycles: int) -> float:
     try:
         proc = subprocess.run(
             [_sys.executable, script, experiment, "--cycles", str(cycles),
-             "--seeds", "1", "--warmup"],
+             "--seeds", "1", "--warmup", "--no-record"],
             check=True, capture_output=True, text=True,
         )
     except subprocess.CalledProcessError as e:
@@ -293,6 +293,9 @@ def _warm_sweep_elapsed(experiment: str, cycles: int) -> float:
         raise
     recs = [json.loads(line) for line in proc.stdout.splitlines()
             if line.startswith("{")]
+    # --no-record: the bench races the workload for wall-clock only; parity
+    # evidence is the multi-seed sweeps recorded by scripts/parity.py runs,
+    # and a bench rerun must not append duplicate single-seed rows
     return recs[-1]["elapsed_s"]
 
 

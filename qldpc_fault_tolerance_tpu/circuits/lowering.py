@@ -329,10 +329,14 @@ def compile_circuit(circuit: Circuit) -> CompiledCircuit:
         for op in seg.ops:
             if op.kind in ("dep1", "dep2", "perr"):
                 idx = int(op.p)
-                assert op.p == idx and 1 <= idx <= len(values), (
-                    "template op carries a non-index probability — "
-                    "canonicalization missed a noise instruction"
-                )
+                if op.p != idx or not 1 <= idx <= len(values):
+                    # hard error (not assert: silent corruption under -O
+                    # would install a wrong probability)
+                    raise RuntimeError(
+                        "template op carries a non-index probability "
+                        f"({op.p!r}) — canonicalization missed a noise "
+                        "instruction"
+                    )
                 op = dataclasses.replace(op, p=values[idx - 1])
             ops.append(op)
         segs.append(dataclasses.replace(seg, ops=ops))

@@ -404,3 +404,38 @@ def test_sampler_structure_cache_shares_compile_but_not_probs():
     other = FrameSampler(build_memory_circuit(code, 5, ep, sx, sz,
                                               spacetime=False))
     assert other._structure_key != lo._structure_key
+
+
+def test_compile_circuit_template_cache_instantiates_probabilities():
+    """compile_circuit memoizes lowering on p-canonicalized text; two
+    same-structure circuits at different probabilities must share structure
+    (same structure_key, same fused op shapes) while carrying their OWN
+    probabilities — and a zero probability must change the structure (the
+    op is dropped), not silently reuse the nonzero template."""
+    from qldpc_fault_tolerance_tpu.circuits.ir import Circuit
+    from qldpc_fault_tolerance_tpu.circuits.lowering import compile_circuit
+
+    def build(p_cx, p_m):
+        c = Circuit()
+        c.append("RX", [0, 1, 2])
+        c.append("CX", [0, 1])
+        c.append("DEPOLARIZE2", [0, 1], p_cx)
+        c.append("DEPOLARIZE1", [2], p_m)
+        c.append("MX", [0, 1, 2])
+        c.append("DETECTOR", [target_rec(-1)])
+        return c
+
+    a = compile_circuit(build(0.01, 0.002))
+    b = compile_circuit(build(0.03, 0.004))
+    assert a.structure_key() == b.structure_key()
+    def noise_ps(cc):
+        return sorted(op.p for s in cc.segments for op in s.ops
+                      if op.kind in ("dep1", "dep2", "perr"))
+
+    pa, pb = noise_ps(a), noise_ps(b)
+    assert pa == [0.002, 0.01] and pb == [0.004, 0.03]
+    # equal probabilities fuse-compatible pattern: same p on both ops gives
+    # the same key as itself but zero-p drops the op -> different key
+    z = compile_circuit(build(0.01, 0.0))
+    assert z.structure_key() != a.structure_key()
+    assert noise_ps(z) == [0.01]
