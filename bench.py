@@ -90,7 +90,8 @@ def _bp_utilization(dec_x, dec_z, code, p, rate, key):
         head = bp_mod.TWO_PHASE_HEAD_ITERS
         pallas = getattr(dec, "_pallas_head", None)
         two_phase_runs = (getattr(dec, "two_phase", True)
-                          and diag_b >= 64 and dec.max_iter > 8)
+                          and diag_b >= bp_mod.TWO_PHASE_MIN_BATCH
+                          and dec.max_iter >= bp_mod.TWO_PHASE_MIN_ITER)
         pallas_runs = (two_phase_runs and pallas is not None
                        and pallas.max_block_b(diag_b) > 0)
         if pallas_runs:

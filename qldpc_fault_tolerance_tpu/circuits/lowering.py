@@ -28,11 +28,20 @@ from __future__ import annotations
 import dataclasses
 import hashlib
 import re
+import threading
+import warnings
 from collections import OrderedDict
 
 import numpy as np
 
-from .ir import Circuit, Instruction, MEASUREMENT_NAMES, RecTarget, RepeatBlock
+from .ir import (
+    Circuit,
+    Instruction,
+    MEASUREMENT_NAMES,
+    NOISE_NAMES,
+    RecTarget,
+    RepeatBlock,
+)
 
 __all__ = ["Op", "Segment", "CompiledCircuit", "compile_circuit"]
 
@@ -273,6 +282,19 @@ _NOISE_ARG_RE = re.compile(
 # from the canonical TEXT while the key must be its digest.
 _TEMPLATE_CACHE: "OrderedDict[str, CompiledCircuit]" = OrderedDict()
 _TEMPLATE_CACHE_MAX = 32
+_TEMPLATE_CACHE_LOCK = threading.Lock()
+
+
+def _freeze_template_arrays(template: CompiledCircuit) -> None:
+    """Templates share their index arrays (op targets, rec columns) with
+    every instantiation compile_circuit returns — an in-place write through
+    any of them would corrupt the cache and all sibling instantiations, so
+    make numpy raise instead."""
+    for seg in template.segments:
+        for op in seg.ops:
+            for arr in (op.a, op.b, op.rec):
+                if arr is not None:
+                    arr.setflags(write=False)
 
 
 def compile_circuit(circuit: Circuit) -> CompiledCircuit:
@@ -300,6 +322,7 @@ def compile_circuit(circuit: Circuit) -> CompiledCircuit:
     text = str(circuit)
     values: list[float] = []
     ids: dict[float, int] = {}
+    saw_zero_noise = False
 
     def _sub(m):
         # the package emits exactly one argument per noise instruction; a
@@ -307,6 +330,8 @@ def compile_circuit(circuit: Circuit) -> CompiledCircuit:
         # fail loudly instead of guessing
         f = float(m.group(2).strip())
         if f == 0.0:
+            nonlocal saw_zero_noise
+            saw_zero_noise = True
             return m.group(0)
         if f not in ids:
             ids[f] = len(values) + 1
@@ -314,15 +339,41 @@ def compile_circuit(circuit: Circuit) -> CompiledCircuit:
         return f"{m.group(1)}({ids[f]})"
 
     canon = _NOISE_ARG_RE.sub(_sub, text)
+    if saw_zero_noise:
+        # a zero-probability NOISE arg in the text is either a true p=0 op
+        # (dropped by design) or a nonzero p < 5e-13 that rounded to zero in
+        # the 12-decimal format; tell those apart from the in-memory
+        # instructions and make the pathological case visible.  (Gated on
+        # noise args specifically — annotation args like OBSERVABLE_INCLUDE(0)
+        # must not trigger the O(instructions) walk on every compile.)
+        def _each_ins(items):
+            for item in items:
+                if isinstance(item, RepeatBlock):
+                    yield from _each_ins(item.body.items)
+                else:
+                    yield item
+
+        for ins in _each_ins(circuit.items):
+            if ins.name in NOISE_NAMES and ins.args and 0 < ins.args[0] < 5e-13:
+                warnings.warn(
+                    f"noise probability {ins.args[0]!r} formats to 0 in the "
+                    "12-decimal circuit text and the op will be dropped "
+                    "(compile_circuit docstring, 'Probability precision')",
+                    stacklevel=2,
+                )
+                break
     digest = hashlib.sha256(canon.encode()).hexdigest()
-    template = _TEMPLATE_CACHE.get(digest)
+    with _TEMPLATE_CACHE_LOCK:
+        template = _TEMPLATE_CACHE.get(digest)
+        if template is not None:
+            _TEMPLATE_CACHE.move_to_end(digest)
     if template is None:
         template = _compile_circuit_impl(Circuit(canon))
-        _TEMPLATE_CACHE[digest] = template
-        if len(_TEMPLATE_CACHE) > _TEMPLATE_CACHE_MAX:
-            _TEMPLATE_CACHE.popitem(last=False)
-    else:
-        _TEMPLATE_CACHE.move_to_end(digest)
+        _freeze_template_arrays(template)
+        with _TEMPLATE_CACHE_LOCK:
+            _TEMPLATE_CACHE[digest] = template
+            if len(_TEMPLATE_CACHE) > _TEMPLATE_CACHE_MAX:
+                _TEMPLATE_CACHE.popitem(last=False)
     segs = []
     for seg in template.segments:
         ops = []

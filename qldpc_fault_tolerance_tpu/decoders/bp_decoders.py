@@ -154,8 +154,9 @@ def decode_device(static, state, syndromes):
         return corr, {"final_weight": w}
     assert kind == "bp", kind
     _, max_iter, method, msf, two_phase, _has_pallas = static
-    if (two_phase and syndromes.ndim == 2 and syndromes.shape[0] >= 64
-            and max_iter > 8):
+    if (two_phase and syndromes.ndim == 2
+            and syndromes.shape[0] >= bp.TWO_PHASE_MIN_BATCH
+            and max_iter >= bp.TWO_PHASE_MIN_ITER):
         res = bp.bp_decode_two_phase(
             state["graph"], syndromes, state["llr0"],
             max_iter=max_iter, method=method, ms_scaling_factor=msf,
@@ -294,8 +295,9 @@ class BPDecoder:
         return corrections
 
     def bp_batch_device(self, syndromes) -> bp.BPResult:
-        if self.two_phase and syndromes.ndim == 2 and syndromes.shape[0] >= 64 \
-                and self.max_iter > 8:
+        if self.two_phase and syndromes.ndim == 2 \
+                and syndromes.shape[0] >= bp.TWO_PHASE_MIN_BATCH \
+                and self.max_iter >= bp.TWO_PHASE_MIN_ITER:
             return bp.bp_decode_two_phase(
                 self.graph,
                 syndromes,

@@ -369,6 +369,11 @@ def bp_decode(
 TWO_PHASE_HEAD_ITERS = 3
 TWO_PHASE_TAIL_DIV = 16           # tail_capacity default = b // 16
 TWO_PHASE_BIG_TIER_MULT = 4       # big tier = 4 * tail_capacity
+# engagement gate (decoders/bp_decoders.py and bench.py's roofline model
+# both import these — the literals must not drift apart): two-phase only
+# pays off with enough shots to compact and enough iterations to skip
+TWO_PHASE_MIN_BATCH = 64
+TWO_PHASE_MIN_ITER = 9
 
 
 def two_phase_head2_iters(head_iters: int, max_iter: int) -> int:

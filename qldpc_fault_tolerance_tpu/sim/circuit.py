@@ -29,6 +29,8 @@ from ..circuits import AddCXError, Circuit, ColorationCircuit, \
 from ..decoders.bp_decoders import decode_device
 from ..ops.linalg import gf2_matmul
 from .common import (
+    apply_worker_batch_fence,
+    fence_batch_value,
     ShotBatcher,
     accumulate_counts,
     mesh_batch_stats,
@@ -390,7 +392,7 @@ class CodeSimulator_Circuit:
     def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
         self._ensure_circuit()
         self._assert_round_decoder_device()
-        bs = batch_size or self.batch_size
+        bs = fence_batch_value(self, batch_size or self.batch_size)
         return np.asarray(
             self._finish_batch(self._sample_and_decode_rounds(key, bs))
         )
@@ -415,6 +417,7 @@ class CodeSimulator_Circuit:
 
     def _count_failures(self, num_samples: int, key=None):
         """(failure count, shots actually run) over the right dispatch path."""
+        apply_worker_batch_fence(self)
         self._ensure_circuit()
         self._assert_round_decoder_device()
         if key is None:

@@ -37,6 +37,8 @@ from ..decoders.bp_decoders import decode_device
 from ..ops.linalg import gf2_matmul
 from .circuit import _swap_xz_inplace, build_memory_circuit
 from .common import (
+    apply_worker_batch_fence,
+    fence_batch_value,
     ShotBatcher,
     accumulate_counts,
     mesh_batch_stats,
@@ -272,7 +274,7 @@ class CodeSimulator_Circuit_SpaceTime:
     def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
         self._ensure_ready()
         self._assert_window_decoder_device()
-        bs = batch_size or self.batch_size
+        bs = fence_batch_value(self, batch_size or self.batch_size)
         return np.asarray(
             self._finish_batch(self._sample_and_decode_windows(key, bs))
         )
@@ -294,6 +296,7 @@ class CodeSimulator_Circuit_SpaceTime:
 
     def _count_failures(self, num_samples: int, key=None):
         """(failure count, shots actually run) over the right dispatch path."""
+        apply_worker_batch_fence(self)
         self._ensure_ready()
         self._assert_window_decoder_device()
         if key is None:
@@ -332,6 +335,9 @@ class CodeSimulator_Circuit_SpaceTime:
         self._ensure_ready()
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
+        # fence here, not just in run_batch: total_samples accounting below
+        # must use the batch size that actually ran
+        batch_size = fence_batch_value(self, batch_size)
         total_samples, total_failures = 0, 0
         for i in range(int(max_batches)):
             fails = self.run_batch(jax.random.fold_in(key, i), int(batch_size))

@@ -11,6 +11,7 @@ The estimator contracts follow the reference exactly:
 from __future__ import annotations
 
 import dataclasses
+import warnings
 
 import numpy as np
 
@@ -106,6 +107,79 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key):
         min_w = w if min_w is None else jnp.minimum(min_w, w)
     count, min_w = jax.device_get((count, min_w))  # one host round-trip
     return int(count), batcher.total, int(min_w)
+
+
+# The tunneled axon TPU worker deterministically crashes decode programs
+# containing an OSD stage at batch >= 4096 (environment regression since
+# round 2; retries land on the same crash — README "Known frontiers").
+# Batch 1024-2048 is the measured safe envelope.  The same configs run
+# correctly at full batch on the CPU mesh (tests/test_worker_fence.py), so
+# this is a worker fence, not a framework limit.
+WORKER_OSD_BATCH_CRASH = 4096
+WORKER_OSD_BATCH_SAFE = 2048
+
+
+def _has_osd_stage(sim) -> bool:
+    return any(
+        getattr(v, "osd_method", None) is not None
+        or type(v).__name__.startswith(("BPOSD", "ST_BPOSD"))
+        for v in vars(sim).values()
+    )
+
+
+def apply_worker_batch_fence(sim) -> None:
+    """Clamp ``sim.batch_size`` into the tunneled worker's safe envelope.
+
+    Engines call this at decode-dispatch time (not __init__ — space-time
+    engines attach their OSD decoders after construction).  No-op off the
+    axon backend and for OSD-free pipelines: plain-BP programs run fine at
+    batch 16384 (bench.py flagship), so only OSD-bearing programs are
+    fenced."""
+    if sim.batch_size < WORKER_OSD_BATCH_CRASH or getattr(
+            sim, "_batch_fence_applied", False):
+        return
+    if not _has_osd_stage(sim):
+        return
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # backend init failure — nothing to fence
+        return
+    if backend != "axon":
+        return
+    warnings.warn(
+        f"tunneled-TPU worker fence: OSD decode at batch "
+        f"{sim.batch_size} is in the worker's known-crash envelope "
+        f"(>= {WORKER_OSD_BATCH_CRASH}); clamping batch_size to "
+        f"{WORKER_OSD_BATCH_SAFE}.  Identical configs at full batch are "
+        "validated on the CPU mesh (tests/test_worker_fence.py).",
+        stacklevel=3,
+    )
+    sim.batch_size = WORKER_OSD_BATCH_SAFE
+    sim._batch_fence_applied = True
+
+
+def fence_batch_value(sim, batch_size: int) -> int:
+    """Value-level companion to apply_worker_batch_fence for dispatch paths
+    that take the batch size as an argument (run_batch,
+    WordErrorRate_TargetFailure) instead of reading ``sim.batch_size``."""
+    batch_size = int(batch_size)
+    if batch_size < WORKER_OSD_BATCH_CRASH or not _has_osd_stage(sim):
+        return batch_size
+    import jax
+
+    try:
+        if jax.default_backend() != "axon":
+            return batch_size
+    except Exception:
+        return batch_size
+    warnings.warn(
+        f"tunneled-TPU worker fence: OSD decode at batch {batch_size} is in "
+        f"the worker's known-crash envelope (>= {WORKER_OSD_BATCH_CRASH}); "
+        f"using {WORKER_OSD_BATCH_SAFE}.", stacklevel=3,
+    )
+    return WORKER_OSD_BATCH_SAFE
 
 
 def wer_single_shot(error_count: int, num_run: int, K: int):

@@ -22,6 +22,8 @@ from ..decoders.bp_decoders import decode_device
 from ..noise import depolarizing_xz
 from ..ops.linalg import ParityOp, gf2_matmul, parity_apply
 from .common import (
+    apply_worker_batch_fence,
+    fence_batch_value,
     ShotBatcher,
     mesh_batch_stats,
     wer_single_shot,
@@ -244,7 +246,7 @@ class CodeSimulator_DataError:
 
     def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
         """Run one batch; returns per-shot failure flags (host bool array)."""
-        bs = batch_size or self.batch_size
+        bs = fence_batch_value(self, batch_size or self.batch_size)
         return self._drain_batch(self._sample_and_bp(key, bs))
 
     def _single_run(self):
@@ -254,6 +256,7 @@ class CodeSimulator_DataError:
 
     def WordErrorRate(self, num_run: int, key=None):
         """WER over ``num_run`` shots (src/Simulators.py:170-188 contract)."""
+        apply_worker_batch_fence(self)
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         if self._mesh is not None and not self._needs_host:

@@ -24,6 +24,8 @@ from ..decoders.bp_decoders import decode_device
 from ..noise import bit_flips, depolarizing_xz
 from ..ops.linalg import gf2_matmul
 from .common import (
+    apply_worker_batch_fence,
+    fence_batch_value,
     ShotBatcher,
     accumulate_device,
     mesh_batch_stats,
@@ -258,7 +260,7 @@ class CodeSimulator_Phenon:
         return fail
 
     def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
-        bs = batch_size or self.batch_size
+        bs = fence_batch_value(self, batch_size or self.batch_size)
         return np.asarray(self._finish_batch(self._launch_batch(key, num_rounds, bs)))
 
     def _single_run(self, num_rounds):
@@ -286,6 +288,7 @@ class CodeSimulator_Phenon:
         return fail.sum(dtype=jnp.int32), min_w
 
     def _count_failures(self, num_rounds, num_samples, key=None):
+        apply_worker_batch_fence(self)
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         dec2_host = (self.decoder2_x.needs_host_postprocess

@@ -25,6 +25,8 @@ from ..decoders.bp_decoders import decode_device
 from ..noise import bit_flips, depolarizing_xz
 from ..ops.linalg import gf2_matmul
 from .common import (
+    apply_worker_batch_fence,
+    fence_batch_value,
     ShotBatcher,
     accumulate_device,
     mesh_batch_stats,
@@ -246,7 +248,7 @@ class CodeSimulator_Phenon_SpaceTime:
 
     def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
         self._assert_window_decoders_device()
-        bs = batch_size or self.batch_size
+        bs = fence_batch_value(self, batch_size or self.batch_size)
         return np.asarray(self._finish_batch(self._launch_batch(key, num_rounds, bs)))
 
     def _single_run(self, num_rounds):
@@ -273,6 +275,7 @@ class CodeSimulator_Phenon_SpaceTime:
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into
         windows of num_rep; total cycle count must come out odd."""
+        apply_worker_batch_fence(self)
         self._assert_window_decoders_device()
         num_rounds = int((num_cycles - 1) / self.num_rep + 1)
         total_num_cycles = (num_rounds - 1) * self.num_rep + 1

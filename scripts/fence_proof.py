@@ -1,0 +1,76 @@
+"""Prove the tunneled-worker crash configs are worker bugs, not framework
+limits: run the SAME shapes at FULL batch on the CPU backend.
+
+Config A — bench.py's bposd mode (hgp_34_n625 data-error BP+OSD) at batch
+8192, i.e. twice the axon worker's crash threshold (>= 4096).
+Config B — an hgp_34_n1600 phenomenological parity cell (Threshold ckpt
+cell 12 recipe: [H|I] dec1 int(N/30) iters, BPOSD osd_e order-10 dec2
+int(N/10) iters, q=0) — the exact per-cell program that crashes the worker
+at ANY batch.
+
+Writes FENCE_PROOF.json.  Run with JAX_PLATFORMS=cpu (the point is the CPU
+backend); wall-clock is minutes — this is a proof artifact, not a bench.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+
+from qldpc_fault_tolerance_tpu.codes import load_code  # noqa: E402
+from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder  # noqa: E402
+from qldpc_fault_tolerance_tpu.sim import CodeSimulator_DataError  # noqa: E402
+
+import parity  # noqa: E402
+
+
+def main():
+    assert jax.default_backend() != "axon", (
+        "run me with JAX_PLATFORMS=cpu — the point is the non-worker backend")
+    out = {"backend": jax.default_backend(), "results": {}}
+
+    # ---- config A: BP+OSD at batch 8192 (worker crashes at >= 4096)
+    code = load_code(os.path.join(REPO, "codes_lib_tpu", "hgp_34_n625.npz"))
+    p = 0.01
+    dec = lambda h: BPOSD_Decoder(  # noqa: E731
+        h, np.full(code.N, p), max_iter=50, bp_method="minimum_sum",
+        ms_scaling_factor=0.625, osd_method="osd_e", osd_order=10)
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=8192, seed=11,
+    )
+    t0 = time.time()
+    wer, eb = sim.WordErrorRate(16384)
+    out["results"]["bposd_batch8192_n625"] = {
+        "batch_size": 8192, "shots": 16384, "wer": float(wer),
+        "eb": float(eb), "elapsed_s": round(time.time() - t0, 1),
+        "ok": bool(0.0 <= wer <= 1.0),
+    }
+    print(out["results"]["bposd_batch8192_n625"])
+
+    # ---- config B: n1600 phenl cell (crashes the worker at any batch)
+    code = load_code(os.path.join(REPO, "codes_lib_tpu", "hgp_34_n1600.npz"))
+    t0 = time.time()
+    w = parity.phenl_cell_wer(code, eval_p=0.02, cycles=6, samples=2048,
+                              seed=1, batch_size=2048)
+    out["results"]["phenl_n1600_cell"] = {
+        "batch_size": 2048, "samples": 2048, "cycles": 6, "p": 0.02,
+        "wer_per_cycle": float(w), "elapsed_s": round(time.time() - t0, 1),
+        "ok": bool(0.0 <= w <= 1.0),
+    }
+    print(out["results"]["phenl_n1600_cell"])
+
+    with open(os.path.join(REPO, "FENCE_PROOF.json"), "w") as f:
+        json.dump(out, f, indent=1)
+    print("wrote FENCE_PROOF.json")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Round-5 LP/GBC parity campaign (VERDICT item 1). Sequential to keep the
+# single tunneled chip uncontended. Each experiment gets up to 3 process-level
+# attempts (the in-process retry already handles worker crashes; a process
+# retry covers compile-helper sickness that outlives it).
+cd /root/repo
+run() {
+  for attempt in 1 2 3; do
+    echo "=== $(date +%H:%M:%S) $* (attempt $attempt) ==="
+    python scripts/parity.py "$@" && return 0
+    echo "--- experiment $1 attempt $attempt failed (rc $?); cooling 120s"
+    sleep 120
+  done
+  echo "!!! experiment $1 exhausted attempts"
+  return 1
+}
+run lp_phenl_12k --seeds 2 --warmup
+run gbc_circuit  --seeds 2 --warmup
+run lp_circuit   --seeds 2 --warmup
+run lp_phenl     --seeds 2 --warmup
+echo "CAMPAIGN_DONE"

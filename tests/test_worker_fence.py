@@ -1,0 +1,70 @@
+"""Tunneled-worker crash fence (README "Known frontiers").
+
+The axon worker deterministically crashes OSD-bearing decode programs at
+batch >= 4096, and hgp_34_n1600 phenomenological cells (environment
+regression since round 2).  The fence clamps the batch into the measured
+safe envelope ON THE AXON BACKEND ONLY; these tests prove (a) the clamp
+logic itself, and (b) that the same configs run CORRECTLY at full batch on
+the CPU mesh — i.e. the crash is a worker property, not a framework limit
+(scripts/fence_proof.py runs the heavyweight full-shape versions).
+"""
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder, BPDecoder
+from qldpc_fault_tolerance_tpu.sim import CodeSimulator_DataError
+from qldpc_fault_tolerance_tpu.sim.common import (
+    WORKER_OSD_BATCH_SAFE,
+    apply_worker_batch_fence,
+)
+
+
+def _bposd_sim(batch_size):
+    code = hgp(rep_code(5), rep_code(5))
+    p = 0.02
+    dec = lambda h: BPOSD_Decoder(  # noqa: E731
+        h, np.full(code.N, p), max_iter=12, osd_method="osd_0")
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=batch_size, seed=3,
+    )
+
+
+def test_fence_clamps_osd_batch_on_axon(monkeypatch):
+    sim = _bposd_sim(8192)
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    with pytest.warns(UserWarning, match="worker fence"):
+        apply_worker_batch_fence(sim)
+    assert sim.batch_size == WORKER_OSD_BATCH_SAFE
+    # idempotent: a second call neither warns nor re-clamps
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        apply_worker_batch_fence(sim)
+    assert sim.batch_size == WORKER_OSD_BATCH_SAFE
+
+
+def test_fence_leaves_plain_bp_alone(monkeypatch):
+    code = hgp(rep_code(5), rep_code(5))
+    p = 0.02
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=12)  # noqa: E731
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=16384, seed=3,
+    )
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    apply_worker_batch_fence(sim)
+    assert sim.batch_size == 16384  # flagship plain-BP batches stay untouched
+
+
+def test_full_batch_osd_runs_on_cpu():
+    """The exact crash-envelope batch (8192 >= 4096, OSD stage) on the CPU
+    backend: must run and produce a sane WER — no clamp, no crash."""
+    sim = _bposd_sim(8192)
+    apply_worker_batch_fence(sim)
+    assert sim.batch_size == 8192  # cpu backend: fence is a no-op
+    wer, eb = sim.WordErrorRate(8192)
+    assert 0.0 <= wer <= 1.0 and eb >= 0.0
