@@ -137,9 +137,72 @@ def _bp_utilization(dec_x, dec_z, code, p, rate, key):
     }
 
 
+def _sample_synd_rates(code, p, batch, key):
+    """Measured shots/s of the sample→syndrome stage alone, all three
+    substrates: dense uint8 planes, packed lane words (bit-exact same
+    draws), and the fused counter-PRNG path (ops/gf2_pallas, own stream,
+    syndromes-only writes).  A scalar reduction forces materialization
+    without adding a transfer."""
+    import jax
+    import jax.numpy as jnp
+
+    from qldpc_fault_tolerance_tpu.noise import (
+        depolarizing_xz,
+        depolarizing_xz_packed,
+    )
+    from qldpc_fault_tolerance_tpu.ops import gf2_pallas
+    from qldpc_fault_tolerance_tpu.ops.gf2_packed import packed_parity_apply
+    from qldpc_fault_tolerance_tpu.ops.linalg import ParityOp
+
+    hx, hz = ParityOp(code.hx), ParityOp(code.hz)
+    probs = (p / 3, p / 3, p / 3)
+    spec = gf2_pallas.build_fused_spec(code.hx, code.hz, code.lx, code.lz,
+                                       probs)
+
+    @jax.jit
+    def dense(k):
+        ex, ez = depolarizing_xz(k, (batch, code.N), probs)
+        return hx(ez).sum(dtype=jnp.int32) + hz(ex).sum(dtype=jnp.int32)
+
+    @jax.jit
+    def packed(k):
+        exp, ezp = depolarizing_xz_packed(k, (batch, code.N), probs)
+        a = packed_parity_apply(hx.nbr, hx.mask, ezp)
+        b = packed_parity_apply(hz.nbr, hz.mask, exp)
+        pc = jax.lax.population_count
+        return pc(a).sum(dtype=jnp.int32) + pc(b).sum(dtype=jnp.int32)
+
+    @jax.jit
+    def fused(k):
+        sx, sz = gf2_pallas.sample_syndrome(spec, k, batch,
+                                            emit_errors=False)
+        pc = jax.lax.population_count
+        return pc(sx).sum(dtype=jnp.int32) + pc(sz).sum(dtype=jnp.int32)
+
+    out = {}
+    for name, f in (("dense", dense), ("packed", packed), ("fused", fused)):
+        f(key).block_until_ready()
+        times = []
+        for rep in range(5):
+            t0 = time.perf_counter()
+            f(jax.random.fold_in(key, rep)).block_until_ready()
+            times.append(time.perf_counter() - t0)
+        out[name] = round(batch / sorted(times)[2], 1)
+    return out
+
+
 def mode_bp():
     """Headline: plain-BP code-capacity throughput (BASELINE.json config 1 /
-    the 1e6 shots/s north star)."""
+    the 1e6 shots/s north star).
+
+    Default arm is the bit-packed GF(2) pipeline (ops/gf2_packed, 32 shots
+    per uint32 lane) through the dispatch-amortized megabatch driver; a
+    dense-uint8 A/B arm runs the SAME config + key and the result records
+    both rates plus the bit-exactness of the packed WER (the packed layer's
+    acceptance gate).  Env knobs: BENCH_BATCH / BENCH_BATCHES (shapes),
+    BENCH_PACKED=0 (dense headline), BENCH_FUSED=1 (opt-in counter-PRNG
+    fused sampler — its own PRNG stream, so the A/B equality field is
+    skipped), BENCH_AB=0 (skip the dense arm)."""
     import jax
 
     from qldpc_fault_tolerance_tpu.decoders import BPDecoder
@@ -149,38 +212,92 @@ def mode_bp():
     p = 0.01
     batch = int(os.environ.get("BENCH_BATCH", "16384"))
     n_batches = int(os.environ.get("BENCH_BATCHES", "128"))
+    packed = os.environ.get("BENCH_PACKED", "1") != "0"
+    # the fused sampler rides on the packed substrate; BENCH_PACKED=0 wins
+    fused = os.environ.get("BENCH_FUSED", "0") == "1" and packed
+    run_ab = os.environ.get("BENCH_AB", "1") != "0"
     dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=50)
     dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=50)
-    sim = CodeSimulator_DataError(
-        code=code,
-        decoder_x=dec_x,
-        decoder_z=dec_z,
-        pauli_error_probs=[p / 3, p / 3, p / 3],
-        batch_size=batch,
-        seed=0,
-        # the whole timed run is one scan dispatch + one host sync (the
-        # tunneled chip pays ~50-100ms per dispatch/fetch round-trip)
-        scan_chunk=n_batches,
-    )
 
+    def make_sim(packed_arm):
+        return CodeSimulator_DataError(
+            code=code,
+            decoder_x=dec_x,
+            decoder_z=dec_z,
+            pauli_error_probs=[p / 3, p / 3, p / 3],
+            batch_size=batch,
+            seed=0,
+            # the whole timed run is one megabatch dispatch + one host sync
+            # (the tunneled chip pays ~50-100ms per dispatch/fetch
+            # round-trip)
+            scan_chunk=n_batches,
+            packed=packed_arm,
+            fused_sampler=fused and packed_arm,
+        )
+
+    sim = make_sim(packed)
     key = jax.random.PRNGKey(123)
     # warmup / compile (same compiled scan shape as the timed run)
     sim.WordErrorRate(n_batches * batch, key=jax.random.fold_in(key, 0))
     # timed steady state; median of 3 runs for a stable number
     shots = n_batches * batch
-    times = []
+    times, wer_main = [], None
     for rep in range(3):
         t0 = time.perf_counter()
-        sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1 + rep))
+        wer_rep = sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
         times.append(time.perf_counter() - t0)
+        wer_main = wer_rep
     rate = shots / sorted(times)[1]
 
+    out_ab = {}
+    if run_ab:
+        # dense-uint8 A/B arm: same shapes, same key, same median-of-3
+        # timing protocol as the main arm -> the packed arm must be
+        # bit-exact (identical WER tuple) and faster
+        other = make_sim(not packed)
+        other.WordErrorRate(shots, key=jax.random.fold_in(key, 0))  # warmup
+        times_other, wer_other = [], None
+        for rep in range(3):
+            t0 = time.perf_counter()
+            wer_other = other.WordErrorRate(shots,
+                                            key=jax.random.fold_in(key, 1))
+            times_other.append(time.perf_counter() - t0)
+        rate_other = shots / sorted(times_other)[1]
+        # label the main arm by what actually ran: the fused sampler is a
+        # different substrate (own PRNG stream), not the packed layer
+        main = "fused" if fused else ("packed" if packed else "dense")
+        ab_other = "dense" if packed else "packed"
+        out_ab = {
+            f"{main}_shots_per_s": round(rate, 1),
+            f"{ab_other}_shots_per_s": round(rate_other, 1),
+            f"{main}_speedup_vs_{ab_other}": round(rate / rate_other, 2),
+        }
+        if not fused:  # fused sampler is a different PRNG stream
+            out_ab["wer_bitexact_vs_dense"] = bool(
+                wer_main[0] == wer_other[0] and wer_main[1] == wer_other[1])
+
+    # sample+syndrome stage traffic model: the dense path writes two uint8
+    # error planes, both syndrome planes, and re-reads the errors for the
+    # residual checks; the packed path moves the same planes as uint32 lane
+    # words — 1 bit/shot/plane, an 8x byte drop (BASELINE.md "Packed
+    # bitplane layout")
+    mx, mz = code.hx.shape[0], code.hz.shape[0]
+    dense_bps = 4 * code.N + mx + mz
     baseline_rate = 36.0  # reference CPU shots/s (SURVEY §6)
     return {
         "metric": f"decoded shots/sec/chip ({code.name or 'hgp'}, N={code.N}, BP-50, p=0.01)",
         "value": round(rate, 1),
         "unit": "shots/s",
         "vs_baseline": round(rate / baseline_rate, 1),
+        "packed": packed,
+        "fused_sampler": fused,
+        "dispatches_per_run": int(sim.last_dispatches),
+        "shots_per_dispatch": batch * min(n_batches, sim._scan_chunk),
+        "sample_synd_bytes_per_shot_dense": dense_bps,
+        "sample_synd_bytes_per_shot_packed": round(dense_bps / 8, 1),
+        "sample_synd_shots_per_s": _sample_synd_rates(
+            code, p, batch, jax.random.fold_in(key, 98)),
+        **out_ab,
         **_bp_utilization(dec_x, dec_z, code, p, rate,
                           jax.random.fold_in(key, 99)),
     }

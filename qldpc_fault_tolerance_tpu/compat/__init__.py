@@ -68,7 +68,8 @@ def _install_loadmat_redirect() -> None:
     if getattr(sio.loadmat, "__qldpc_redirect__", False):
         return
     orig = sio.loadmat
-    ref_lib = "/root/reference/codes_lib"
+    ref_lib = os.environ.get("QLDPC_REF_CODES_LIB",
+                             "/root/reference/codes_lib")
     known_patterns = ("LP_*.mat", "GenBicycleA*.mat")
 
     def loadmat(file_name, *args, **kwargs):

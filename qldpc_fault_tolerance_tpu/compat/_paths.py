@@ -22,7 +22,8 @@ import warnings
 
 from ..codes.loaders import load_code, load_object
 
-_REFERENCE_CODES_LIB = "/root/reference/codes_lib"
+_REFERENCE_CODES_LIB = os.environ.get("QLDPC_REF_CODES_LIB",
+                                      "/root/reference/codes_lib")
 _REPO_CODES_LIB = os.path.join(
     os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
     "codes_lib_tpu",

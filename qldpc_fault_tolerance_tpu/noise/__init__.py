@@ -1,3 +1,13 @@
-from .samplers import bit_flips, depolarizing_xz
+from .samplers import (
+    bit_flips,
+    bit_flips_packed,
+    depolarizing_xz,
+    depolarizing_xz_packed,
+)
 
-__all__ = ["bit_flips", "depolarizing_xz"]
+__all__ = [
+    "bit_flips",
+    "bit_flips_packed",
+    "depolarizing_xz",
+    "depolarizing_xz_packed",
+]

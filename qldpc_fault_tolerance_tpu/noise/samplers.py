@@ -15,7 +15,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["depolarizing_xz", "bit_flips"]
+__all__ = ["depolarizing_xz", "bit_flips",
+           "depolarizing_xz_packed", "bit_flips_packed"]
 
 
 def depolarizing_xz(key, shape, pauli_error_probs):
@@ -37,3 +38,22 @@ def bit_flips(key, shape, p):
     """i.i.d. Bernoulli(p) flips (syndrome-measurement errors etc.)."""
     u = jax.random.uniform(key, shape, dtype=jnp.float32)
     return (u < jnp.asarray(p, jnp.float32)).astype(jnp.uint8)
+
+
+def depolarizing_xz_packed(key, shape, pauli_error_probs):
+    """Bit-packed ``depolarizing_xz``: same uniform draws for the same
+    key/shape (bit-exact, shot for shot), returned as (ceil(B/32), n) uint32
+    lane words.  Inside jit the uint8 planes fuse away — the sampler's only
+    HBM write is the packed planes (8x fewer bytes).
+    """
+    from ..ops.gf2_packed import pack_shots
+
+    error_x, error_z = depolarizing_xz(key, shape, pauli_error_probs)
+    return pack_shots(error_x), pack_shots(error_z)
+
+
+def bit_flips_packed(key, shape, p):
+    """Bit-packed ``bit_flips`` (same draws, packed lane words)."""
+    from ..ops.gf2_packed import pack_shots
+
+    return pack_shots(bit_flips(key, shape, p))

@@ -1,4 +1,17 @@
 from .bp import BPResult, TannerGraph, bp_decode, build_tanner_graph, llr_from_probs
+from .gf2_packed import (
+    LANE,
+    lane_mask,
+    num_words,
+    pack_shots,
+    packed_any,
+    packed_count,
+    packed_gf2_matmul,
+    packed_parity_apply,
+    packed_per_shot_weight,
+    packed_residual_stats,
+    unpack_shots,
+)
 from .linalg import as_device_gf2, gf2_matmul, syndrome
 
 __all__ = [
@@ -10,4 +23,15 @@ __all__ = [
     "as_device_gf2",
     "gf2_matmul",
     "syndrome",
+    "LANE",
+    "lane_mask",
+    "num_words",
+    "pack_shots",
+    "packed_any",
+    "packed_count",
+    "packed_gf2_matmul",
+    "packed_parity_apply",
+    "packed_per_shot_weight",
+    "packed_residual_stats",
+    "unpack_shots",
 ]

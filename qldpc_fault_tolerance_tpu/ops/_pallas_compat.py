@@ -1,0 +1,16 @@
+"""Pallas TPU API compatibility across the jax 0.4 -> 0.5 rename.
+
+jax 0.4.x exposes the TPU compiler-params dataclass as
+``pltpu.TPUCompilerParams``; 0.5+ renamed it ``pltpu.CompilerParams``.
+Every kernel module imports the resolved name from here so the repo runs on
+both toolchains (the container bakes one; the tunneled worker may run the
+other).
+"""
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
+__all__ = ["CompilerParams"]

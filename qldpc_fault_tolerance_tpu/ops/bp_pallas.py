@@ -45,6 +45,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._pallas_compat import CompilerParams
 from .bp import TannerGraph, BPResult
 
 __all__ = ["PallasHeadGraph", "build_pallas_head", "bp_head_pallas"]
@@ -317,7 +318,7 @@ def bp_head_pallas(
             jax.ShapeDtypeStruct((n, b), jnp.float32),
             jax.ShapeDtypeStruct((1, b), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             # the default 16MB scoped-vmem cap is conservative; v5e has
             # 128MiB of physical VMEM and the kernel's working set (incidence
             # stack + message planes) is what makes it fast

@@ -1,0 +1,207 @@
+"""Bit-packed GF(2) execution layer: 32 shots per uint32 lane.
+
+The round-5 bench model showed the code-capacity pipeline is sampler/SpMV
+bound, not BP bound: 98% of shots converge inside the VMEM-resident BP head,
+so the wall clock is the depolarizing PRNG sampler, the dense-uint8 syndrome
+SpMV and fixed per-dispatch latency.  This module packs every {0,1} bitplane
+(errors, syndromes, corrections, residuals, failure flags) 32 Monte-Carlo
+shots per uint32 lane word:
+
+  * layout: a (B, n) uint8 bitplane becomes (W, n) uint32 with
+    W = ceil(B/32); shot ``32*w + j`` is bit ``j`` (LSB-first) of
+    ``packed[w, :]``.  Packing along the SHOT axis turns the mod-2
+    accumulation of every GF(2) product into bitwise XOR across lane words —
+    no carries, no popcount needed until a scalar count is read out.
+  * ``packed_parity_apply`` is the sparse syndrome SpMV: gather ``rw`` words
+    per check and XOR-reduce — ~rw*4 bytes per 32 shots instead of rw bytes
+    per shot (8x less traffic, 32x fewer gather elements).
+  * ``packed_gf2_matmul`` handles the small dense products (logical checks:
+    K columns) by masked XOR-reduction over the shared n axis.
+  * failure counting is ``popcount`` (lax.population_count) over packed flag
+    words, masked by ``lane_mask`` so ragged (non-multiple-of-32) batches
+    count exactly their real shots.
+
+BP LLR messages stay float32 — only the {0,1} planes pack; the simulators
+unpack syndromes at the BP boundary (``unpack_shots``) and re-pack the
+hard-decision corrections after it (``pack_shots``).  All ops are bit-exact
+against the dense uint8 path (tests/test_gf2_packed.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "LANE",
+    "num_words",
+    "lane_mask",
+    "pack_shots",
+    "unpack_shots",
+    "xor_reduce",
+    "or_reduce",
+    "popcount",
+    "packed_parity_apply",
+    "packed_gf2_matmul",
+    "packed_any",
+    "packed_count",
+    "packed_per_shot_weight",
+    "packed_residual_stats",
+]
+
+LANE = 32  # shots per uint32 lane word
+
+
+def num_words(batch_size: int) -> int:
+    """Packed words needed for ``batch_size`` shots."""
+    return -(-int(batch_size) // LANE)
+
+
+def lane_mask(batch_size: int) -> jnp.ndarray:
+    """(W,) uint32 mask of valid shot bits; ragged tails mask the padding."""
+    w = num_words(batch_size)
+    idx = np.arange(w * LANE, dtype=np.uint64).reshape(w, LANE)
+    valid = idx < batch_size
+    words = (valid.astype(np.uint64) << np.arange(LANE, dtype=np.uint64)).sum(1)
+    return jnp.asarray(words.astype(np.uint32))
+
+
+def pack_shots(bits) -> jnp.ndarray:
+    """Pack a (B, ...) {0,1} plane into (ceil(B/32), ...) uint32 lane words.
+
+    Shot ``32*w + j`` lands in bit ``j`` of word ``w`` (LSB-first); a ragged
+    tail pads with zero bits.  Inside jit, XLA fuses the compare/shift/sum so
+    the uint8 plane never materializes.
+    """
+    bits = jnp.asarray(bits)
+    b = bits.shape[0]
+    w = num_words(b)
+    pad = w * LANE - b
+    if pad:
+        bits = jnp.pad(bits, [(0, pad)] + [(0, 0)] * (bits.ndim - 1))
+    x = bits.reshape((w, LANE) + bits.shape[1:]).astype(jnp.uint32)
+    shifts = jnp.arange(LANE, dtype=jnp.uint32).reshape(
+        (1, LANE) + (1,) * (bits.ndim - 1))
+    return jnp.sum(x << shifts, axis=1, dtype=jnp.uint32)
+
+
+def unpack_shots(packed, batch_size: int) -> jnp.ndarray:
+    """Inverse of ``pack_shots``: (W, ...) uint32 -> (batch_size, ...) uint8."""
+    packed = jnp.asarray(packed)
+    w = packed.shape[0]
+    shifts = jnp.arange(LANE, dtype=jnp.uint32).reshape(
+        (1, LANE) + (1,) * (packed.ndim - 1))
+    bits = (packed[:, None] >> shifts) & jnp.uint32(1)
+    out = bits.reshape((w * LANE,) + packed.shape[1:]).astype(jnp.uint8)
+    return out[:batch_size]
+
+
+def xor_reduce(x, axis: int = -1) -> jnp.ndarray:
+    """Bitwise-XOR reduction (the packed-layout mod-2 accumulator)."""
+    x = jnp.asarray(x)
+    return jax.lax.reduce(x, np.array(0, x.dtype), jax.lax.bitwise_xor,
+                          (axis % x.ndim,))
+
+
+def or_reduce(x, axis: int = -1) -> jnp.ndarray:
+    """Bitwise-OR reduction (packed ``any`` over a plane axis)."""
+    x = jnp.asarray(x)
+    return jax.lax.reduce(x, np.array(0, x.dtype), jax.lax.bitwise_or,
+                          (axis % x.ndim,))
+
+
+def popcount(x) -> jnp.ndarray:
+    """Per-word set-bit count (uint32 in, uint32 out)."""
+    return jax.lax.population_count(jnp.asarray(x))
+
+
+def packed_parity_apply(nbr, mask, packed_bits) -> jnp.ndarray:
+    """Packed sparse GF(2) SpMV: ``x @ H.T % 2`` on lane words.
+
+    ``nbr``/``mask`` are a ParityOp's (m, rw) padded adjacency;
+    ``packed_bits`` is (W, n) uint32.  Returns (W, m) uint32 — each output
+    word carries the syndrome bit of 32 shots, computed as an XOR of the
+    <= rw gathered neighbor words.
+    """
+    g = jnp.asarray(packed_bits)[..., nbr]                 # (W, m, rw)
+    return xor_reduce(jnp.where(mask, g, jnp.uint32(0)), axis=-1)
+
+
+def packed_gf2_matmul(packed_bits, h_t) -> jnp.ndarray:
+    """Packed dense GF(2) product ``x @ h_t % 2`` on lane words.
+
+    packed_bits: (W, n) uint32; h_t: (n, k) {0,1}.  Returns (W, k) uint32.
+    Masked XOR-reduction over n — meant for small k (logical checks); use
+    ``packed_parity_apply`` for sparse parity-check matrices.
+    """
+    xp = jnp.asarray(packed_bits)
+    sel = jnp.where(jnp.asarray(h_t)[None, :, :] != 0, xp[:, :, None],
+                    jnp.uint32(0))                         # (W, n, k)
+    return xor_reduce(sel, axis=1)
+
+
+def packed_any(packed_words, axis: int = -1) -> jnp.ndarray:
+    """Per-shot OR over a plane axis: (W, m) -> (W,) flag words."""
+    return or_reduce(packed_words, axis=axis)
+
+
+def packed_count(flag_words, batch_size: int) -> jnp.ndarray:
+    """Count set shots in (W,) flag words, masking ragged padding lanes.
+
+    Returns an int32 device scalar (no host sync).
+    """
+    masked = jnp.asarray(flag_words) & lane_mask(batch_size)
+    return popcount(masked).sum(dtype=jnp.int32)
+
+
+def packed_residual_stats(res_x, res_z, hz_par, hx_par, lz_t, lx_t,
+                          eval_type: str, batch_size: int, n: int, *,
+                          z_weight_excludes_stab: bool = False):
+    """Residual stabilizer/logical checks on packed planes -> two scalars.
+
+    The shared tail of every packed pipeline (data-error, phenom, and the
+    fused XLA twin): stabilizer parity as an XOR gather, logical checks as a
+    packed masked-XOR matmul, failure count by lane-masked popcount, and the
+    min residual weight among logical failures.
+
+    res_x/res_z: (W, n) packed residual planes.  hz_par/hx_par: ParityOp
+    ``(nbr, mask)`` adjacency pairs (hz checks res_x, hx checks res_z).
+    lz_t/lx_t: (n, k) {0,1} logical transposes (any dtype; nonzero = 1).
+    ``z_weight_excludes_stab`` reproduces the phenom engine's convention of
+    excluding stabilizer-failed shots from the z min-weight track.  Returns
+    int32 device scalars (failure count, min logical residual weight).
+    """
+    x_stab = packed_any(packed_parity_apply(hz_par[0], hz_par[1], res_x))
+    x_log = packed_any(packed_gf2_matmul(res_x, lz_t))
+    z_stab = packed_any(packed_parity_apply(hx_par[0], hx_par[1], res_z))
+    z_log = packed_any(packed_gf2_matmul(res_z, lx_t))
+    x_fail = x_stab | x_log
+    z_fail = z_stab | z_log
+    if eval_type == "X":
+        fail = x_fail
+    elif eval_type == "Z":
+        fail = z_fail
+    else:
+        fail = x_fail | z_fail
+    wz_flags = z_log & ~z_stab if z_weight_excludes_stab else z_log
+    wx = jnp.where(unpack_shots(x_log, batch_size).astype(bool),
+                   packed_per_shot_weight(res_x, batch_size), n)
+    wz = jnp.where(unpack_shots(wz_flags, batch_size).astype(bool),
+                   packed_per_shot_weight(res_z, batch_size), n)
+    min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
+    return packed_count(fail, batch_size), min_w
+
+
+def packed_per_shot_weight(packed_bits, batch_size: int) -> jnp.ndarray:
+    """Per-shot Hamming weight of a packed (W, n) plane -> (batch_size,) i32.
+
+    Used for the min-logical-weight diagnostic; XLA fuses the lane unpack
+    with the reduction so no (B, n) plane is materialized.
+    """
+    packed = jnp.asarray(packed_bits)
+    w = packed.shape[0]
+    shifts = jnp.arange(LANE, dtype=jnp.uint32).reshape(
+        (1, LANE) + (1,) * (packed.ndim - 1))
+    bits = (packed[:, None] >> shifts) & jnp.uint32(1)     # (W, 32, n)
+    weights = jnp.sum(bits, axis=-1, dtype=jnp.int32)      # (W, 32)
+    return weights.reshape(w * LANE)[:batch_size]

@@ -1,0 +1,465 @@
+"""Fused sample→syndrome→check kernels on bit-packed GF(2) planes.
+
+The packed XLA layer (ops/gf2_packed) already cuts the sample+syndrome HBM
+traffic ~8x, but still materializes the packed error planes between the
+sampler dispatch and the syndrome dispatch, and re-reads them for the
+residual checks after BP.  This module removes both hand-offs:
+
+  * ``sample_syndrome`` draws the depolarizing errors from a COUNTER-BASED
+    PRNG (Threefry-2x32 keyed on (shot, qubit) — no sampler state, any
+    (shot, qubit) word is recomputable anywhere), computes both syndrome
+    SpMVs in-register, and writes only packed planes.  On TPU this is ONE
+    Pallas dispatch whose only HBM writes are the packed errors + syndromes.
+  * ``residual_check_stats`` REGENERATES the error bits from the same
+    counters instead of reading them back, XORs the BP corrections in, and
+    reduces the stabilizer/logical checks to two int32 scalars per block —
+    so with the Pallas path the (B, n) error planes never touch HBM at all:
+    sampling → syndrome SpMV → residual stabilizer/logical checks are fused
+    across exactly two dispatches with BP in between, and the inter-stage
+    traffic is the syndromes and corrections only (~(mx+mz+2n)/8 bytes per
+    shot).
+
+Every kernel has an XLA twin built from the SAME ``threefry2x32`` and the
+gf2_packed ops, bit-exact word for word with the kernel (asserted in
+interpret mode by tests/test_gf2_pallas.py) — the twin is the fallback on
+CPU / when the batch doesn't tile.  The counter-PRNG stream is deliberately
+its OWN stream: it does not reproduce ``jax.random.uniform`` draws, so the
+fused path is opt-in (``CodeSimulator_DataError(fused_sampler=True)``) and
+the default packed path stays seed-for-seed identical to the dense one.
+
+Layout matches gf2_packed: 32 shots per uint32 lane word, shot ``32*w + j``
+in bit ``j`` (LSB-first).  Kernel arithmetic stays in int32 (mosaic-friendly
+outputs); words bitcast to uint32 at the boundary.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._pallas_compat import CompilerParams
+from .bp import _LruCache
+from .gf2_packed import LANE, num_words, pack_shots, \
+    packed_parity_apply, packed_residual_stats
+from .linalg import ParityOp
+
+__all__ = [
+    "threefry2x32",
+    "counter_draws",
+    "depolarizing_cuts",
+    "FusedSpec",
+    "build_fused_spec",
+    "sample_syndrome",
+    "residual_check_stats",
+    "pallas_feasible",
+]
+
+
+# ---------------------------------------------------------------------------
+# Counter-based PRNG: Threefry-2x32, 20 rounds (the jax default generator's
+# block cipher).  Pure jnp bit ops, so the SAME function body runs inside the
+# Pallas kernel and in the XLA twin — bit-exactness between the two paths is
+# by construction, not by test luck (the test still asserts it).
+_ROTATIONS = ((13, 15, 26, 6), (17, 29, 16, 24))
+_PARITY_CONST = 0x1BD11BDA
+
+
+def _rotl(x, r: int):
+    return (x << jnp.uint32(r)) | (x >> jnp.uint32(32 - r))
+
+
+def threefry2x32(k0, k1, c0, c1):
+    """Threefry-2x32(20 rounds): (key words, counter words) -> 2 uint32.
+
+    All inputs broadcast; outputs have the broadcast shape.  Matches the
+    reference cipher (Salmon et al. 2011) round for round.
+    """
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    ks = (k0, k1, k0 ^ k1 ^ jnp.uint32(_PARITY_CONST))
+    x0 = jnp.asarray(c0, jnp.uint32) + ks[0]
+    x1 = jnp.asarray(c1, jnp.uint32) + ks[1]
+    for block in range(5):
+        for r in _ROTATIONS[block % 2]:
+            x0 = x0 + x1
+            x1 = _rotl(x1, r) ^ x0
+        x0 = x0 + ks[(block + 1) % 3]
+        x1 = x1 + ks[(block + 2) % 3] + jnp.uint32(block + 1)
+    return x0, x1
+
+
+def counter_draws(k0, k1, batch_size: int, n: int) -> jnp.ndarray:
+    """(batch_size, n) uint32 draws, word (b, v) = Threefry(key, (b, v)).x0.
+
+    The XLA twin of the in-kernel generator: same counters, same words."""
+    c0 = jnp.arange(batch_size, dtype=jnp.uint32)[:, None]
+    c1 = jnp.arange(n, dtype=jnp.uint32)[None, :]
+    x0, _ = threefry2x32(k0, k1, c0, c1)
+    return x0
+
+
+def depolarizing_cuts(pauli_error_probs) -> np.ndarray:
+    """[pz, pz+px, pz+px+py] as uint32 thresholds on a uniform 32-bit draw.
+
+    Binning order matches noise.depolarizing_xz / the reference
+    (src/Simulators.py:102-113): u < pz -> Z, next px -> X, next py -> Y."""
+    px, py, pz = (float(p) for p in pauli_error_probs)
+    edges = np.cumsum([pz, px, py])
+    if edges[-1] > 1.0 + 1e-9:
+        raise ValueError(f"pauli probs sum to {edges[-1]} > 1")
+    return np.minimum(np.round(edges * 4294967296.0), 4294967295.0).astype(
+        np.uint32)
+
+
+def _errors_from_draws(r, cuts):
+    """uint32 draws + cuts -> (error_x, error_z) int32 {0,1} planes."""
+    cz, czx, czxy = (cuts[i] for i in range(3))
+    is_z = r < cz
+    is_x = (r >= cz) & (r < czx)
+    is_y = (r >= czx) & (r < czxy)
+    return (is_x | is_y).astype(jnp.int32), (is_z | is_y).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+class FusedSpec(NamedTuple):
+    """Per-code device data for the fused kernels (a plain array pytree, so
+    it rides through jit as a value like the simulators' ``state``).
+
+    Dense f32 transposes feed the in-kernel MXU products; the ParityOp
+    adjacencies feed the XLA twin's packed XOR gathers."""
+
+    cuts: jnp.ndarray       # (3,) uint32 depolarizing thresholds
+    hx_t: jnp.ndarray       # (n, mx) f32 — syndrome_z = e_z @ hx_t
+    hz_t: jnp.ndarray       # (n, mz) f32
+    lx_t: jnp.ndarray       # (n, k) f32 — z-logical check
+    lz_t: jnp.ndarray       # (n, k) f32
+    hx_nbr: jnp.ndarray     # ParityOp(hx) adjacency (twin path)
+    hx_mask: jnp.ndarray
+    hz_nbr: jnp.ndarray
+    hz_mask: jnp.ndarray
+
+
+_spec_cache = _LruCache()
+
+
+def build_fused_spec(hx, hz, lx, lz, pauli_error_probs) -> FusedSpec:
+    hx = (np.asarray(hx) != 0).astype(np.uint8)
+    hz = (np.asarray(hz) != 0).astype(np.uint8)
+    lx = (np.asarray(lx) != 0).astype(np.uint8)
+    lz = (np.asarray(lz) != 0).astype(np.uint8)
+    cuts = depolarizing_cuts(pauli_error_probs)
+    key = (hx.shape, hz.shape, hx.tobytes(), hz.tobytes(), lx.tobytes(),
+           lz.tobytes(), cuts.tobytes())
+
+    def make():
+        hxp, hzp = ParityOp(hx), ParityOp(hz)
+        return FusedSpec(
+            cuts=jnp.asarray(cuts),
+            hx_t=jnp.asarray(hx.T, jnp.float32),
+            hz_t=jnp.asarray(hz.T, jnp.float32),
+            lx_t=jnp.asarray(lx.T, jnp.float32),
+            lz_t=jnp.asarray(lz.T, jnp.float32),
+            hx_nbr=hxp.nbr, hx_mask=hxp.mask,
+            hz_nbr=hzp.nbr, hz_mask=hzp.mask,
+        )
+
+    return _spec_cache.get(key, make)
+
+
+def _key_words(key):
+    kd = jax.random.key_data(key) if hasattr(jax.random, "key_data") else key
+    kd = jnp.asarray(kd, jnp.uint32).reshape(-1)
+    return kd[0], kd[1]
+
+
+# ---------------------------------------------------------------------------
+# In-kernel building blocks (shared by both kernels; plain jnp so the same
+# code runs under interpret, mosaic, and in the XLA twins' tests)
+def _block_draws(k0, k1, base_shot, block_w: int, n: int):
+    """(block_w, LANE, n) uint32 draws for shots [base, base + 32*block_w)."""
+    shape = (block_w, LANE, n)
+    w_i = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    j_i = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    v_i = jax.lax.broadcasted_iota(jnp.uint32, shape, 2)
+    shot = jnp.asarray(base_shot, jnp.uint32) + w_i * jnp.uint32(LANE) + j_i
+    x0, _ = threefry2x32(k0, k1, shot, v_i)
+    return x0
+
+
+def _pack_lane_axis(bits3):
+    """(W, LANE, d) int32 {0,1} -> (W, d) int32 words (bit j = lane j)."""
+    shifts = jax.lax.broadcasted_iota(jnp.int32, bits3.shape, 1)
+    return jax.lax.reduce(
+        jax.lax.shift_left(bits3, shifts), np.int32(0),
+        jax.lax.bitwise_or, (1,))
+
+
+def _unpack_lane_axis(words, block_w: int, d: int):
+    """(W, d) int32 words -> (W, LANE, d) int32 {0,1}."""
+    shape = (block_w, LANE, d)
+    shifts = jax.lax.broadcasted_iota(jnp.int32, shape, 1)
+    full = jnp.broadcast_to(words[:, None, :], shape)
+    return jax.lax.shift_right_logical(full, shifts) & jnp.int32(1)
+
+
+def _mod2(x):
+    return x - 2.0 * jnp.floor(x * 0.5)
+
+
+def _gf2_dense(bits_f32, h_t_f32):
+    """Exact GF(2) product on the MXU: f32 accumulate, mod 2 (row sums are
+    far below 2**24 for any code here)."""
+    return _mod2(jnp.dot(bits_f32, h_t_f32, preferred_element_type=jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Kernel 1: counter PRNG -> packed errors + packed syndromes, one dispatch.
+# The ``emit_errors=False`` variant writes ONLY the packed syndromes — the
+# error planes live and die in VMEM (kernel 2 regenerates them from the same
+# counters), so the sampler's HBM cost drops to (mx + mz)/8 bytes per shot.
+def _sample_block(par_ref, block_w: int, n: int):
+    k0 = jax.lax.bitcast_convert_type(par_ref[0, 0], jnp.uint32)
+    k1 = jax.lax.bitcast_convert_type(par_ref[0, 1], jnp.uint32)
+    cuts = jax.lax.bitcast_convert_type(par_ref[0, 2:5], jnp.uint32)
+    base = pl.program_id(0) * (block_w * LANE)
+    r = _block_draws(k0, k1, base, block_w, n)
+    return _errors_from_draws(r, cuts)
+
+
+def _sample_syndrome_kernel(par_ref, hx_t_ref, hz_t_ref, *out_refs,
+                            block_w: int, n: int, mx: int, mz: int,
+                            emit_errors: bool):
+    ex, ez = _sample_block(par_ref, block_w, n)
+    if emit_errors:
+        exp_ref, ezp_ref, sxp_ref, szp_ref = out_refs
+        exp_ref[:] = _pack_lane_axis(ex)
+        ezp_ref[:] = _pack_lane_axis(ez)
+    else:
+        sxp_ref, szp_ref = out_refs
+    bt = block_w * LANE
+    sz = _gf2_dense(ez.reshape(bt, n).astype(jnp.float32), hx_t_ref[:])
+    sx = _gf2_dense(ex.reshape(bt, n).astype(jnp.float32), hz_t_ref[:])
+    szp_ref[:] = _pack_lane_axis(sz.astype(jnp.int32).reshape(block_w, LANE, mx))
+    sxp_ref[:] = _pack_lane_axis(sx.astype(jnp.int32).reshape(block_w, LANE, mz))
+
+
+def _pack_params(spec: FusedSpec, key):
+    k0, k1 = _key_words(key)
+    return jax.lax.bitcast_convert_type(
+        jnp.stack([k0, k1, spec.cuts[0], spec.cuts[1], spec.cuts[2],
+                   jnp.uint32(0), jnp.uint32(0), jnp.uint32(0)]),
+        jnp.int32).reshape(1, 8)
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size", "block_w",
+                                             "interpret", "emit_errors"))
+def _sample_syndrome_pallas(spec: FusedSpec, key, batch_size: int,
+                            block_w: int, interpret: bool,
+                            emit_errors: bool = True):
+    n, mx = spec.hx_t.shape
+    mz = spec.hz_t.shape[1]
+    w = num_words(batch_size)
+    assert batch_size % (block_w * LANE) == 0, (batch_size, block_w)
+    kernel = functools.partial(_sample_syndrome_kernel, block_w=block_w,
+                               n=n, mx=mx, mz=mz, emit_errors=emit_errors)
+    grid = (w // block_w,)
+    err_specs = [pl.BlockSpec((block_w, n), lambda t: (t, 0)),
+                 pl.BlockSpec((block_w, n), lambda t: (t, 0))]
+    err_shapes = [jax.ShapeDtypeStruct((w, n), jnp.int32),
+                  jax.ShapeDtypeStruct((w, n), jnp.int32)]
+    out = pl.pallas_call(
+        kernel,
+        name=(f"gf2_sample_synd_{n}x{mx}x{mz}_w{block_w}"
+              f"{'_e' if emit_errors else ''}"),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda t: (0, 0)),
+            pl.BlockSpec((n, mx), lambda t: (0, 0)),
+            pl.BlockSpec((n, mz), lambda t: (0, 0)),
+        ],
+        out_specs=(err_specs if emit_errors else []) + [
+            pl.BlockSpec((block_w, mz), lambda t: (t, 0)),
+            pl.BlockSpec((block_w, mx), lambda t: (t, 0)),
+        ],
+        out_shape=(err_shapes if emit_errors else []) + [
+            jax.ShapeDtypeStruct((w, mz), jnp.int32),
+            jax.ShapeDtypeStruct((w, mx), jnp.int32),
+        ],
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(_pack_params(spec, key), spec.hx_t, spec.hz_t)
+    u = functools.partial(jax.lax.bitcast_convert_type,
+                          new_dtype=jnp.uint32)
+    return tuple(u(o) for o in out)
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size", "emit_errors"))
+def _sample_syndrome_xla(spec: FusedSpec, key, batch_size: int,
+                         emit_errors: bool = True):
+    n = spec.hx_t.shape[0]
+    k0, k1 = _key_words(key)
+    r = counter_draws(k0, k1, batch_size, n)
+    ex, ez = _errors_from_draws(r, spec.cuts)
+    exp = pack_shots(ex.astype(jnp.uint8))
+    ezp = pack_shots(ez.astype(jnp.uint8))
+    szp = packed_parity_apply(spec.hx_nbr, spec.hx_mask, ezp)
+    sxp = packed_parity_apply(spec.hz_nbr, spec.hz_mask, exp)
+    if emit_errors:
+        return exp, ezp, sxp, szp
+    return sxp, szp
+
+
+# ---------------------------------------------------------------------------
+# Kernel 2: regenerate errors from the same counters, apply corrections,
+# reduce residual stabilizer/logical checks to per-block scalars
+def _residual_check_kernel(par_ref, corx_ref, corz_ref,
+                           hx_t_ref, hz_t_ref, lx_t_ref, lz_t_ref,
+                           cnt_ref, minw_ref,
+                           *, block_w: int, n: int, eval_code: int):
+    k0 = jax.lax.bitcast_convert_type(par_ref[0, 0], jnp.uint32)
+    k1 = jax.lax.bitcast_convert_type(par_ref[0, 1], jnp.uint32)
+    cuts = jax.lax.bitcast_convert_type(par_ref[0, 2:5], jnp.uint32)
+    base = pl.program_id(0) * (block_w * LANE)
+    r = _block_draws(k0, k1, base, block_w, n)
+    ex, ez = _errors_from_draws(r, cuts)
+    res_x = ex ^ _unpack_lane_axis(corx_ref[:], block_w, n)
+    res_z = ez ^ _unpack_lane_axis(corz_ref[:], block_w, n)
+    bt = block_w * LANE
+    rx = res_x.reshape(bt, n).astype(jnp.float32)
+    rz = res_z.reshape(bt, n).astype(jnp.float32)
+    x_stab = jnp.max(_gf2_dense(rx, hz_t_ref[:]), axis=1)       # (bt,)
+    x_log = jnp.max(_gf2_dense(rx, lz_t_ref[:]), axis=1)
+    z_stab = jnp.max(_gf2_dense(rz, hx_t_ref[:]), axis=1)
+    z_log = jnp.max(_gf2_dense(rz, lx_t_ref[:]), axis=1)
+    x_fail = jnp.maximum(x_stab, x_log)
+    z_fail = jnp.maximum(z_stab, z_log)
+    if eval_code == 0:
+        fail = x_fail
+    elif eval_code == 1:
+        fail = z_fail
+    else:
+        fail = jnp.maximum(x_fail, z_fail)
+    cnt_ref[0, 0] = jnp.sum(fail, dtype=jnp.float32).astype(jnp.int32)
+    big = jnp.float32(n)
+    wx = jnp.where(x_log > 0, jnp.sum(rx, axis=1), big)
+    wz = jnp.where(z_log > 0, jnp.sum(rz, axis=1), big)
+    minw_ref[0, 0] = jnp.minimum(jnp.min(wx), jnp.min(wz)).astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size", "eval_type",
+                                             "block_w", "interpret"))
+def _residual_check_pallas(spec: FusedSpec, key, batch_size: int,
+                           corx_p, corz_p, eval_type: str,
+                           block_w: int, interpret: bool):
+    n = spec.hx_t.shape[0]
+    w = num_words(batch_size)
+    assert batch_size % (block_w * LANE) == 0, (batch_size, block_w)
+    kernel = functools.partial(
+        _residual_check_kernel, block_w=block_w, n=n,
+        eval_code={"X": 0, "Z": 1}.get(eval_type, 2))
+    grid = (w // block_w,)
+    i32 = functools.partial(jax.lax.bitcast_convert_type,
+                            new_dtype=jnp.int32)
+    cnt, minw = pl.pallas_call(
+        kernel,
+        name=f"gf2_residual_check_{n}_w{block_w}",
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda t: (0, 0)),
+            pl.BlockSpec((block_w, n), lambda t: (t, 0)),
+            pl.BlockSpec((block_w, n), lambda t: (t, 0)),
+            pl.BlockSpec(spec.hx_t.shape, lambda t: (0, 0)),
+            pl.BlockSpec(spec.hz_t.shape, lambda t: (0, 0)),
+            pl.BlockSpec(spec.lx_t.shape, lambda t: (0, 0)),
+            pl.BlockSpec(spec.lz_t.shape, lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda t: (t, 0)),
+            pl.BlockSpec((1, 1), lambda t: (t, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
+        ],
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=64 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(_pack_params(spec, key), i32(corx_p), i32(corz_p), spec.hx_t,
+      spec.hz_t, spec.lx_t, spec.lz_t)
+    return cnt.sum(dtype=jnp.int32), minw.min()
+
+
+@functools.partial(jax.jit, static_argnames=("batch_size", "eval_type"))
+def _residual_check_xla(spec: FusedSpec, key, batch_size: int,
+                        corx_p, corz_p, eval_type: str):
+    n = spec.hx_t.shape[0]
+    k0, k1 = _key_words(key)
+    r = counter_draws(k0, k1, batch_size, n)
+    ex, ez = _errors_from_draws(r, spec.cuts)
+    res_x = pack_shots(ex.astype(jnp.uint8)) ^ corx_p
+    res_z = pack_shots(ez.astype(jnp.uint8)) ^ corz_p
+    return packed_residual_stats(
+        res_x, res_z, (spec.hz_nbr, spec.hz_mask),
+        (spec.hx_nbr, spec.hx_mask), spec.lz_t != 0, spec.lx_t != 0,
+        eval_type, batch_size, n)
+
+
+# ---------------------------------------------------------------------------
+# Public dispatchers: Pallas on TPU when the batch tiles, XLA twin otherwise
+_DEFAULT_BLOCK_W = 8  # 256 shots per kernel block
+
+
+def pallas_feasible(batch_size: int, block_w: int = _DEFAULT_BLOCK_W) -> bool:
+    return batch_size % (block_w * LANE) == 0
+
+
+def _use_pallas(batch_size: int, backend) -> bool:
+    if backend in ("xla", "cpu"):
+        return False
+    if backend == "pallas":
+        return True
+    try:
+        return (jax.default_backend() == "tpu"
+                and pallas_feasible(batch_size))
+    except Exception:
+        return False
+
+
+def sample_syndrome(spec: FusedSpec, key, batch_size: int, *,
+                    backend: str = "auto", block_w: int = _DEFAULT_BLOCK_W,
+                    interpret: bool = False, emit_errors: bool = True):
+    """Counter-PRNG depolarizing sample + both syndrome SpMVs, fused.
+
+    Returns packed uint32 (ex_p, ez_p, sx_p, sz_p), or just (sx_p, sz_p)
+    with ``emit_errors=False`` (the fully-fused stats pipeline — kernel 2
+    regenerates the errors, so they never reach HBM).  The Pallas path and
+    the XLA twin produce identical words."""
+    if _use_pallas(batch_size, backend):
+        return _sample_syndrome_pallas(spec, key, batch_size, block_w,
+                                       interpret, emit_errors)
+    return _sample_syndrome_xla(spec, key, batch_size, emit_errors)
+
+
+def residual_check_stats(spec: FusedSpec, key, batch_size: int,
+                         corx_p, corz_p, eval_type: str = "Total", *,
+                         backend: str = "auto",
+                         block_w: int = _DEFAULT_BLOCK_W,
+                         interpret: bool = False):
+    """Residual stabilizer/logical checks with in-kernel error regeneration.
+
+    ``key`` must be the SAME key passed to ``sample_syndrome`` for this
+    batch (the counters regenerate that exact error).  Returns int32 device
+    scalars (failure count, min logical residual weight)."""
+    if _use_pallas(batch_size, backend):
+        return _residual_check_pallas(spec, key, batch_size, corx_p, corz_p,
+                                      eval_type, block_w, interpret)
+    return _residual_check_xla(spec, key, batch_size, corx_p, corz_p,
+                               eval_type)

@@ -38,6 +38,7 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["OsdPlan", "build_osd_plan", "osd_decode_device"]
 
 
+from ._pallas_compat import CompilerParams
 from .bp import _LruCache  # shared bounded memo (see ops/bp.py)
 
 _pack_cache = _LruCache()
@@ -453,7 +454,7 @@ def _eliminate_pallas(plan, perm, syndromes, bt: int = 128,
             pltpu.VMEM((m, bt), jnp.int32),
             pltpu.VMEM((8, bt), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_ELIM_VMEM_LIMIT,
         ),
         interpret=interpret,
@@ -644,7 +645,7 @@ def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
             pltpu.VMEM((8, bt), jnp.int32),
             pltpu.VMEM((8, bt), jnp.int32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             vmem_limit_bytes=_ELIM_VMEM_LIMIT,
         ),
         interpret=interpret,

@@ -1,6 +1,9 @@
 from .grid import merge_cell_results, process_cell_owner
 from .shots import (
     SHOT_AXIS,
+    MegabatchDriver,
+    count_min_driver,
+    drain_double_buffered,
     sharded_batch_stats,
     shot_mesh,
     split_keys_for_mesh,
@@ -8,6 +11,9 @@ from .shots import (
 
 __all__ = [
     "SHOT_AXIS",
+    "MegabatchDriver",
+    "count_min_driver",
+    "drain_double_buffered",
     "sharded_batch_stats",
     "shot_mesh",
     "split_keys_for_mesh",

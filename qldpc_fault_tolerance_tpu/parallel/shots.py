@@ -1,4 +1,5 @@
-"""Device-mesh sharding of the Monte-Carlo shot axis.
+"""Device-mesh sharding of the Monte-Carlo shot axis + the dispatch-amortized
+megabatch driver.
 
 The reference's only parallelism is a fork/queue process pool over shots
 (parmap, src/Simulators.py:45-61) with mp.Queue as the "communication
@@ -8,22 +9,53 @@ backend".  The TPU-native mapping: shots are a batch axis inside one chip
 counts.  Multi-host sweeps additionally split the (code, p, cycles) grid by
 ``jax.process_index()`` (see sweep/family.py) so only scalar results cross
 DCN.
+
+Dispatch amortization (``MegabatchDriver``): the tunneled chip pays
+~40-100ms of fixed latency per dispatch and per host fetch, so per-batch
+dispatches dominate short sweeps.  The driver scans ``k_inner`` batches
+inside ONE compiled dispatch (a ``lax.scan`` over the batch index, with the
+accumulator carry donated so XLA reuses the buffers in place) and drains
+results to the host double-buffered: while megabatch d+1 computes, megabatch
+d's values cross the wire.  Fixed latency is paid once per ``k_inner``
+batches instead of once per batch.
 """
 from __future__ import annotations
 
 import functools
+from collections import deque
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.bp import _LruCache  # shared bounded memo (see ops/bp.py)
 
 __all__ = [
     "shot_mesh",
     "sharded_batch_stats",
     "split_keys_for_mesh",
+    "MegabatchDriver",
+    "count_min_driver",
+    "drain_double_buffered",
 ]
 
+# engine stats drivers, memoized on (tag, cfg, k_inner) — see count_min_driver
+_engine_driver_cache = _LruCache()
+
 SHOT_AXIS = "shots"
+
+
+def _shard_map(f, *, mesh, in_specs, out_specs, check_vma):
+    """jax.shard_map across the 0.4/0.5+ API move (jax.experimental.shard_map
+    with ``check_rep`` -> jax.shard_map with ``check_vma``)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=check_vma)
 
 
 def shot_mesh(devices=None) -> Mesh:
@@ -55,7 +87,7 @@ def sharded_batch_stats(stats_fn, mesh: Mesh):
     # sharded-vs-replay equality tests (tests/test_parallel.py).
     @jax.jit
     @functools.partial(
-        jax.shard_map,
+        _shard_map,
         mesh=mesh,
         in_specs=(P(SHOT_AXIS),),
         out_specs=(P(), P()),
@@ -69,3 +101,123 @@ def sharded_batch_stats(stats_fn, mesh: Mesh):
         )
 
     return run
+
+
+# ---------------------------------------------------------------------------
+# Dispatch-amortized megabatch driver
+# ---------------------------------------------------------------------------
+class MegabatchDriver:
+    """Run ``stats_fn(key, *extra)`` for many batches, ``k_inner`` per
+    dispatch.
+
+    stats_fn: (key, *extra) -> pytree of device values (typically scalars).
+              ``extra`` rides through ``run`` untraced-by-name (arrays /
+              pytrees — e.g. an engine's device state), so one driver keyed
+              on a hashable config serves every same-shape simulator (a
+              p-sweep compiles once).
+    combine:  (carry, out) -> carry — the on-device fold (count sums,
+              min-weights jnp.minimum, ...).
+    init_fn:  () -> initial carry pytree (device values).
+
+    ``run`` folds everything on device and returns the carry WITHOUT a host
+    sync — the caller's materialization is the only round-trip.  ``run_keys``
+    streams per-megabatch carries to the host double-buffered for callers
+    that need intermediate values (target-failure early stopping).
+
+    The carry is donated into each dispatch (`donate_argnums`) so XLA
+    accumulates in place instead of allocating a fresh buffer chain; donation
+    is skipped on backends that don't implement it (CPU) to keep test logs
+    clean.
+    """
+
+    def __init__(self, stats_fn, combine, init_fn, k_inner: int = 8):
+        self.k_inner = max(1, int(k_inner))
+        self._init_fn = init_fn
+        self.dispatches = 0  # cumulative, observable by bench
+
+        def mega(carry, key, offset, *extra):
+            def body(c, j):
+                out = stats_fn(jax.random.fold_in(key, offset + j), *extra)
+                return combine(c, out), None
+
+            carry, _ = jax.lax.scan(body, carry, jnp.arange(self.k_inner))
+            return carry
+
+        try:
+            donate = jax.default_backend() not in ("cpu",)
+        except Exception:
+            donate = False
+        self._mega = jax.jit(mega, donate_argnums=(0,) if donate else ())
+
+    def run(self, key, n_batches: int, *extra):
+        """Fold ``n_batches`` batches (rounded UP to a k_inner multiple so
+        every dispatch reuses one compiled scan shape).  Returns
+        ``(carry, batches_run)``; the carry is unsynced device values."""
+        k = self.k_inner
+        n_run = -(-int(n_batches) // k) * k
+        carry = self._init_fn()
+        for start in range(0, n_run, k):
+            carry = self._mega(carry, key, jnp.asarray(start, jnp.int32),
+                               *extra)
+            self.dispatches += 1
+        return carry, n_run
+
+    def run_keys(self, key, n_batches: int, *extra):
+        """Like ``run`` but yields ``(carry_after_megabatch, batches_so_far)``
+        per dispatch, double-buffered via ``drain_double_buffered``:
+        megabatch d's carry is snapshotted while d+1 computes, so
+        early-stopping callers see fresh counts at ~zero added latency.
+        The snapshot copies the carry (the live carry keeps accumulating /
+        being donated)."""
+        k = self.k_inner
+        n_run = -(-int(n_batches) // k) * k
+        carry_box = [self._init_fn()]
+
+        def launch(start):
+            carry_box[0] = self._mega(carry_box[0], key,
+                                      jnp.asarray(start, jnp.int32), *extra)
+            self.dispatches += 1
+            snap = jax.tree_util.tree_map(lambda x: x + 0, carry_box[0])
+            return snap, start + k
+
+        def finish(item):
+            snap, done = item
+            return jax.device_get(snap), done
+
+        yield from drain_double_buffered(launch, finish, range(0, n_run, k))
+
+
+def count_min_driver(tag: str, cfg, k_inner: int, stats_fn,
+                     min_init: int) -> MegabatchDriver:
+    """Memoized MegabatchDriver for the engines' shared stats shape: a
+    ``(failure count, min logical weight)`` fold.  Keyed on
+    ``(tag, cfg, k_inner)`` so same-structure simulator instances (p- and
+    cycle-sweeps: state values change, program doesn't) reuse one compiled
+    scan.  ``stats_fn(key, *extra) -> (i32 count, i32 min_w)``;
+    ``min_init`` seeds the min-weight track (the code length N)."""
+
+    def make():
+        return MegabatchDriver(
+            stats_fn,
+            lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1])),
+            lambda: (jnp.zeros((), jnp.int32),
+                     jnp.asarray(min_init, jnp.int32)),
+            k_inner=k_inner,
+        )
+
+    return _engine_driver_cache.get((tag, cfg, k_inner), make)
+
+
+def drain_double_buffered(launch, finish, items, depth: int = 2):
+    """Generic double-buffered async host drain: keep ``depth`` launched
+    device payloads in flight; yield ``finish(payload)`` host results in
+    order.  ``launch`` must only enqueue async device work; ``finish`` is
+    where the device->host transfer (and any host postprocess) happens, so
+    megabatch d+1's compute overlaps megabatch d's drain."""
+    pending = deque()
+    for it in items:
+        pending.append(launch(it))
+        if len(pending) >= depth:
+            yield finish(pending.popleft())
+    while pending:
+        yield finish(pending.popleft())

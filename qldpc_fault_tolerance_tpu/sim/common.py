@@ -24,6 +24,9 @@ __all__ = [
     "accumulate_counts",
     "windowed_count",
     "mesh_batch_stats",
+    "on_tunneled_worker",
+    "apply_worker_batch_fence",
+    "fence_batch_value",
 ]
 
 
@@ -113,8 +116,8 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key):
 # containing an OSD stage at batch >= 4096 (environment regression since
 # round 2; retries land on the same crash — README "Known frontiers").
 # Batch 1024-2048 is the measured safe envelope.  The same configs run
-# correctly at full batch on the CPU mesh (tests/test_worker_fence.py), so
-# this is a worker fence, not a framework limit.
+# correctly at full batch on the CPU backend (tests/test_worker_fence.py),
+# so this is a worker fence, not a framework limit.
 WORKER_OSD_BATCH_CRASH = 4096
 WORKER_OSD_BATCH_SAFE = 2048
 
@@ -127,33 +130,75 @@ def _has_osd_stage(sim) -> bool:
     )
 
 
+def _axon_tunnel_signal() -> bool:
+    """True when this process talks to the axon-tunneled worker.
+
+    The tunnel registers an experimental 'axon' PJRT platform in
+    jax's backend registry (the "Platform 'axon' is experimental" warning in
+    fence_proof.log / parity_r5.log) even though the default backend it
+    REPORTS is plain 'tpu'.  The registered-platform set is therefore the
+    tunnel signal; AXON_WORKER=1 is accepted as an explicit override for
+    terminal builds that stop registering the platform (a specific truthy
+    sentinel, NOT a bare AXON* name scan — unrelated AXON_LOG_LEVEL-style
+    vars or AXON_WORKER=0 must not clamp a direct TPU)."""
+    import os
+
+    marker = os.environ.get("AXON_WORKER", "").strip().lower()
+    if marker not in ("", "0", "false"):
+        return True
+    try:
+        from jax._src import xla_bridge as _xb
+
+        if "axon" in getattr(_xb, "_backend_factories", {}):
+            return True
+        if "axon" in getattr(_xb, "_backends", {}):
+            return True
+    except Exception:
+        pass
+    return False
+
+
+def on_tunneled_worker() -> bool:
+    """Backend-name gate for worker fences.
+
+    The tunneled worker reports ``jax.default_backend() == 'tpu'`` — NOT
+    'axon' (ADVICE round-5 high: gating on 'axon' left the fence inert in
+    production; bp_decoders.py:261 / osd_device.py's Pallas gates already
+    key on 'tpu').  So: backend 'tpu' plus the axon-tunnel signal.  A
+    literal 'axon' backend name is also accepted for direct-platform
+    configurations."""
+    import jax
+
+    try:
+        backend = jax.default_backend()
+    except Exception:  # backend init failure — nothing to fence
+        return False
+    if backend == "axon":
+        return True
+    return backend == "tpu" and _axon_tunnel_signal()
+
+
 def apply_worker_batch_fence(sim) -> None:
     """Clamp ``sim.batch_size`` into the tunneled worker's safe envelope.
 
     Engines call this at decode-dispatch time (not __init__ — space-time
     engines attach their OSD decoders after construction).  No-op off the
-    axon backend and for OSD-free pipelines: plain-BP programs run fine at
-    batch 16384 (bench.py flagship), so only OSD-bearing programs are
+    tunneled worker and for OSD-free pipelines: plain-BP programs run fine
+    at batch 16384 (bench.py flagship), so only OSD-bearing programs are
     fenced."""
     if sim.batch_size < WORKER_OSD_BATCH_CRASH or getattr(
             sim, "_batch_fence_applied", False):
         return
     if not _has_osd_stage(sim):
         return
-    import jax
-
-    try:
-        backend = jax.default_backend()
-    except Exception:  # backend init failure — nothing to fence
-        return
-    if backend != "axon":
+    if not on_tunneled_worker():
         return
     warnings.warn(
         f"tunneled-TPU worker fence: OSD decode at batch "
         f"{sim.batch_size} is in the worker's known-crash envelope "
         f"(>= {WORKER_OSD_BATCH_CRASH}); clamping batch_size to "
         f"{WORKER_OSD_BATCH_SAFE}.  Identical configs at full batch are "
-        "validated on the CPU mesh (tests/test_worker_fence.py).",
+        "validated on the CPU backend (tests/test_worker_fence.py).",
         stacklevel=3,
     )
     sim.batch_size = WORKER_OSD_BATCH_SAFE
@@ -167,12 +212,7 @@ def fence_batch_value(sim, batch_size: int) -> int:
     batch_size = int(batch_size)
     if batch_size < WORKER_OSD_BATCH_CRASH or not _has_osd_stage(sim):
         return batch_size
-    import jax
-
-    try:
-        if jax.default_backend() != "axon":
-            return batch_size
-    except Exception:
+    if not on_tunneled_worker():
         return batch_size
     warnings.warn(
         f"tunneled-TPU worker fence: OSD decode at batch {batch_size} is in "

@@ -9,6 +9,15 @@ BP-failed shots through the host between the decode and check stages.
 Parallelism: the reference's process-pool-over-shots (parmap,
 src/Simulators.py:45-61) becomes a batch axis on device; multi-chip scaling
 shards the same batch across a mesh (parallel/shots.py).
+
+Bit-packed execution (default): every {0,1} plane — errors, syndromes,
+corrections, residuals, failure flags — is packed 32 shots per uint32 lane
+(ops/gf2_packed), so the sampler writes 8x fewer bytes and the syndrome /
+residual-check SpMVs run as XOR gathers on lane words.  Only the BP LLR
+stage stays f32: syndromes unpack at the BP boundary and the hard-decision
+corrections re-pack after it.  The packed path is bit-exact (same PRNG
+draws, exact GF(2) algebra), so WER is seed-for-seed identical to the dense
+uint8 path (tests/test_gf2_packed.py).
 """
 from __future__ import annotations
 
@@ -19,8 +28,16 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..decoders.bp_decoders import decode_device
-from ..noise import depolarizing_xz
+from ..noise import depolarizing_xz, depolarizing_xz_packed
 from ..ops.linalg import ParityOp, gf2_matmul, parity_apply
+from ..ops.gf2_packed import (
+    pack_shots,
+    packed_parity_apply,
+    packed_residual_stats,
+    unpack_shots,
+)
+from ..ops import gf2_pallas
+from ..parallel.shots import MegabatchDriver, count_min_driver
 from .common import (
     apply_worker_batch_fence,
     fence_batch_value,
@@ -36,9 +53,9 @@ __all__ = ["CodeSimulator_DataError"]
 # ---------------------------------------------------------------------------
 # Value-based device pipeline (module-level; see sim/phenom.py): the jit
 # cache is keyed on ``cfg`` = (batch_size, N, eval_logical_type, dx_static,
-# dz_static); all arrays — parity gathers, logicals, channel probs, decoder
-# LLRs — ride in the ``state`` pytree, so a p-sweep (or equal-shape codes)
-# shares one executable per structure.
+# dz_static, packed); all arrays — parity gathers, logicals, channel probs,
+# decoder LLRs — ride in the ``state`` pytree, so a p-sweep (or equal-shape
+# codes) shares one executable per structure.
 def _parity(par, bits):
     return parity_apply(par[0], par[1], bits)
 
@@ -71,9 +88,71 @@ def _check(cfg, state, error_x, error_z, cor_x, cor_z):
     else:
         fail = x_failure | z_failure
     # min residual weight among logical failures (min_logical_weight track)
-    wx = jnp.where(x_log, residual_x.sum(axis=-1), n)
-    wz = jnp.where(z_log, residual_z.sum(axis=-1), n)
+    wx = jnp.where(x_log, residual_x.sum(axis=-1, dtype=jnp.int32), n)
+    wz = jnp.where(z_log, residual_z.sum(axis=-1, dtype=jnp.int32), n)
     return fail, jnp.minimum(wx.min(), wz.min())
+
+
+# ---------------------------------------------------------------------------
+# Bit-packed pipeline: the {0,1} planes stay 32-shots-per-uint32 end to end;
+# only the syndromes unpack (BP input) and the corrections pack (BP output).
+def _sample_and_bp_packed(cfg, state, key):
+    batch_size, n = cfg[0], cfg[1]
+    ex_p, ez_p = depolarizing_xz_packed(key, (batch_size, n), state["probs"])
+    synd_z_p = packed_parity_apply(state["hx_par"][0], state["hx_par"][1], ez_p)
+    synd_x_p = packed_parity_apply(state["hz_par"][0], state["hz_par"][1], ex_p)
+    # pack/unpack shim at the BP boundary: LLR messages stay f32
+    synd_z = unpack_shots(synd_z_p, batch_size)
+    synd_x = unpack_shots(synd_x_p, batch_size)
+    cor_z, _ = decode_device(cfg[4], state["dz"], synd_z)
+    cor_x, _ = decode_device(cfg[3], state["dx"], synd_x)
+    return ex_p, ez_p, cor_x, cor_z
+
+
+def _check_packed_stats(cfg, state, ex_p, ez_p, cor_x, cor_z):
+    """Packed residual checks -> (failure count, min weight) scalars.
+
+    Same bits as ``_check`` + ``.sum()``: stabilizer parity is an XOR
+    gather on lane words, logical checks a packed masked-XOR matmul, the
+    count a lane-masked popcount (exact on ragged batches)."""
+    batch_size, n, eval_type = cfg[0], cfg[1], cfg[2]
+    res_x = ex_p ^ pack_shots(cor_x)
+    res_z = ez_p ^ pack_shots(cor_z)
+    return packed_residual_stats(
+        res_x, res_z, state["hz_par"], state["hx_par"],
+        state["lz_t"], state["lx_t"], eval_type, batch_size, n)
+
+
+def _stats_fused(cfg, state, key):
+    """Fully-fused stats batch (ops/gf2_pallas): counter-PRNG sample +
+    syndrome SpMV in one dispatch that writes ONLY packed syndromes, BP,
+    then a residual-check dispatch that REGENERATES the errors from the
+    same counters — the (B, n) error planes never touch HBM.  Its own PRNG
+    stream (not ``jax.random.uniform``), hence opt-in via
+    ``fused_sampler=True``."""
+    batch_size = cfg[0]
+    spec = state["fspec"]
+    sxp, szp = gf2_pallas.sample_syndrome(spec, key, batch_size,
+                                          emit_errors=False)
+    synd_z = unpack_shots(szp, batch_size)
+    synd_x = unpack_shots(sxp, batch_size)
+    cor_z, _ = decode_device(cfg[4], state["dz"], synd_z)
+    cor_x, _ = decode_device(cfg[3], state["dx"], synd_x)
+    return gf2_pallas.residual_check_stats(
+        spec, key, batch_size, pack_shots(cor_x), pack_shots(cor_z), cfg[2])
+
+
+def _stats_one_batch(cfg, state, key):
+    """One batch fully on device -> (failure count, min weight) scalars,
+    fused / packed / dense per cfg[6] and cfg[5]."""
+    if len(cfg) > 6 and cfg[6]:
+        return _stats_fused(cfg, state, key)
+    if cfg[5]:
+        ex_p, ez_p, cx, cz = _sample_and_bp_packed(cfg, state, key)
+        return _check_packed_stats(cfg, state, ex_p, ez_p, cx, cz)
+    ex, ez, _, _, cx, cz, _, _ = _sample_and_bp(cfg, state, key)
+    fail, min_w = _check(cfg, state, ex, ez, cx, cz)
+    return fail.sum(dtype=jnp.int32), min_w
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -92,41 +171,32 @@ def _batch_stats(cfg, state, key):
     No host transfer — callers accumulate these device scalars across
     batches and read back once per sweep (the tunneled TPU pays ~100ms
     latency per device->host transfer; per-batch syncs would dominate)."""
-    ex, ez, _, _, cx, cz, _, _ = _sample_and_bp(cfg, state, key)
-    fail, min_w = _check(cfg, state, ex, ez, cx, cz)
-    return fail.sum(dtype=jnp.int32), min_w
+    return _stats_one_batch(cfg, state, key)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "chunk"))
-def _chunk_stats(cfg, state, key, offset, chunk: int):
-    """``chunk`` batches as one dispatch: ``lax.scan`` over batch index,
-    failure count and min logical weight accumulated on device.  The
-    batch offset is a traced argument so every chunk of a run (and every
-    run) reuses one compilation."""
-
-    def body(carry, j):
-        k = jax.random.fold_in(key, offset + j)
-        ex, ez, _, _, cx, cz, _, _ = _sample_and_bp(cfg, state, k)
-        fail, min_w = _check(cfg, state, ex, ez, cx, cz)
-        cnt, mw = carry
-        return (cnt + fail.sum(dtype=jnp.int32), jnp.minimum(mw, min_w)), ()
-
-    init = (jnp.zeros((), jnp.int32), jnp.asarray(cfg[1], jnp.int32))
-    (cnt, mw), _ = jax.lax.scan(body, init, jnp.arange(chunk))
-    return cnt, mw
+def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
+    """Megabatch driver for the data-error stats unit, memoized on the
+    hashable program config so a p-sweep (state values change, structure
+    doesn't) reuses one compiled scan per (cfg, k_inner)."""
+    return count_min_driver(
+        "data", cfg, k_inner,
+        lambda key, state: _stats_one_batch(cfg, state, key),
+        min_init=cfg[1])
 
 
 class CodeSimulator_DataError:
     """Same constructor/WordErrorRate surface as the reference class, batched.
 
-    Extra knobs: ``seed`` (base PRNG key) and ``batch_size`` (shots per device
-    dispatch).
+    Extra knobs: ``seed`` (base PRNG key), ``batch_size`` (shots per device
+    dispatch), ``scan_chunk`` (batches per megabatch dispatch) and ``packed``
+    (bit-packed GF(2) planes, default on — bit-exact vs the dense path).
     """
 
     def __init__(self, code=None, decoder_x=None, decoder_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), eval_logical_type="Total",
                  seed: int = 0, batch_size: int = 2048, mesh=None,
-                 fuse_sectors: bool = False, scan_chunk: int = 8):
+                 fuse_sectors: bool = False, scan_chunk: int = 8,
+                 packed: bool = True, fused_sampler: bool = False):
         assert eval_logical_type in ["X", "Z", "Total"]
         self.code = code
         self.decoder_z, self.decoder_x = decoder_z, decoder_x
@@ -137,8 +207,25 @@ class CodeSimulator_DataError:
         self.min_logical_weight = self.N
         self.batch_size = int(batch_size)
         self._scan_chunk = max(1, int(scan_chunk))
+        self._packed = bool(packed)
+        # fused counter-PRNG sampler (ops/gf2_pallas): its own PRNG stream,
+        # so WER is NOT seed-for-seed comparable with the default sampler —
+        # strictly opt-in for throughput work (bench.py BENCH_FUSED=1)
+        self._fused_sampler = bool(fused_sampler)
+        if self._fused_sampler and not self._packed:
+            raise ValueError(
+                "fused_sampler=True runs on the packed substrate; it cannot "
+                "be combined with packed=False (the dense path is the "
+                "seed-compatible reference)")
+        if self._fused_sampler and (decoder_x.needs_host_postprocess
+                                    or decoder_z.needs_host_postprocess):
+            raise ValueError(
+                "fused_sampler=True requires pure-device decoders: the "
+                "host-postprocess (OSD) path re-reads error planes the "
+                "fused pipeline never materializes")
         self._base_key = jax.random.PRNGKey(seed)
         self._mesh = mesh
+        self.last_dispatches = 0  # dispatches of the most recent stats run
 
         # syndromes / residual stabilizer checks as sparse parity gathers
         # (row weight <= ~12 for codes_lib matrices — far cheaper than the
@@ -157,6 +244,9 @@ class CodeSimulator_DataError:
             "probs": jnp.asarray(self.channel_probs, jnp.float32),
             "dx": decoder_x.device_state, "dz": decoder_z.device_state,
         }
+        if self._fused_sampler:
+            self._dev_state["fspec"] = gf2_pallas.build_fused_spec(
+                code.hx, code.hz, code.lx, code.lz, self.channel_probs)
         # Optionally fuse the two sector decodes into one kernel call when
         # both are plain BP with identical settings (bit-identical results,
         # one iteration loop / straggler tail instead of two).  Off by
@@ -175,14 +265,17 @@ class CodeSimulator_DataError:
     # device stages (delegating to the shared value-based pipeline; the
     # legacy fused-pair experiment keeps its per-instance path)
     # ------------------------------------------------------------------
-    def _cfg(self, batch_size: int):
+    def _cfg(self, batch_size: int, packed: bool | None = None):
         return (batch_size, self.N, self.eval_logical_type,
-                self.decoder_x.device_static, self.decoder_z.device_static)
+                self.decoder_x.device_static, self.decoder_z.device_static,
+                self._packed if packed is None else bool(packed),
+                self._fused_sampler)
 
     def _sample_and_bp(self, key, batch_size: int):
         if self._fused is not None:
             return self._sample_and_bp_fused(key, batch_size)
-        return _sample_and_bp_jit(self._cfg(batch_size), self._dev_state, key)
+        return _sample_and_bp_jit(
+            self._cfg(batch_size, packed=False), self._dev_state, key)
 
     @functools.partial(jax.jit, static_argnames=("self", "batch_size"))
     def _sample_and_bp_fused(self, key, batch_size: int):
@@ -194,8 +287,8 @@ class CodeSimulator_DataError:
         return error_x, error_z, synd_x, synd_z, cor_x, cor_z, {}, {}
 
     def _check_failures(self, error_x, error_z, cor_x, cor_z):
-        return _check_jit(self._cfg(error_x.shape[0]), self._dev_state,
-                          error_x, error_z, cor_x, cor_z)
+        return _check_jit(self._cfg(error_x.shape[0], packed=False),
+                          self._dev_state, error_x, error_z, cor_x, cor_z)
 
     # ------------------------------------------------------------------
     def _device_batch_stats(self, key, batch_size: int):
@@ -205,25 +298,24 @@ class CodeSimulator_DataError:
         latency per device->host transfer; per-batch syncs would dominate)."""
         return _batch_stats(self._cfg(batch_size), self._dev_state, key)
 
-    # default batches per compiled scan dispatch (``scan_chunk`` ctor arg):
-    # large enough that the ~40-60ms per-dispatch tunnel overhead is
+    # default batches per compiled megabatch dispatch (``scan_chunk`` ctor
+    # arg): large enough that the ~40-60ms per-dispatch tunnel overhead is
     # amortized, small enough that short sweeps don't overshoot their shot
     # budget by much; throughput-critical callers (bench) raise it so the
     # whole run is one dispatch
     _SCAN_CHUNK = 8
 
     def _device_run_stats(self, key, batch_size: int, n_batches: int):
-        """Run ``n_batches`` batches in fixed-size scan chunks; device scalars
-        accumulate across the (async) chunk dispatches.  Returns device
-        scalars — the caller's materialization is the only host sync."""
+        """Run ``n_batches`` batches through the dispatch-amortized megabatch
+        driver (parallel/shots.py): ``scan_chunk`` batches per compiled
+        dispatch, donated accumulator carry, device-resident scalars.
+        Returns device scalars — the caller's materialization is the only
+        host sync."""
         chunk = min(n_batches, self._scan_chunk)
-        cfg = self._cfg(batch_size)
-        cnt, mw = 0, jnp.asarray(self.N, jnp.int32)
-        for start in range(0, n_batches, chunk):
-            c, w = _chunk_stats(
-                cfg, self._dev_state, key, jnp.asarray(start, jnp.int32), chunk
-            )
-            cnt, mw = cnt + c, jnp.minimum(mw, w)
+        driver = _stats_driver(self._cfg(batch_size), chunk)
+        before = driver.dispatches
+        (cnt, mw), _ = driver.run(key, n_batches, self._dev_state)
+        self.last_dispatches = driver.dispatches - before
         return cnt, mw
 
     def _drain_batch(self, batch_out) -> np.ndarray:
@@ -254,14 +346,28 @@ class CodeSimulator_DataError:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, 1)[0])
 
-    def WordErrorRate(self, num_run: int, key=None):
-        """WER over ``num_run`` shots (src/Simulators.py:170-188 contract)."""
+    def WordErrorRate(self, num_run: int, key=None, target_failures=None):
+        """WER over ``num_run`` shots (src/Simulators.py:170-188 contract).
+
+        ``target_failures`` caps the run adaptively: the megabatch stream is
+        drained double-buffered (``MegabatchDriver.run_keys`` — megabatch
+        d's counts cross the wire while d+1 computes) and the run stops
+        after the first megabatch whose cumulative failure count reaches
+        the target, with the denominator being the shots actually run.
+        Standard Monte-Carlo practice for WER curves: deep points stop on
+        failure count, not on a worst-case shot budget."""
         apply_worker_batch_fence(self)
+        if target_failures is not None and (self._needs_host
+                                            or self._mesh is not None):
+            raise ValueError(
+                "target_failures early stopping requires the pure-device "
+                "single-chip path (no host-postprocess decoders, no mesh)")
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         if self._mesh is not None and not self._needs_host:
             count, total, min_w = mesh_batch_stats(
-                self, ("data", self.batch_size),
+                self, ("data", self.batch_size, self._packed,
+                       self._fused_sampler),
                 lambda k: self._device_batch_stats(k, self.batch_size),
                 num_run, key,
             )
@@ -269,10 +375,23 @@ class CodeSimulator_DataError:
             return wer_single_shot(count, total, self.K)
         batcher = ShotBatcher(num_run, self.batch_size)
         if not self._needs_host:
-            # scan-chunked dispatches, one host sync; chunks run whole, so
+            # megabatch dispatches, one host sync; megabatches run whole, so
             # the denominator rounds up to the chunk multiple actually run
             chunk = min(batcher.num_batches, self._scan_chunk)
             n_batches = -(-batcher.num_batches // chunk) * chunk
+            if target_failures is not None:
+                driver = _stats_driver(self._cfg(self.batch_size), chunk)
+                before = driver.dispatches
+                cnt, mw, done = 0, self.N, 0
+                for (cnt, mw), done in driver.run_keys(
+                        key, n_batches, self._dev_state):
+                    if int(cnt) >= int(target_failures):
+                        break
+                self.last_dispatches = driver.dispatches - before
+                self.min_logical_weight = min(
+                    self.min_logical_weight, int(mw))
+                return wer_single_shot(
+                    int(cnt), done * self.batch_size, self.K)
             total, min_w = self._device_run_stats(
                 key, self.batch_size, n_batches
             )

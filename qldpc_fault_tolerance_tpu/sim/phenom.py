@@ -11,6 +11,13 @@ final decode runs outside the scan so a BPOSD decoder 2 can apply its host
 OSD stage to the minority of BP failures.  Decoder 1 must be pure device code
 (BP / FirstMin — the notebook configurations) for the scan path; a per-round
 host fallback covers host-postprocess decoders.
+
+Bit-packed execution (default): the per-round syndrome SpMVs against the
+extended [H | I] matrices and the final-round / residual-check products run
+on 32-shots-per-uint32 lane words (ops/gf2_packed) — an XOR gather over the
+sparse adjacency instead of a dense f32 matmul — with pack/unpack shims at
+the BP boundary.  Bit-exact vs the dense path (same draws, exact GF(2)), so
+WER is seed-for-seed identical.
 """
 from __future__ import annotations
 
@@ -22,12 +29,18 @@ import numpy as np
 
 from ..decoders.bp_decoders import decode_device
 from ..noise import bit_flips, depolarizing_xz
-from ..ops.linalg import gf2_matmul
+from ..ops.linalg import ParityOp, gf2_matmul
+from ..ops.gf2_packed import (
+    pack_shots,
+    packed_parity_apply,
+    packed_residual_stats,
+    unpack_shots,
+)
+from ..parallel.shots import MegabatchDriver, count_min_driver
 from .common import (
     apply_worker_batch_fence,
     fence_batch_value,
     ShotBatcher,
-    accumulate_device,
     mesh_batch_stats,
     wer_per_cycle,
     wer_single_shot,
@@ -45,7 +58,7 @@ __all__ = ["CodeSimulator_Phenon"]
 # ``cfg`` is the hashable program config; every array rides in the
 # ``state`` pytree.
 # cfg = (batch_size, N, eval_logical_type,
-#        d1x_static, d1z_static, d2x_static, d2z_static)
+#        d1x_static, d1z_static, d2x_static, d2z_static, packed)
 def _sample_ext(cfg, state, key, batch_size):
     """One round of extended errors (src/Simulators.py:215-255)."""
     n = cfg[1]
@@ -60,6 +73,37 @@ def _sample_ext(cfg, state, key, batch_size):
     return ex_ext, ez_ext
 
 
+def _ext_syndromes(cfg, state, cur_x, cur_z):
+    """Extended-matrix syndromes, packed (XOR gather on lane words) or dense
+    per cfg[7]; both produce identical (B, m) uint8 planes for BP."""
+    if cfg[7]:
+        b = cur_x.shape[0]
+        synd_z = unpack_shots(packed_parity_apply(
+            state["hx_ext_par"][0], state["hx_ext_par"][1],
+            pack_shots(cur_z)), b)
+        synd_x = unpack_shots(packed_parity_apply(
+            state["hz_ext_par"][0], state["hz_ext_par"][1],
+            pack_shots(cur_x)), b)
+        return synd_x, synd_z
+    synd_z = gf2_matmul(cur_z, state["hx_ext_t"])
+    synd_x = gf2_matmul(cur_x, state["hz_ext_t"])
+    return synd_x, synd_z
+
+
+def _bare_syndromes(cfg, state, cur_x, cur_z):
+    """Bare-H final-round syndromes, packed or dense per cfg[7]."""
+    if cfg[7]:
+        b = cur_x.shape[0]
+        synd_z = unpack_shots(packed_parity_apply(
+            state["hx_par"][0], state["hx_par"][1], pack_shots(cur_z)), b)
+        synd_x = unpack_shots(packed_parity_apply(
+            state["hz_par"][0], state["hz_par"][1], pack_shots(cur_x)), b)
+        return synd_x, synd_z
+    synd_z = gf2_matmul(cur_z, state["hx_t"])
+    synd_x = gf2_matmul(cur_x, state["hz_t"])
+    return synd_x, synd_z
+
+
 def _round_step(cfg, state, carry, key, batch_size):
     """One noisy QEC round (src/Simulators.py:265-281): only the data part
     of the previous residual carries over; syndrome coords are fresh."""
@@ -68,8 +112,7 @@ def _round_step(cfg, state, carry, key, batch_size):
     ex_ext, ez_ext = _sample_ext(cfg, state, key, batch_size)
     cur_x = ex_ext.at[:, :n].set(ex_ext[:, :n] ^ data_x)
     cur_z = ez_ext.at[:, :n].set(ez_ext[:, :n] ^ data_z)
-    synd_z = gf2_matmul(cur_z, state["hx_ext_t"])
-    synd_x = gf2_matmul(cur_x, state["hz_ext_t"])
+    synd_x, synd_z = _ext_syndromes(cfg, state, cur_x, cur_z)
     dz, _ = decode_device(cfg[4], state["d1z"], synd_z)
     dx, _ = decode_device(cfg[3], state["d1x"], synd_x)
     cur_x = cur_x ^ dx
@@ -102,8 +145,7 @@ def _final_round(cfg, state, key, data_x, data_z):
     ex_ext, ez_ext = _sample_ext(cfg, state, key, batch_size)
     cur_x = data_x ^ ex_ext[:, :n]
     cur_z = data_z ^ ez_ext[:, :n]
-    synd_z = gf2_matmul(cur_z, state["hx_t"])
-    synd_x = gf2_matmul(cur_x, state["hz_t"])
+    synd_x, synd_z = _bare_syndromes(cfg, state, cur_x, cur_z)
     dz, az = decode_device(cfg[6], state["d2z"], synd_z)
     dx, ax = decode_device(cfg[5], state["d2x"], synd_x)
     return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
@@ -123,14 +165,29 @@ def _check(cfg, state, cur_x, cur_z, dec_x, dec_z):
     z_log = gf2_matmul(residual_z, state["lx_t"]).any(axis=-1)
     x_fail = x_stab | x_log
     z_fail = z_stab | z_log
-    wx = jnp.where(x_log, residual_x.sum(axis=-1), n)
-    wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1), n)
+    wx = jnp.where(x_log, residual_x.sum(axis=-1, dtype=jnp.int32), n)
+    wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1, dtype=jnp.int32), n)
     min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
     if eval_type == "X":
         return x_fail, min_w
     if eval_type == "Z":
         return z_fail, min_w
     return x_fail | z_fail, min_w
+
+
+def _check_stats(cfg, state, cur_x, cur_z, dec_x, dec_z):
+    """(failure count, min weight) scalars; packed lane words when cfg[7]
+    (same bits as ``_check`` + ``.sum()``, counted by masked popcount)."""
+    if not cfg[7]:
+        fail, min_w = _check(cfg, state, cur_x, cur_z, dec_x, dec_z)
+        return fail.sum(dtype=jnp.int32), min_w
+    b, n, eval_type = cur_x.shape[0], cfg[1], cfg[2]
+    res_x = pack_shots(cur_x ^ dec_x)
+    res_z = pack_shots(cur_z ^ dec_z)
+    return packed_residual_stats(
+        res_x, res_z, state["hz_par"], state["hx_par"],
+        state["lz_t"], state["lx_t"], eval_type, b, n,
+        z_weight_excludes_stab=True)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -142,8 +199,21 @@ def _batch_stats(cfg, state, key, num_rounds):
     cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
         cfg, state, k_final, data_x, data_z
     )
-    fail, min_w = _check(cfg, state, cur_x, cur_z, dx, dz)
-    return fail.sum(dtype=jnp.int32), min_w
+    return _check_stats(cfg, state, cur_x, cur_z, dx, dz)
+
+
+def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
+    """Dispatch-amortized megabatch driver for the phenom stats unit, shared
+    across same-shape simulator instances (p- and cycle-sweeps compile
+    once); ``num_rounds`` rides through as a traced extra."""
+    def stats(key, state, num_rounds):
+        k_rounds, k_final = jax.random.split(key)
+        data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
+        cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
+            cfg, state, k_final, data_x, data_z)
+        return _check_stats(cfg, state, cur_x, cur_z, dx, dz)
+
+    return count_min_driver("phenl", cfg, k_inner, stats, min_init=cfg[1])
 
 
 class CodeSimulator_Phenon:
@@ -153,7 +223,8 @@ class CodeSimulator_Phenon:
                  decoder2_x=None, decoder2_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), q=0,
                  eval_logical_type="Total", seed: int = 0,
-                 batch_size: int = 1024, mesh=None):
+                 batch_size: int = 1024, mesh=None, scan_chunk: int = 4,
+                 packed: bool = True):
         assert eval_logical_type in ["X", "Z", "Total"]
         self.code = code
         self.hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
@@ -167,8 +238,11 @@ class CodeSimulator_Phenon:
         self.eval_logical_type = eval_logical_type
         self.min_logical_weight = self.N
         self.batch_size = int(batch_size)
+        self._scan_chunk = max(1, int(scan_chunk))
+        self._packed = bool(packed)
         self._base_key = jax.random.PRNGKey(seed)
         self._mesh = mesh
+        self.last_dispatches = 0
 
         self._mx = code.hx.shape[0]
         self._mz = code.hz.shape[0]
@@ -178,6 +252,12 @@ class CodeSimulator_Phenon:
         self._hz_t = jnp.asarray(code.hz.T)
         self._lx_t = jnp.asarray(code.lx.T)
         self._lz_t = jnp.asarray(code.lz.T)
+        # sparse adjacency for the packed XOR-gather SpMVs ([H | I] row
+        # weight is rw(H) + 1; bare H for final round + residual checks)
+        hx_ext_par = ParityOp(self.hx_ext)
+        hz_ext_par = ParityOp(self.hz_ext)
+        hx_par = ParityOp(code.hx)
+        hz_par = ParityOp(code.hz)
         self._dec1_on_device = not (
             decoder1_x.needs_host_postprocess or decoder1_z.needs_host_postprocess
         )
@@ -185,16 +265,21 @@ class CodeSimulator_Phenon:
             "hx_ext_t": self._hx_ext_t, "hz_ext_t": self._hz_ext_t,
             "hx_t": self._hx_t, "hz_t": self._hz_t,
             "lx_t": self._lx_t, "lz_t": self._lz_t,
+            "hx_ext_par": (hx_ext_par.nbr, hx_ext_par.mask),
+            "hz_ext_par": (hz_ext_par.nbr, hz_ext_par.mask),
+            "hx_par": (hx_par.nbr, hx_par.mask),
+            "hz_par": (hz_par.nbr, hz_par.mask),
             "probs": jnp.asarray(self.channel_probs, jnp.float32),
             "q": jnp.float32(self.synd_prob),
             "d1x": decoder1_x.device_state, "d1z": decoder1_z.device_state,
             "d2x": decoder2_x.device_state, "d2z": decoder2_z.device_state,
         }
 
-    def _cfg(self, batch_size: int):
+    def _cfg(self, batch_size: int, packed: bool | None = None):
         return (batch_size, self.N, self.eval_logical_type,
                 self.decoder1_x.device_static, self.decoder1_z.device_static,
-                self.decoder2_x.device_static, self.decoder2_z.device_static)
+                self.decoder2_x.device_static, self.decoder2_z.device_static,
+                self._packed if packed is None else bool(packed))
 
     # ------------------------------------------------------------------
     def _sample_ext(self, key, batch_size):
@@ -284,8 +369,7 @@ class CodeSimulator_Phenon:
         data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
         cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
             cfg, state, k_final, data_x, data_z)
-        fail, min_w = _check(cfg, state, cur_x, cur_z, dx, dz)
-        return fail.sum(dtype=jnp.int32), min_w
+        return _check_stats(cfg, state, cur_x, cur_z, dx, dz)
 
     def _count_failures(self, num_rounds, num_samples, key=None):
         apply_worker_batch_fence(self)
@@ -296,22 +380,29 @@ class CodeSimulator_Phenon:
         if self._dec1_on_device and not dec2_host:
             if self._mesh is not None:
                 count, total, min_w = mesh_batch_stats(
-                    self, ("phenl", num_rounds, self.batch_size),
+                    self, ("phenl", num_rounds, self.batch_size, self._packed),
                     lambda k: self._device_batch_stats(
                         k, num_rounds, self.batch_size),
                     num_samples, key,
                 )
                 self.min_logical_weight = min(self.min_logical_weight, min_w)
                 return count, total
+            # dispatch-amortized megabatch driver: scan_chunk batches per
+            # compiled dispatch, donated carry, one host sync at the end.
+            # The chunk clamps to the batch count so small sweeps neither
+            # overshoot their shot budget nor change their shot stream.
             batcher = ShotBatcher(num_samples, self.batch_size)
-            keys = [jax.random.fold_in(key, i) for i in batcher]
-            stats = accumulate_device(
-                lambda k: self._device_batch_stats(k, num_rounds, self.batch_size),
-                keys,
-                lambda a, b: (a[0] + b[0], jnp.minimum(a[1], b[1])),
-            )
-            self.min_logical_weight = min(self.min_logical_weight, int(stats[1]))
-            return int(stats[0]), batcher.total
+            chunk = min(batcher.num_batches, self._scan_chunk)
+            n_batches = -(-batcher.num_batches // chunk) * chunk
+            driver = _stats_driver(self._cfg(self.batch_size), chunk)
+            before = driver.dispatches
+            (cnt, mw), _ = driver.run(
+                key, n_batches, self._dev_state,
+                jnp.asarray(num_rounds, jnp.int32))
+            self.last_dispatches = driver.dispatches - before
+            cnt, mw = jax.device_get((cnt, mw))  # one host round-trip
+            self.min_logical_weight = min(self.min_logical_weight, int(mw))
+            return int(cnt), n_batches * self.batch_size
         batcher = ShotBatcher(num_samples, self.batch_size)
         keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(

@@ -32,8 +32,14 @@ import parity  # noqa: E402
 
 
 def main():
-    assert jax.default_backend() != "axon", (
-        "run me with JAX_PLATFORMS=cpu — the point is the non-worker backend")
+    # The proof is only a proof on the CPU backend.  The old guard
+    # (``backend != 'axon'``) passed on the tunneled worker — which reports
+    # 'tpu' — so a committed FENCE_PROOF.json could claim "runs fine off the
+    # worker" while having run ON it (that artifact shipped mislabeled with
+    # backend 'tpu' through round 5; regenerated on CPU this round).
+    assert jax.default_backend() == "cpu", (
+        f"run me with JAX_PLATFORMS=cpu (got backend "
+        f"{jax.default_backend()!r}) — the point is the non-worker backend")
     out = {"backend": jax.default_backend(), "results": {}}
 
     # ---- config A: BP+OSD at batch 8192 (worker crashes at >= 4096)

@@ -107,7 +107,12 @@ def hgp_codes(tags=("n225", "n625", "n1600")):
     return [load_code(os.path.join(lib, f"hgp_34_{t}.npz")) for t in tags]
 
 
-REF_CODES_LIB = "/root/reference/codes_lib"
+# Root of the reference .mat code matrices (LP / GBC families).  Overridable
+# because the mount point is deployment-specific — CI images and laptops
+# don't have /root/reference; point QLDPC_REF_CODES_LIB at a checkout of the
+# reference repo's codes_lib to run those parity families.
+REF_CODES_LIB = os.environ.get("QLDPC_REF_CODES_LIB",
+                               "/root/reference/codes_lib")
 
 
 def lp_codes():

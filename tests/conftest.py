@@ -20,4 +20,5 @@ assert force_virtual_cpu(8), (
     "run degenerate; check JAX private-API drift in utils/backend.py"
 )
 
-REFERENCE_CODES_LIB = "/root/reference/codes_lib"
+REFERENCE_CODES_LIB = os.environ.get("QLDPC_REF_CODES_LIB",
+                                     "/root/reference/codes_lib")

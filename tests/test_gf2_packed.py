@@ -1,0 +1,169 @@
+"""Bit-packed GF(2) layer (ops/gf2_packed) vs the dense uint8 reference.
+
+Every packed op must be BIT-EXACT against the dense path — packing is a
+layout change, not an approximation — including on ragged
+(non-multiple-of-32) batches where the padding lanes must never leak into
+results.  The WER test at the bottom is the end-to-end guarantee: the
+packed pipeline is seed-for-seed identical to the dense one on a real
+codes_lib code.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.codes.gf2 import pack_bitplane, unpack_bitplane
+from qldpc_fault_tolerance_tpu.noise import (
+    bit_flips,
+    bit_flips_packed,
+    depolarizing_xz,
+    depolarizing_xz_packed,
+)
+from qldpc_fault_tolerance_tpu.ops.gf2_packed import (
+    lane_mask,
+    num_words,
+    pack_shots,
+    packed_any,
+    packed_count,
+    packed_gf2_matmul,
+    packed_parity_apply,
+    packed_per_shot_weight,
+    unpack_shots,
+)
+from qldpc_fault_tolerance_tpu.ops.linalg import ParityOp, gf2_matmul
+
+RAGGED_BATCHES = [1, 31, 32, 33, 100, 256]
+
+
+def _rand_bits(rng, b, n):
+    return (rng.random((b, n)) < 0.3).astype(np.uint8)
+
+
+@pytest.mark.parametrize("b", RAGGED_BATCHES)
+def test_pack_unpack_roundtrip(b):
+    rng = np.random.default_rng(b)
+    bits = _rand_bits(rng, b, 17)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_shots(pack_shots(bits), b)), bits)
+    # host reference (codes/gf2.py) pins the same layout with numpy only
+    np.testing.assert_array_equal(
+        np.asarray(pack_shots(bits)), pack_bitplane(bits))
+    np.testing.assert_array_equal(unpack_bitplane(pack_bitplane(bits), b), bits)
+
+
+def test_lane_layout_lsb_first():
+    # shot 32*w + j lands in bit j of word w
+    bits = np.zeros((70, 1), np.uint8)
+    bits[0] = bits[33] = bits[69] = 1
+    packed = np.asarray(pack_shots(bits))
+    assert packed.shape == (3, 1)
+    assert packed[0, 0] == 1            # shot 0 -> word 0 bit 0
+    assert packed[1, 0] == 1 << 1       # shot 33 -> word 1 bit 1
+    assert packed[2, 0] == 1 << 5       # shot 69 -> word 2 bit 5
+
+
+@pytest.mark.parametrize("b", RAGGED_BATCHES)
+def test_packed_parity_apply_matches_dense(b):
+    rng = np.random.default_rng(100 + b)
+    n, m = 37, 23
+    h = (rng.random((m, n)) < 0.15).astype(np.uint8)
+    h[:, 0] = 1  # no empty rows/cols edge weirdness
+    par = ParityOp(h)
+    bits = _rand_bits(rng, b, n)
+    dense = np.asarray(par(jnp.asarray(bits)))
+    packed = packed_parity_apply(par.nbr, par.mask, pack_shots(bits))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_shots(packed, b)), dense)
+
+
+@pytest.mark.parametrize("b", RAGGED_BATCHES)
+def test_packed_gf2_matmul_matches_dense(b):
+    rng = np.random.default_rng(200 + b)
+    n, k = 29, 5
+    h_t = (rng.random((n, k)) < 0.4).astype(np.uint8)
+    bits = _rand_bits(rng, b, n)
+    dense = np.asarray(gf2_matmul(jnp.asarray(bits), jnp.asarray(h_t)))
+    packed = packed_gf2_matmul(pack_shots(bits), jnp.asarray(h_t))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_shots(packed, b)), dense)
+
+
+@pytest.mark.parametrize("b", RAGGED_BATCHES)
+def test_packed_reductions_mask_ragged_padding(b):
+    rng = np.random.default_rng(300 + b)
+    n = 11
+    bits = _rand_bits(rng, b, n)
+    packed = pack_shots(bits)
+    flags = packed_any(packed)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_shots(flags, b)), bits.any(axis=1).astype(np.uint8))
+    # count masks the padding lanes even if they were (artificially) set
+    poisoned = jnp.asarray(np.asarray(flags) | ~np.asarray(lane_mask(b)))
+    assert int(packed_count(poisoned, b)) == int(bits.any(axis=1).sum())
+    np.testing.assert_array_equal(
+        np.asarray(packed_per_shot_weight(packed, b)),
+        bits.sum(axis=1).astype(np.int32))
+    assert num_words(b) == -(-b // 32)
+
+
+@pytest.mark.parametrize("b", [32, 100, 512])
+def test_packed_samplers_bit_exact(b):
+    key = jax.random.PRNGKey(b)
+    probs = (0.01, 0.005, 0.02)
+    ex, ez = depolarizing_xz(key, (b, 40), probs)
+    exp, ezp = depolarizing_xz_packed(key, (b, 40), probs)
+    np.testing.assert_array_equal(np.asarray(unpack_shots(exp, b)),
+                                  np.asarray(ex))
+    np.testing.assert_array_equal(np.asarray(unpack_shots(ezp, b)),
+                                  np.asarray(ez))
+    flips = bit_flips(key, (b, 15), 0.1)
+    flips_p = bit_flips_packed(key, (b, 15), 0.1)
+    np.testing.assert_array_equal(np.asarray(unpack_shots(flips_p, b)),
+                                  np.asarray(flips))
+
+
+def _wer(code, packed, batch_size, shots, key):
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+
+    p = 0.05
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=20)  # noqa: E731
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=batch_size, seed=0,
+        scan_chunk=2, packed=packed,
+    )
+    wer, eb = sim.WordErrorRate(shots, key=key)
+    return wer, eb, sim.min_logical_weight
+
+
+def test_wer_seed_for_seed_packed_equals_dense_hgp225():
+    """End-to-end: the packed pipeline on hgp_34_n225 is bit-identical to
+    the dense uint8 pipeline — same failure count, error bar and min
+    logical weight for the same key."""
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    npz = os.path.join(here, "codes_lib_tpu", "hgp_34_n225.npz")
+    if os.path.exists(npz):
+        from qldpc_fault_tolerance_tpu.codes import load_code
+
+        code = load_code(npz)
+    else:  # regenerated lib missing: equivalent structural stand-in
+        code = hgp(rep_code(8), rep_code(8))
+    key = jax.random.PRNGKey(42)
+    got_p = _wer(code, True, 512, 1024, key)
+    got_d = _wer(code, False, 512, 1024, key)
+    assert got_p == got_d, (got_p, got_d)
+
+
+def test_wer_seed_for_seed_packed_equals_dense_ragged_batch():
+    """Ragged batch (not a multiple of 32): padding lanes must not alter
+    counts."""
+    code = hgp(rep_code(5), rep_code(5))
+    key = jax.random.PRNGKey(7)
+    got_p = _wer(code, True, 100, 300, key)
+    got_d = _wer(code, False, 100, 300, key)
+    assert got_p == got_d, (got_p, got_d)

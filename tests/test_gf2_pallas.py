@@ -1,0 +1,142 @@
+"""Fused sample→syndrome→check kernels (ops/gf2_pallas).
+
+The Pallas kernels run in interpreter mode here (CPU suite; the Mosaic path
+is exercised on TPU by bench.py BENCH_FUSED=1), and must be bit-exact
+word-for-word against their XLA twins — same counters, same Threefry, same
+GF(2) algebra.  The twin itself is validated against jax's reference
+Threefry cipher and the dense pipeline.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.ops import gf2_pallas as gp
+from qldpc_fault_tolerance_tpu.ops.gf2_packed import pack_shots, unpack_shots
+from qldpc_fault_tolerance_tpu.ops.linalg import gf2_matmul
+
+
+@pytest.fixture(scope="module")
+def spec():
+    code = hgp(rep_code(4), rep_code(5))
+    return code, gp.build_fused_spec(code.hx, code.hz, code.lx, code.lz,
+                                     (0.012, 0.008, 0.02))
+
+
+def test_threefry_matches_jax_reference_cipher():
+    try:
+        from jax._src.prng import threefry_2x32 as ref
+    except ImportError:
+        pytest.skip("jax internal threefry not importable")
+    k = jnp.array([0xDEADBEEF, 0x12345678], dtype=jnp.uint32)
+    c = jnp.arange(64, dtype=jnp.uint32)
+    ours = np.stack([np.asarray(a) for a in
+                     gp.threefry2x32(k[0], k[1], c[:32], c[32:])])
+    theirs = np.asarray(ref(k, c)).reshape(2, 32)
+    np.testing.assert_array_equal(ours, theirs)
+
+
+def test_sample_syndrome_kernel_bit_exact_vs_twin(spec):
+    code, fspec = spec
+    key = jax.random.PRNGKey(11)
+    b = 512  # 16 lane words = 2 blocks of block_w=8
+    ref = gp.sample_syndrome(fspec, key, b, backend="xla")
+    ker = gp.sample_syndrome(fspec, key, b, backend="pallas", interpret=True)
+    assert len(ref) == len(ker) == 4
+    for r, k_ in zip(ref, ker):
+        np.testing.assert_array_equal(np.asarray(r), np.asarray(k_))
+    # syndromes-only variant returns the same syndrome words
+    sx, sz = gp.sample_syndrome(fspec, key, b, backend="pallas",
+                                interpret=True, emit_errors=False)
+    np.testing.assert_array_equal(np.asarray(sx), np.asarray(ref[2]))
+    np.testing.assert_array_equal(np.asarray(sz), np.asarray(ref[3]))
+
+
+def test_sampled_syndromes_consistent_with_dense_algebra(spec):
+    code, fspec = spec
+    key = jax.random.PRNGKey(3)
+    b = 256
+    exp, ezp, sxp, szp = gp.sample_syndrome(fspec, key, b, backend="xla")
+    ex = np.asarray(unpack_shots(exp, b))
+    ez = np.asarray(unpack_shots(ezp, b))
+    np.testing.assert_array_equal(
+        np.asarray(unpack_shots(szp, b)), ez @ code.hx.T % 2)
+    np.testing.assert_array_equal(
+        np.asarray(unpack_shots(sxp, b)), ex @ code.hz.T % 2)
+    # marginal sanity: X-flip rate ~ px + py
+    assert abs(ex.mean() - 0.02) < 0.005
+
+
+@pytest.mark.parametrize("eval_type", ["X", "Z", "Total"])
+def test_residual_check_kernel_bit_exact_vs_twin(spec, eval_type):
+    code, fspec = spec
+    key = jax.random.PRNGKey(29)
+    b = 256
+    rng = np.random.default_rng(5)
+    corx = pack_shots((rng.random((b, code.N)) < 0.02).astype(np.uint8))
+    corz = pack_shots((rng.random((b, code.N)) < 0.02).astype(np.uint8))
+    ref = gp.residual_check_stats(fspec, key, b, corx, corz, eval_type,
+                                  backend="xla")
+    ker = gp.residual_check_stats(fspec, key, b, corx, corz, eval_type,
+                                  backend="pallas", interpret=True)
+    assert int(ref[0]) == int(ker[0])
+    assert int(ref[1]) == int(ker[1])
+
+
+def test_residual_check_matches_dense_reference(spec):
+    """The twin's scalars equal a from-scratch dense computation of the
+    stabilizer/logical checks on the regenerated error."""
+    code, fspec = spec
+    key = jax.random.PRNGKey(8)
+    b = 96
+    k0, k1 = gp._key_words(key)
+    r = gp.counter_draws(k0, k1, b, code.N)
+    ex, ez = gp._errors_from_draws(r, fspec.cuts)
+    ex, ez = np.asarray(ex, np.uint8), np.asarray(ez, np.uint8)
+    rng = np.random.default_rng(9)
+    cx = (rng.random((b, code.N)) < 0.02).astype(np.uint8)
+    cz = (rng.random((b, code.N)) < 0.02).astype(np.uint8)
+    res_x, res_z = ex ^ cx, ez ^ cz
+    x_fail = ((res_x @ code.hz.T % 2).any(1)) | ((res_x @ code.lz.T % 2).any(1))
+    z_fail = ((res_z @ code.hx.T % 2).any(1)) | ((res_z @ code.lx.T % 2).any(1))
+    want = int((x_fail | z_fail).sum())
+    cnt, _ = gp.residual_check_stats(
+        fspec, key, b, pack_shots(cx), pack_shots(cz), "Total", backend="xla")
+    assert int(cnt) == want
+
+
+def test_fused_sim_stats_backends_agree(spec):
+    """The full fused stats batch (sample → BP → regenerate-and-check)
+    produces identical scalars whether the kernels run as XLA twins or as
+    interpreted Pallas."""
+    code, _ = spec
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim import data_error as de
+
+    p = 0.03
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=8)  # noqa: E731
+    sim = de.CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=256, seed=1,
+        fused_sampler=True,
+    )
+    key = jax.random.PRNGKey(77)
+    cfg = sim._cfg(256)
+    cnt_xla, mw_xla = de._stats_fused(cfg, sim._dev_state, key)
+    # force the pallas-interpret route through the public dispatchers
+    spec_ = sim._dev_state["fspec"]
+    sxp, szp = gp.sample_syndrome(spec_, key, 256, backend="pallas",
+                                  interpret=True, emit_errors=False)
+    from qldpc_fault_tolerance_tpu.decoders.bp_decoders import decode_device
+
+    cor_z, _ = decode_device(cfg[4], sim._dev_state["dz"],
+                             unpack_shots(szp, 256))
+    cor_x, _ = decode_device(cfg[3], sim._dev_state["dx"],
+                             unpack_shots(sxp, 256))
+    cnt_pl, mw_pl = gp.residual_check_stats(
+        spec_, key, 256, pack_shots(cor_x), pack_shots(cor_z), cfg[2],
+        backend="pallas", interpret=True)
+    assert int(cnt_xla) == int(cnt_pl)
+    assert int(mw_xla) == int(mw_pl)

@@ -1,0 +1,130 @@
+"""Dispatch-amortized megabatch driver (parallel/shots.py) — the tier-1
+smoke of the packed megabatch path: one compiled scan per ``k_inner``
+batches, dispatch accounting the bench relies on, and result equality with
+the naive one-dispatch-per-batch loop.
+"""
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.parallel import (
+    MegabatchDriver,
+    drain_double_buffered,
+)
+
+
+def _counting_driver(k_inner):
+    calls = []
+
+    def stats(key, bias):
+        calls.append(1)
+        draw = jax.random.randint(key, (), 0, 100, jnp.int32)
+        return draw + bias, -draw
+
+    driver = MegabatchDriver(
+        stats,
+        lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1])),
+        lambda: (jnp.zeros((), jnp.int32), jnp.asarray(10 ** 6, jnp.int32)),
+        k_inner=k_inner,
+    )
+    return driver, calls
+
+
+def test_driver_matches_naive_loop_and_counts_dispatches():
+    key = jax.random.PRNGKey(0)
+    bias = jnp.asarray(3, jnp.int32)
+    driver, _ = _counting_driver(k_inner=4)
+    (total, mn), n_run = driver.run(key, 8, bias)
+    assert n_run == 8 and driver.dispatches == 2
+    # naive reference: same fold_in stream, one "dispatch" per batch
+    want_t, want_m = 0, 10 ** 6
+    for j in range(8):
+        d = jax.random.randint(jax.random.fold_in(key, j), (), 0, 100,
+                               jnp.int32)
+        want_t, want_m = want_t + int(d) + 3, min(want_m, -int(d))
+    assert int(total) == want_t and int(mn) == want_m
+
+
+def test_driver_rounds_up_to_k_inner_multiple():
+    driver, _ = _counting_driver(k_inner=4)
+    (_, _), n_run = driver.run(jax.random.PRNGKey(1), 5, jnp.int32(0))
+    assert n_run == 8 and driver.dispatches == 2
+
+
+def test_run_keys_streams_every_megabatch():
+    key = jax.random.PRNGKey(2)
+    driver, _ = _counting_driver(k_inner=2)
+    snaps = list(driver.run_keys(key, 6, jnp.int32(0)))
+    assert [done for _, done in snaps] == [2, 4, 6]
+    # monotone accumulation; final snapshot equals a fresh full run
+    totals = [int(c[0]) for c, _ in snaps]
+    assert totals == sorted(totals)
+    driver2, _ = _counting_driver(k_inner=2)
+    (total, _), _ = driver2.run(key, 6, jnp.int32(0))
+    assert totals[-1] == int(total)
+
+
+def test_drain_double_buffered_preserves_order():
+    launched, finished = [], []
+    out = list(drain_double_buffered(
+        lambda i: (launched.append(i), i)[1],
+        lambda i: (finished.append(i), i * 10)[1],
+        range(5), depth=2,
+    ))
+    assert out == [0, 10, 20, 30, 40]
+    assert launched == list(range(5)) and finished == list(range(5))
+
+
+def _tiny_sim(batch_size=64, scan_chunk=2, **kw):
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+
+    code = hgp(rep_code(3), rep_code(3))
+    p = kw.pop("p", 0.02)
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=6)  # noqa: E731
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=batch_size, seed=0,
+        scan_chunk=scan_chunk, packed=True, **kw)
+
+
+def test_target_failures_early_stop():
+    """WordErrorRate(target_failures=...) drains megabatch counts
+    double-buffered and stops once the cumulative count reaches the
+    target — fewer dispatches than the full budget, and the WER uses the
+    shots actually run as its denominator."""
+    import pytest
+
+    sim = _tiny_sim(p=0.2)  # high p so failures arrive in the first chunk
+    wer, _ = sim.WordErrorRate(64 * 16, key=jax.random.PRNGKey(3),
+                               target_failures=1)
+    assert 0.0 < wer <= 1.0
+    assert sim.last_dispatches < 8  # stopped before the 16-batch budget
+    # unsupported on the host-postprocess/mesh paths: loud, not silent
+    sim2 = _tiny_sim()
+    sim2._needs_host = True
+    with pytest.raises(ValueError, match="target_failures"):
+        sim2.WordErrorRate(128, key=jax.random.PRNGKey(0), target_failures=1)
+
+
+def test_packed_megabatch_smoke_cpu():
+    """One packed megabatch through the real data-error engine on CPU —
+    the driver path the bench uses, kept tiny so tier-1 always exercises
+    it."""
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+
+    code = hgp(rep_code(3), rep_code(3))
+    p = 0.02
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=6)  # noqa: E731
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=64, seed=0,
+        scan_chunk=2, packed=True,
+    )
+    wer, eb = sim.WordErrorRate(256, key=jax.random.PRNGKey(5))
+    assert 0.0 <= wer <= 1.0 and eb >= 0.0
+    assert sim.last_dispatches == 2  # 4 batches / k_inner 2

@@ -1,12 +1,15 @@
 """Tunneled-worker crash fence (README "Known frontiers").
 
-The axon worker deterministically crashes OSD-bearing decode programs at
-batch >= 4096, and hgp_34_n1600 phenomenological cells (environment
-regression since round 2).  The fence clamps the batch into the measured
-safe envelope ON THE AXON BACKEND ONLY; these tests prove (a) the clamp
-logic itself, and (b) that the same configs run CORRECTLY at full batch on
-the CPU mesh — i.e. the crash is a worker property, not a framework limit
-(scripts/fence_proof.py runs the heavyweight full-shape versions).
+The axon-tunneled worker deterministically crashes OSD-bearing decode
+programs at batch >= 4096 (environment regression since round 2).  The
+fence clamps the batch into the measured safe envelope ON THE TUNNELED
+WORKER ONLY.  Crucially, that worker REPORTS ``jax.default_backend() ==
+'tpu'`` — not 'axon' (ADVICE round-5 high: a fence gated on the literal
+backend name 'axon' is inert in production).  These tests therefore drive
+the fence through the backend string it actually sees in production
+('tpu' + the axon-tunnel signal); a fence regressed to ``backend ==
+'axon'`` gating FAILS them.  scripts/fence_proof.py runs the heavyweight
+full-shape CPU counter-proof.
 """
 import warnings
 
@@ -20,6 +23,7 @@ from qldpc_fault_tolerance_tpu.sim import CodeSimulator_DataError
 from qldpc_fault_tolerance_tpu.sim.common import (
     WORKER_OSD_BATCH_SAFE,
     apply_worker_batch_fence,
+    on_tunneled_worker,
 )
 
 
@@ -34,15 +38,63 @@ def _bposd_sim(batch_size):
     )
 
 
-def test_fence_clamps_osd_batch_on_axon(monkeypatch):
+def _as_tunneled_worker(monkeypatch):
+    """Impersonate the production worker: backend name 'tpu' (what the
+    tunnel actually reports) plus the AXON env marker tunnel signal."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setenv("AXON_WORKER", "1")
+
+
+def test_fence_clamps_osd_batch_on_tunneled_tpu_worker(monkeypatch):
+    """THE regression test for the inert-fence bug: the worker reports
+    'tpu', so a fence that only fires on backend 'axon' never fires in
+    production — this test fails against such a fence."""
     sim = _bposd_sim(8192)
-    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    _as_tunneled_worker(monkeypatch)
+    assert on_tunneled_worker()
     with pytest.warns(UserWarning, match="worker fence"):
         apply_worker_batch_fence(sim)
     assert sim.batch_size == WORKER_OSD_BATCH_SAFE
     # idempotent: a second call neither warns nor re-clamps
     with warnings.catch_warnings():
         warnings.simplefilter("error")
+        apply_worker_batch_fence(sim)
+    assert sim.batch_size == WORKER_OSD_BATCH_SAFE
+
+
+def test_fence_ignores_plain_tpu_without_tunnel_signal(monkeypatch):
+    """A direct (non-tunneled) TPU has no crash envelope: backend 'tpu'
+    alone must NOT clamp.  Every tunnel-signal source is scrubbed — AXON*
+    env markers AND the registered-platform sets (dev images that eagerly
+    initialize the axon plugin leave 'axon' in xla_bridge's factory
+    registry even after _clear_backends)."""
+    sim = _bposd_sim(8192)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    for k in list(__import__("os").environ):
+        if k.startswith("AXON"):
+            monkeypatch.delenv(k)
+    # unrelated AXON-prefixed vars / disable-intent values are NOT signals
+    monkeypatch.setenv("AXON_LOG_LEVEL", "debug")
+    monkeypatch.setenv("AXON_WORKER", "0")
+    from jax._src import xla_bridge as xb
+
+    for reg in ("_backend_factories", "_backends"):
+        cur = getattr(xb, reg, {})
+        monkeypatch.setattr(
+            xb, reg, {k: v for k, v in cur.items() if k != "axon"},
+            raising=False)
+    assert not on_tunneled_worker()
+    apply_worker_batch_fence(sim)
+    assert sim.batch_size == 8192
+
+
+def test_fence_accepts_literal_axon_backend(monkeypatch):
+    """Configurations that register the tunnel as the default platform
+    report 'axon' directly; the fence still fires."""
+    sim = _bposd_sim(8192)
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    assert on_tunneled_worker()
+    with pytest.warns(UserWarning, match="worker fence"):
         apply_worker_batch_fence(sim)
     assert sim.batch_size == WORKER_OSD_BATCH_SAFE
 
@@ -55,7 +107,7 @@ def test_fence_leaves_plain_bp_alone(monkeypatch):
         code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
         pauli_error_probs=[p / 3] * 3, batch_size=16384, seed=3,
     )
-    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    _as_tunneled_worker(monkeypatch)
     apply_worker_batch_fence(sim)
     assert sim.batch_size == 16384  # flagship plain-BP batches stay untouched
 
