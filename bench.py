@@ -9,6 +9,7 @@ Baseline: the reference sustains ~36 shots/s on a laptop CPU pool with
 BP+OSD (Single-Shot checkpoint cell 4: 16k shots in 449.7 s); vs_baseline is
 measured against that figure.  Prints ONE json line.
 """
+import contextlib
 import json
 import os
 import sys
@@ -17,6 +18,65 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
+
+
+@contextlib.contextmanager
+def _no_env_jsonl():
+    """Suppress the QLDPC_TELEMETRY_JSONL fallback around bench-internal
+    enable() calls: an operator streaming parity sweeps must not have bench
+    A/B events appended to their file, and the per-event flush of a JSONL
+    sink inside a timed region would inflate the measured overhead."""
+    saved = os.environ.pop("QLDPC_TELEMETRY_JSONL", None)
+    try:
+        yield
+    finally:
+        if saved is not None:
+            os.environ["QLDPC_TELEMETRY_JSONL"] = saved
+
+
+@contextlib.contextmanager
+def _tele_region():
+    """Fresh telemetry region for a bench counters pass: reset + enable
+    (enable() re-baselines the retrace fallback itself; the env JSONL
+    fallback is suppressed), and ALWAYS disable — an exception inside one
+    mode must not leak the enabled switch into the next."""
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    with _no_env_jsonl():
+        telemetry.reset()
+        telemetry.enable()
+        try:
+            yield
+        finally:
+            telemetry.disable()
+
+
+def _tele_counters_block(snap=None, stats=None, **extra):
+    """Uniform ``telemetry`` block for the BENCH json: headline counters
+    from the registry snapshot + retrace count (utils.telemetry)."""
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    snap = telemetry.snapshot() if snap is None else snap
+    stats = telemetry.compile_stats() if stats is None else stats
+
+    def val(name):
+        return snap.get(name, {}).get("value", 0)
+
+    it = snap.get("bp.iterations", {})
+    bp_shots = val("bp.shots")
+    return {
+        "shots": val("sim.shots"),
+        "failures": val("sim.failures"),
+        "dispatches": val("driver.dispatches"),
+        "bp_converged_fraction": (round(val("bp.converged") / bp_shots, 4)
+                                  if bp_shots else None),
+        "bp_iterations_mean": (round(it["mean"], 2)
+                               if it.get("mean") is not None else None),
+        "osd_invocations": val("osd.invocations"),
+        "osd_shots": val("osd.shots") + val("osd.device_shots"),
+        "retraces": stats.get("jax.retraces", 0),
+        **extra,
+    }
 
 
 def _bench_code():
@@ -249,6 +309,51 @@ def mode_bp():
         wer_main = wer_rep
     rate = shots / sorted(times)[1]
 
+    # telemetry A/B arm — the <2% overhead acceptance gate of ISSUE 2.
+    # Same config/key/median-of-3 protocol, but the on/off reps INTERLEAVE
+    # (off, on, off, on, ...) so machine drift hits both arms equally; a
+    # sequential A-then-B run showed ±30% phantom deltas on a shared CPU.
+    # The telemetry fold is part of the compiled program, so the enabled
+    # arm gets its own warmup.  BENCH_TELE=0 skips the arm (7 extra
+    # full-size runs) for quick perf checks, mirroring BENCH_AB.
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    if os.environ.get("BENCH_TELE", "1") != "0":
+        try:
+            with _no_env_jsonl():
+                telemetry.reset()
+                telemetry.enable()
+                sim.WordErrorRate(shots, key=jax.random.fold_in(key, 0))
+                telemetry.disable()
+                times_off, times_tel, wer_tel = [], [], None
+                for rep in range(3):
+                    t0 = time.perf_counter()
+                    sim.WordErrorRate(
+                        shots, key=jax.random.fold_in(key, 1))
+                    times_off.append(time.perf_counter() - t0)
+                    telemetry.reset()  # counters = final enabled rep only
+                    telemetry.enable()
+                    t0 = time.perf_counter()
+                    wer_tel = sim.WordErrorRate(
+                        shots, key=jax.random.fold_in(key, 1))
+                    times_tel.append(time.perf_counter() - t0)
+                    telemetry.disable()
+        finally:
+            telemetry.disable()  # never leak the switch into later modes
+        rate_off = shots / sorted(times_off)[1]
+        rate_tel = shots / sorted(times_tel)[1]
+        # snapshot()/compile_stats() read the registry regardless of the
+        # switch, so the block sees the final enabled rep's counters
+        tele_block = _tele_counters_block(
+            enabled_shots_per_s=round(rate_tel, 1),
+            disabled_shots_per_s=round(rate_off, 1),
+            overhead_pct=round((rate_off - rate_tel) / rate_off * 100, 2),
+            wer_bitexact_vs_disabled=bool(wer_tel[0] == wer_main[0]
+                                          and wer_tel[1] == wer_main[1]),
+        )
+    else:
+        tele_block = {"skipped": "BENCH_TELE=0"}
+
     out_ab = {}
     if run_ab:
         # dense-uint8 A/B arm: same shapes, same key, same median-of-3
@@ -297,6 +402,7 @@ def mode_bp():
         "sample_synd_bytes_per_shot_packed": round(dense_bps / 8, 1),
         "sample_synd_shots_per_s": _sample_synd_rates(
             code, p, batch, jax.random.fold_in(key, 98)),
+        "telemetry": tele_block,
         **out_ab,
         **_bp_utilization(dec_x, dec_z, code, p, rate,
                           jax.random.fold_in(key, 99)),
@@ -333,14 +439,22 @@ def mode_bposd():
     shots = 16384
     # warmup at the SAME shot count: the scan-chunk length is a static shape
     sim.WordErrorRate(shots, key=jax.random.fold_in(key, 0))
+    # headline timed run stays telemetry-DISABLED so the metric definition
+    # matches the PR-1 baselines; a separate enabled pass (same shots/key,
+    # same warm program — the host-OSD path compiles no telemetry variant)
+    # populates the counters block
     t0 = time.perf_counter()
     sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
     rate = shots / (time.perf_counter() - t0)
+    with _tele_region():
+        sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
+        tele_block = _tele_counters_block(telemetry_enabled=True)
     return {
         "metric": f"BP+OSD(osd_e,10) data-noise shots/sec ({code.name or 'hgp'}, N={code.N}, p=0.05)",
         "value": round(rate, 1),
         "unit": "shots/s",
         "vs_baseline": round(rate / 36.0, 1),
+        "telemetry": tele_block,
         **_bp_utilization(dec_x, dec_z, code, p, rate,
                           jax.random.fold_in(key, 99)),
     }
@@ -379,42 +493,76 @@ def mode_st_circuit():
                                               max_iter=mi, osd_method="osd_e",
                                               osd_order=10)
     key = jax.random.PRNGKey(11)
-    sim.WordErrorRate(4096, key=jax.random.fold_in(key, 0))  # warmup/compile
     shots = 16384
+    sim.WordErrorRate(4096, key=jax.random.fold_in(key, 0))  # warmup/compile
+    # headline timed run telemetry-DISABLED (PR-1 metric definition); the
+    # enabled counters pass reuses the warm program (host-windowed engine,
+    # no telemetry program variant)
     t0 = time.perf_counter()
     sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
     rate = shots / (time.perf_counter() - t0)
+    with _tele_region():
+        sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
+        tele_block = _tele_counters_block(telemetry_enabled=True)
     return {
         "metric": "ST-circuit shots/sec (SpaceTimeDecodingDemo config: toric d3, 13 cycles, BP+BPOSD)",
         "value": round(rate, 1),
         "unit": "shots/s",
         "vs_baseline": round(rate / 1890.0, 1),
+        "telemetry": tele_block,
     }
 
 
-def _warm_sweep_elapsed(experiment: str, cycles: int) -> float:
-    """Run one parity sweep in a subprocess with --warmup and return the
-    recorded warm elapsed_s (see mode_phenl_cell for the protocol)."""
+def _warm_sweep_elapsed(experiment: str, cycles: int):
+    """Run one parity sweep in a subprocess with --warmup and return
+    ``(warm elapsed_s, telemetry block)`` (see mode_phenl_cell for the
+    protocol).  The subprocess streams its telemetry to a JSONL file via
+    ``QLDPC_TELEMETRY_JSONL`` (scripts/parity.py enables on that env var);
+    the final snapshot event becomes the mode's ``telemetry`` block."""
+    import shutil
     import subprocess
     import sys as _sys
+    import tempfile
 
     script = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           "scripts", "parity.py")
+    tele_dir = tempfile.mkdtemp(prefix="qldpc_bench_tele_")
+    tele_path = os.path.join(tele_dir, "run.jsonl")
+    env = dict(os.environ, QLDPC_TELEMETRY_JSONL=tele_path)
     try:
-        proc = subprocess.run(
-            [_sys.executable, script, experiment, "--cycles", str(cycles),
-             "--seeds", "1", "--warmup", "--no-record"],
-            check=True, capture_output=True, text=True,
-        )
-    except subprocess.CalledProcessError as e:
-        _sys.stderr.write(e.stderr or "")
-        raise
-    recs = [json.loads(line) for line in proc.stdout.splitlines()
-            if line.startswith("{")]
+        try:
+            proc = subprocess.run(
+                [_sys.executable, script, experiment, "--cycles", str(cycles),
+                 "--seeds", "1", "--warmup", "--no-record"],
+                check=True, capture_output=True, text=True, env=env,
+            )
+        except subprocess.CalledProcessError as e:
+            _sys.stderr.write(e.stderr or "")
+            raise
+        recs = [json.loads(line) for line in proc.stdout.splitlines()
+                if line.startswith("{")]
+        # unlike bp/bposd/st_circuit, the cell modes' elapsed_s IS measured
+        # with telemetry on: the sweep runs once in one subprocess, and
+        # doubling a multi-minute cell for a disabled arm isn't worth the
+        # <2% (A/B-gated, within noise) it would isolate — the flag below
+        # keeps the metric definition explicit for cross-PR comparisons
+        tele = {"scope": "subprocess", "telemetry_enabled": True,
+                "headline_includes_telemetry": True}
+        try:
+            with open(tele_path, encoding="utf-8") as fh:
+                events = [json.loads(line) for line in fh if line.strip()]
+            snaps = [e for e in events if e.get("kind") == "snapshot"]
+            if snaps:
+                tele.update(_tele_counters_block(snaps[-1].get("metrics", {}),
+                                                 snaps[-1].get("compile", {})))
+        except OSError:
+            pass
+    finally:
+        shutil.rmtree(tele_dir, ignore_errors=True)
     # --no-record: the bench races the workload for wall-clock only; parity
     # evidence is the multi-seed sweeps recorded by scripts/parity.py runs,
     # and a bench rerun must not append duplicate single-seed rows
-    return recs[-1]["elapsed_s"]
+    return recs[-1]["elapsed_s"], tele
 
 
 def mode_phenl_cell():
@@ -428,12 +576,13 @@ def mode_phenl_cell():
     already imported/constructed/hot).  ``--warmup`` runs a tiny-scale pass
     of the same cells first, then the recorded ``elapsed_s`` measures the
     warm sweep alone."""
-    elapsed = _warm_sweep_elapsed("toric_phenl", 10)
+    elapsed, tele = _warm_sweep_elapsed("toric_phenl", 10)
     return {
         "metric": "toric phenl threshold point wall-clock (Threshold cell 25, cycles=10)",
         "value": round(elapsed, 1),
         "unit": "s",
         "vs_baseline": round(111.3 / elapsed, 2),  # >1 = faster than reference
+        "telemetry": tele,
     }
 
 
@@ -443,12 +592,13 @@ def mode_circuit_cell():
     synthesis + Pauli-frame detector sampling + per-round BP decoding with
     a BPOSD final round.  Reference: 318.2 s (cell 29 third output).  Same
     warm-process protocol as mode_phenl_cell."""
-    elapsed = _warm_sweep_elapsed("hgp_circuit", 10)
+    elapsed, tele = _warm_sweep_elapsed("hgp_circuit", 10)
     return {
         "metric": "hgp circuit threshold point wall-clock (Threshold cell 29, cycles=10)",
         "value": round(elapsed, 1),
         "unit": "s",
         "vs_baseline": round(318.2 / elapsed, 2),
+        "telemetry": tele,
     }
 
 

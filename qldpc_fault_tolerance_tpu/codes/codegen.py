@@ -189,7 +189,16 @@ def GeneRandGraphsLargeGirthFinal(n0: int, Delta_c: int, Delta_v: int,
         if ok:
             out.append(H2)
     if len(out) < num:
-        print("Max iter reached")
+        # non-convergence is a signal, not stdout noise: warn + count it
+        import warnings
+
+        from ..utils import telemetry
+
+        telemetry.count("codegen.max_iter_reached")
+        warnings.warn(
+            f"GeneRandGraphsLargeGirthFinal: max_iter={max_iter} reached "
+            f"with {len(out)}/{num} codes at girth {target_girth}",
+            stacklevel=2)
     return out
 
 

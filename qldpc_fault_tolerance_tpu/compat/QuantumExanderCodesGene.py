@@ -65,5 +65,13 @@ def GeneRandGraphsLargeGirth(n0, Delta_c, Delta_v, min_girth, min_distance,
                 classical_code_distance(H) >= min_distance:
             out.append(H)
     if len(out) < num:
-        print("Max iter reached")
+        # non-convergence is a signal, not stdout noise: warn + count it
+        import warnings
+
+        from ..utils import telemetry
+
+        telemetry.count("codegen.max_iter_reached")
+        warnings.warn(
+            f"GeneRandGraphsLargeGirth: max_iter={max_iter} reached with "
+            f"{len(out)}/{num} codes", stacklevel=2)
     return out

@@ -168,7 +168,8 @@ def decode_device(static, state, syndromes):
             max_iter=max_iter, method=method, ms_scaling_factor=msf,
         )
     return res.error, {
-        "converged": res.converged, "posterior_llr": res.posterior_llr
+        "converged": res.converged, "posterior_llr": res.posterior_llr,
+        "iterations": res.iterations,
     }
 
 
@@ -288,7 +289,9 @@ class BPDecoder:
     def decode_batch_device(self, syndromes):
         """Uniform device interface: returns (corrections (B,n) uint8, aux dict)."""
         res = self.bp_batch_device(syndromes)
-        return res.error, {"converged": res.converged, "posterior_llr": res.posterior_llr}
+        return res.error, {"converged": res.converged,
+                           "posterior_llr": res.posterior_llr,
+                           "iterations": res.iterations}
 
     def host_postprocess(self, syndromes, corrections, aux):
         """No-op for plain BP (bposd applies OSD only on BP failure)."""
@@ -318,7 +321,13 @@ class BPDecoder:
 
     # --- host-side batch API ---
     def decode_batch(self, syndromes) -> np.ndarray:
+        from ..utils import telemetry
+
         res = self.bp_batch_device(jnp.asarray(np.atleast_2d(syndromes)))
+        if telemetry.enabled():
+            telemetry.record_bp_aux(
+                {"converged": np.asarray(res.converged),
+                 "iterations": np.asarray(res.iterations)})
         return np.asarray(res.error)
 
     def decode(self, synd):
@@ -405,6 +414,13 @@ class BPOSD_Decoder(BPDecoder):
                                   syndromes)
 
     def host_postprocess(self, syndromes, corrections, aux):
+        from ..utils import telemetry
+
+        if telemetry.enabled():
+            # the aux is already host-bound on this path: BP stats (and one
+            # counted host round-trip) come for free here
+            telemetry.record_bp_aux(aux)
+            telemetry.count("osd.host_round_trips")
         return self.osd_host(
             np.asarray(syndromes),
             np.asarray(corrections),
@@ -413,11 +429,27 @@ class BPOSD_Decoder(BPDecoder):
         )
 
     def decode_batch(self, syndromes) -> np.ndarray:
+        from ..utils import telemetry
+
         syndromes = np.atleast_2d(np.asarray(syndromes))
         if self.device_osd:
-            out, _ = self.decode_batch_device(jnp.asarray(syndromes))
+            out, aux = self.decode_batch_device(jnp.asarray(syndromes))
+            if telemetry.enabled():
+                telemetry.record_bp_aux(
+                    {k: np.asarray(v) for k, v in aux.items()
+                     if k in ("converged", "iterations")})
+                conv = aux.get("converged")
+                if conv is not None:
+                    # mirror device_tele_vec: BP-failed shots routed to the
+                    # device OSD stage count as OSD fallback pressure
+                    telemetry.count("osd.device_shots",
+                                    int((~np.asarray(conv)).sum()))
             return np.asarray(out)
         res = self.bp_batch_device(jnp.asarray(syndromes))
+        if telemetry.enabled():
+            telemetry.record_bp_aux(
+                {"converged": np.asarray(res.converged),
+                 "iterations": np.asarray(res.iterations)})
         return self.osd_host(
             syndromes, np.asarray(res.error), np.asarray(res.converged),
             np.asarray(res.posterior_llr),

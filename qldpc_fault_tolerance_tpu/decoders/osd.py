@@ -151,6 +151,7 @@ def osd_postprocess(
     osd_order: int = 10,
 ) -> np.ndarray:
     """Combine BP output with OSD on the non-converged shots (bposd semantics)."""
+    from ..utils import telemetry
     from ..utils.observability import stage_timer
 
     bp_errors = np.asarray(bp_errors, dtype=np.uint8)
@@ -158,6 +159,8 @@ def osd_postprocess(
     if conv.all():
         return bp_errors
     idx = np.nonzero(~conv)[0]
+    telemetry.count("osd.invocations")
+    telemetry.count("osd.shots", int(idx.size))
     with stage_timer("osd_host"):
         fixed = osd_decode_batch(
             h,

@@ -30,6 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.bp import _LruCache  # shared bounded memo (see ops/bp.py)
+from ..utils import telemetry
 
 __all__ = [
     "shot_mesh",
@@ -70,7 +71,7 @@ def split_keys_for_mesh(key, mesh: Mesh):
     return jax.random.split(key, n)
 
 
-def sharded_batch_stats(stats_fn, mesh: Mesh):
+def sharded_batch_stats(stats_fn, mesh: Mesh, has_tele: bool = False):
     """Build a jitted function (keys (n_dev,) -> (count, min_weight) scalars).
 
     ``stats_fn(key) -> (int32 failure count, int32 min logical weight)`` runs
@@ -78,6 +79,10 @@ def sharded_batch_stats(stats_fn, mesh: Mesh):
     the mesh unit shared by every MC engine: the count psum-reduces and the
     diagnostic min-logical-weight pmin-reduces over ICI — the only
     cross-device traffic is these two scalars.
+
+    ``has_tele``: ``stats_fn`` returns a third element, the (TELE_LEN,)
+    int32 device telemetry vector (utils.telemetry), which psum-reduces
+    alongside the count so sharded runs report decoder statistics too.
     """
 
     # check_vma=False: engine internals scan with replicated zero-init
@@ -90,15 +95,18 @@ def sharded_batch_stats(stats_fn, mesh: Mesh):
         _shard_map,
         mesh=mesh,
         in_specs=(P(SHOT_AXIS),),
-        out_specs=(P(), P()),
+        out_specs=(P(), P(), P()) if has_tele else (P(), P()),
         check_vma=False,
     )
     def run(keys):
-        count, min_w = stats_fn(keys[0])
-        return (
-            jax.lax.psum(count, SHOT_AXIS),
-            jax.lax.pmin(min_w, SHOT_AXIS),
+        stats = stats_fn(keys[0])
+        out = (
+            jax.lax.psum(stats[0], SHOT_AXIS),
+            jax.lax.pmin(stats[1], SHOT_AXIS),
         )
+        if has_tele:
+            out = out + (jax.lax.psum(stats[2], SHOT_AXIS),)
+        return out
 
     return run
 
@@ -157,9 +165,12 @@ class MegabatchDriver:
         n_run = -(-int(n_batches) // k) * k
         carry = self._init_fn()
         for start in range(0, n_run, k):
-            carry = self._mega(carry, key, jnp.asarray(start, jnp.int32),
-                               *extra)
+            with telemetry.span("megabatch_dispatch"):
+                carry = self._mega(carry, key, jnp.asarray(start, jnp.int32),
+                                   *extra)
             self.dispatches += 1
+            telemetry.count("driver.dispatches")
+        telemetry.count("driver.batches", n_run)
         return carry, n_run
 
     def run_keys(self, key, n_batches: int, *extra):
@@ -174,38 +185,52 @@ class MegabatchDriver:
         carry_box = [self._init_fn()]
 
         def launch(start):
-            carry_box[0] = self._mega(carry_box[0], key,
-                                      jnp.asarray(start, jnp.int32), *extra)
+            with telemetry.span("megabatch_dispatch"):
+                carry_box[0] = self._mega(carry_box[0], key,
+                                          jnp.asarray(start, jnp.int32),
+                                          *extra)
             self.dispatches += 1
+            telemetry.count("driver.dispatches")
+            telemetry.count("driver.batches", k)
             snap = jax.tree_util.tree_map(lambda x: x + 0, carry_box[0])
             return snap, start + k
 
         def finish(item):
             snap, done = item
-            return jax.device_get(snap), done
+            with telemetry.span("megabatch_drain"):
+                return jax.device_get(snap), done
 
         yield from drain_double_buffered(launch, finish, range(0, n_run, k))
 
 
 def count_min_driver(tag: str, cfg, k_inner: int, stats_fn,
-                     min_init: int) -> MegabatchDriver:
+                     min_init: int, tele_len: int = 0) -> MegabatchDriver:
     """Memoized MegabatchDriver for the engines' shared stats shape: a
     ``(failure count, min logical weight)`` fold.  Keyed on
-    ``(tag, cfg, k_inner)`` so same-structure simulator instances (p- and
-    cycle-sweeps: state values change, program doesn't) reuse one compiled
-    scan.  ``stats_fn(key, *extra) -> (i32 count, i32 min_w)``;
-    ``min_init`` seeds the min-weight track (the code length N)."""
+    ``(tag, cfg, k_inner, tele_len)`` so same-structure simulator instances
+    (p- and cycle-sweeps: state values change, program doesn't) reuse one
+    compiled scan.  ``stats_fn(key, *extra) -> (i32 count, i32 min_w)``;
+    ``min_init`` seeds the min-weight track (the code length N).
+
+    ``tele_len > 0``: the stats tuple carries a third element — a
+    ``(tele_len,)`` int32 device telemetry vector (utils.telemetry slot
+    layout) summed across batches alongside the counts, so per-shot decoder
+    statistics reach the host at the run's one existing sync."""
 
     def make():
-        return MegabatchDriver(
-            stats_fn,
-            lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1])),
-            lambda: (jnp.zeros((), jnp.int32),
-                     jnp.asarray(min_init, jnp.int32)),
-            k_inner=k_inner,
-        )
+        if tele_len:
+            combine = lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1]),
+                                    c[2] + o[2])
+            init = lambda: (jnp.zeros((), jnp.int32),
+                            jnp.asarray(min_init, jnp.int32),
+                            jnp.zeros((tele_len,), jnp.int32))
+        else:
+            combine = lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1]))
+            init = lambda: (jnp.zeros((), jnp.int32),
+                            jnp.asarray(min_init, jnp.int32))
+        return MegabatchDriver(stats_fn, combine, init, k_inner=k_inner)
 
-    return _engine_driver_cache.get((tag, cfg, k_inner), make)
+    return _engine_driver_cache.get((tag, cfg, k_inner, tele_len), make)
 
 
 def drain_double_buffered(launch, finish, items, depth: int = 2):
@@ -217,6 +242,7 @@ def drain_double_buffered(launch, finish, items, depth: int = 2):
     pending = deque()
     for it in items:
         pending.append(launch(it))
+        telemetry.set_gauge("driver.drain_depth", len(pending))
         if len(pending) >= depth:
             yield finish(pending.popleft())
     while pending:

@@ -34,6 +34,7 @@ from .common import (
     ShotBatcher,
     accumulate_counts,
     mesh_batch_stats,
+    record_wer_run,
     wer_per_cycle,
     windowed_count,
 )
@@ -446,5 +447,10 @@ class CodeSimulator_Circuit:
 
     def WordErrorRate(self, num_samples: int, key=None):
         """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
-        count, total = self._count_failures(num_samples, key)
-        return wer_per_cycle(count, total, self.K, self.num_cycles)
+        from ..utils import telemetry
+
+        with telemetry.span("wer.circuit"):
+            count, total = self._count_failures(num_samples, key)
+        wer = wer_per_cycle(count, total, self.K, self.num_cycles)
+        record_wer_run("circuit", count, total, wer[0])
+        return wer

@@ -45,11 +45,18 @@ def accumulate_device(step_fn, keys, combine):
 
 
 def accumulate_counts(count_fn, keys) -> int:
-    """Sum device scalar counts over batches with ONE final host sync."""
+    """Sum device scalar counts over batches with ONE final host sync.
+
+    The ``device_dispatch`` / ``device_sync`` stage timers double as
+    telemetry spans when utils.telemetry is enabled (xprof-annotated, with
+    duration histograms), and every batch counts as a dispatch."""
+    from ..utils import telemetry
     from ..utils.observability import stage_timer
 
+    keys = list(keys)
     with stage_timer("device_dispatch"):
         total = accumulate_device(count_fn, keys, lambda a, b: a + b)
+    telemetry.count("driver.dispatches", len(keys))
     if total is None:
         return 0
     with stage_timer("device_sync"):
@@ -64,13 +71,18 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     Per-stage wall-clock lands in utils.observability.timings():
     "launch" (async device dispatch), "finish" (device->host transfer +
     host postprocess + checks; the OSD slice inside it is separately
-    tracked as "osd_host" by decoders/osd.py)."""
+    tracked as "osd_host" by decoders/osd.py).  With utils.telemetry
+    enabled the same stages are trace spans, each launch counts as a
+    dispatch, and the in-flight window depth is a gauge."""
+    from ..utils import telemetry
     from ..utils.observability import stage_timer
 
     window, count = [], 0
     for k in keys:
         with stage_timer("launch"):
             window.append(launch(k))
+        telemetry.count("driver.dispatches")
+        telemetry.set_gauge("driver.drain_depth", len(window))
         if len(window) >= in_flight:
             with stage_timer("finish"):
                 count += int(np.asarray(finish(window.pop(0))).sum())
@@ -80,35 +92,66 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     return count
 
 
-def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key):
+def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
+    """Shared per-run telemetry bookkeeping for every engine's
+    WordErrorRate path: the sim.* counters plus one ``wer_run`` event with
+    a uniform schema (``dispatches`` is included only when the path tracks
+    it — megabatch/windowed runs do, plain accumulate paths don't)."""
+    from ..utils import telemetry
+
+    fields = {"engine": engine, "shots": int(shots),
+              "failures": int(failures), "wer": float(wer)}
+    if dispatches is not None:
+        fields["dispatches"] = int(dispatches)
+    telemetry.count("sim.shots", int(shots))
+    telemetry.count("sim.failures", int(failures))
+    telemetry.count("sim.runs")
+    telemetry.event("wer_run", **fields)
+
+
+def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
+                     has_tele: bool = False):
     """Shot loop sharded over ``sim._mesh``: every mesh device runs
     ``sim.batch_size``-shot batches of ``stats_fn(key) -> (count, min_w)``;
     scalars reduce over ICI (parallel.sharded_batch_stats).
 
     Compiled runners are cached on the simulator keyed by ``cache_key``
-    (anything static the closure bakes in: num_rounds, batch size, ...).
-    Dispatches are asynchronous; the two int() reads at the end are the only
-    host syncs.  Returns (failure_count, shots_run, min_logical_weight).
+    (anything static the closure bakes in: num_rounds, batch size, the
+    telemetry flag, ...).  Dispatches are asynchronous; the reads at the
+    end are the only host sync.  Returns
+    (failure_count, shots_run, min_logical_weight).
+
+    ``has_tele``: ``stats_fn`` additionally returns the device telemetry
+    vector (utils.telemetry), which psum-reduces over the mesh, accumulates
+    across batches, and publishes to the registry at the same sync.
     """
     import jax
     import jax.numpy as jnp
 
     from ..parallel import sharded_batch_stats, split_keys_for_mesh
+    from ..utils import telemetry
 
     mesh = sim._mesh
     runners = sim.__dict__.setdefault("_mesh_runners", {})
     run = runners.get(cache_key)
     if run is None:
-        run = runners[cache_key] = sharded_batch_stats(stats_fn, mesh)
+        run = runners[cache_key] = sharded_batch_stats(stats_fn, mesh,
+                                                       has_tele=has_tele)
     n_dev = mesh.devices.size
     batcher = ShotBatcher(num_samples, sim.batch_size * n_dev)
-    count, min_w = None, None
+    count, min_w, tele = None, None, None
     for i in batcher:
         keys = split_keys_for_mesh(jax.random.fold_in(key, i), mesh)
-        c, w = run(keys)
-        count = c if count is None else count + c
-        min_w = w if min_w is None else jnp.minimum(min_w, w)
-    count, min_w = jax.device_get((count, min_w))  # one host round-trip
+        out = run(keys)
+        telemetry.count("driver.dispatches")
+        count = out[0] if count is None else count + out[0]
+        min_w = out[1] if min_w is None else jnp.minimum(min_w, out[1])
+        if has_tele:
+            tele = out[2] if tele is None else tele + out[2]
+    # one host round-trip
+    count, min_w, tele = jax.device_get((count, min_w, tele))
+    if tele is not None:
+        telemetry.publish_device_tele(tele)
     return int(count), batcher.total, int(min_w)
 
 

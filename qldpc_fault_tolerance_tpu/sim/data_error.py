@@ -38,11 +38,13 @@ from ..ops.gf2_packed import (
 )
 from ..ops import gf2_pallas
 from ..parallel.shots import MegabatchDriver, count_min_driver
+from ..utils import telemetry
 from .common import (
     apply_worker_batch_fence,
     fence_batch_value,
     ShotBatcher,
     mesh_batch_stats,
+    record_wer_run,
     wer_single_shot,
     windowed_count,
 )
@@ -104,9 +106,9 @@ def _sample_and_bp_packed(cfg, state, key):
     # pack/unpack shim at the BP boundary: LLR messages stay f32
     synd_z = unpack_shots(synd_z_p, batch_size)
     synd_x = unpack_shots(synd_x_p, batch_size)
-    cor_z, _ = decode_device(cfg[4], state["dz"], synd_z)
-    cor_x, _ = decode_device(cfg[3], state["dx"], synd_x)
-    return ex_p, ez_p, cor_x, cor_z
+    cor_z, aux_z = decode_device(cfg[4], state["dz"], synd_z)
+    cor_x, aux_x = decode_device(cfg[3], state["dx"], synd_x)
+    return ex_p, ez_p, cor_x, cor_z, aux_x, aux_z
 
 
 def _check_packed_stats(cfg, state, ex_p, ez_p, cor_x, cor_z):
@@ -136,23 +138,40 @@ def _stats_fused(cfg, state, key):
                                           emit_errors=False)
     synd_z = unpack_shots(szp, batch_size)
     synd_x = unpack_shots(sxp, batch_size)
-    cor_z, _ = decode_device(cfg[4], state["dz"], synd_z)
-    cor_x, _ = decode_device(cfg[3], state["dx"], synd_x)
-    return gf2_pallas.residual_check_stats(
+    cor_z, aux_z = decode_device(cfg[4], state["dz"], synd_z)
+    cor_x, aux_x = decode_device(cfg[3], state["dx"], synd_x)
+    stats = gf2_pallas.residual_check_stats(
         spec, key, batch_size, pack_shots(cor_x), pack_shots(cor_z), cfg[2])
+    return stats, aux_x, aux_z
+
+
+def _tele_on(cfg) -> bool:
+    return len(cfg) > 7 and cfg[7]
 
 
 def _stats_one_batch(cfg, state, key):
     """One batch fully on device -> (failure count, min weight) scalars,
-    fused / packed / dense per cfg[6] and cfg[5]."""
+    fused / packed / dense per cfg[6] and cfg[5].  With the telemetry flag
+    (cfg[7]) a third element rides along: the (TELE_LEN,) int32 decoder
+    statistics vector (utils.telemetry) summed through the megabatch carry,
+    so BP convergence / iteration / OSD-routing counts reach the host at
+    the run's one existing sync instead of adding one."""
     if len(cfg) > 6 and cfg[6]:
-        return _stats_fused(cfg, state, key)
-    if cfg[5]:
-        ex_p, ez_p, cx, cz = _sample_and_bp_packed(cfg, state, key)
-        return _check_packed_stats(cfg, state, ex_p, ez_p, cx, cz)
-    ex, ez, _, _, cx, cz, _, _ = _sample_and_bp(cfg, state, key)
-    fail, min_w = _check(cfg, state, ex, ez, cx, cz)
-    return fail.sum(dtype=jnp.int32), min_w
+        (cnt, mw), aux_x, aux_z = _stats_fused(cfg, state, key)
+        cx_aux, cz_aux = aux_x, aux_z
+    elif cfg[5]:
+        ex_p, ez_p, cx, cz, cx_aux, cz_aux = _sample_and_bp_packed(
+            cfg, state, key)
+        cnt, mw = _check_packed_stats(cfg, state, ex_p, ez_p, cx, cz)
+    else:
+        ex, ez, _, _, cx, cz, cx_aux, cz_aux = _sample_and_bp(cfg, state, key)
+        fail, mw = _check(cfg, state, ex, ez, cx, cz)
+        cnt = fail.sum(dtype=jnp.int32)
+    if _tele_on(cfg):
+        tele = telemetry.device_tele_vec(
+            [(cfg[3], cx_aux), (cfg[4], cz_aux)])
+        return cnt, mw, tele
+    return cnt, mw
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -177,11 +196,15 @@ def _batch_stats(cfg, state, key):
 def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
     """Megabatch driver for the data-error stats unit, memoized on the
     hashable program config so a p-sweep (state values change, structure
-    doesn't) reuses one compiled scan per (cfg, k_inner)."""
+    doesn't) reuses one compiled scan per (cfg, k_inner).  The telemetry
+    flag lives in cfg, so enabled and disabled runs compile (and memoize)
+    separate programs — the disabled program is bit-identical to the
+    pre-telemetry one."""
     return count_min_driver(
         "data", cfg, k_inner,
         lambda key, state: _stats_one_batch(cfg, state, key),
-        min_init=cfg[1])
+        min_init=cfg[1],
+        tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
 
 
 class CodeSimulator_DataError:
@@ -265,11 +288,12 @@ class CodeSimulator_DataError:
     # device stages (delegating to the shared value-based pipeline; the
     # legacy fused-pair experiment keeps its per-instance path)
     # ------------------------------------------------------------------
-    def _cfg(self, batch_size: int, packed: bool | None = None):
+    def _cfg(self, batch_size: int, packed: bool | None = None,
+             tele: bool = False):
         return (batch_size, self.N, self.eval_logical_type,
                 self.decoder_x.device_static, self.decoder_z.device_static,
                 self._packed if packed is None else bool(packed),
-                self._fused_sampler)
+                self._fused_sampler, bool(tele))
 
     def _sample_and_bp(self, key, batch_size: int):
         if self._fused is not None:
@@ -291,12 +315,14 @@ class CodeSimulator_DataError:
                           self._dev_state, error_x, error_z, cor_x, cor_z)
 
     # ------------------------------------------------------------------
-    def _device_batch_stats(self, key, batch_size: int):
-        """One batch fully on device: (failure count, min logical weight).
-        No host transfer — callers accumulate these device scalars across
-        batches and read back once per sweep (the tunneled TPU pays ~100ms
-        latency per device->host transfer; per-batch syncs would dominate)."""
-        return _batch_stats(self._cfg(batch_size), self._dev_state, key)
+    def _device_batch_stats(self, key, batch_size: int, tele: bool = False):
+        """One batch fully on device: (failure count, min logical weight,
+        + the telemetry vector when ``tele``).  No host transfer — callers
+        accumulate these device scalars across batches and read back once
+        per sweep (the tunneled TPU pays ~100ms latency per device->host
+        transfer; per-batch syncs would dominate)."""
+        return _batch_stats(self._cfg(batch_size, tele=tele),
+                            self._dev_state, key)
 
     # default batches per compiled megabatch dispatch (``scan_chunk`` ctor
     # arg): large enough that the ~40-60ms per-dispatch tunnel overhead is
@@ -309,14 +335,16 @@ class CodeSimulator_DataError:
         """Run ``n_batches`` batches through the dispatch-amortized megabatch
         driver (parallel/shots.py): ``scan_chunk`` batches per compiled
         dispatch, donated accumulator carry, device-resident scalars.
-        Returns device scalars — the caller's materialization is the only
-        host sync."""
+        Returns ``(count, min_w, tele_vec-or-None)`` device values — the
+        caller's materialization is the only host sync (the telemetry
+        vector rides the same carry, see utils.telemetry)."""
         chunk = min(n_batches, self._scan_chunk)
-        driver = _stats_driver(self._cfg(batch_size), chunk)
+        cfg = self._cfg(batch_size, tele=telemetry.enabled())
+        driver = _stats_driver(cfg, chunk)
         before = driver.dispatches
-        (cnt, mw), _ = driver.run(key, n_batches, self._dev_state)
+        carry, _ = driver.run(key, n_batches, self._dev_state)
         self.last_dispatches = driver.dispatches - before
-        return cnt, mw
+        return carry[0], carry[1], (carry[2] if len(carry) > 2 else None)
 
     def _drain_batch(self, batch_out) -> np.ndarray:
         """Host-postprocess one _sample_and_bp output tuple and return the
@@ -364,15 +392,31 @@ class CodeSimulator_DataError:
                 "single-chip path (no host-postprocess decoders, no mesh)")
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
+        with telemetry.span("wer.data"):
+            wer = self._word_error_rate(num_run, key, target_failures)
+        return wer
+
+    def _wer_result(self, failures: int, shots: int):
+        """WER + telemetry bookkeeping shared by every WordErrorRate path."""
+        wer = wer_single_shot(int(failures), int(shots), self.K)
+        record_wer_run("data", failures, shots, wer[0],
+                       dispatches=self.last_dispatches)
+        return wer
+
+    def _word_error_rate(self, num_run, key, target_failures):
         if self._mesh is not None and not self._needs_host:
+            tele_on = telemetry.enabled()
             count, total, min_w = mesh_batch_stats(
                 self, ("data", self.batch_size, self._packed,
-                       self._fused_sampler),
-                lambda k: self._device_batch_stats(k, self.batch_size),
-                num_run, key,
+                       self._fused_sampler, tele_on),
+                lambda k: self._device_batch_stats(k, self.batch_size,
+                                                   tele=tele_on),
+                num_run, key, has_tele=tele_on,
             )
             self.min_logical_weight = min(self.min_logical_weight, min_w)
-            return wer_single_shot(count, total, self.K)
+            self.last_dispatches = total // (
+                self.batch_size * self._mesh.devices.size)
+            return self._wer_result(count, total)
         batcher = ShotBatcher(num_run, self.batch_size)
         if not self._needs_host:
             # megabatch dispatches, one host sync; megabatches run whole, so
@@ -380,30 +424,39 @@ class CodeSimulator_DataError:
             chunk = min(batcher.num_batches, self._scan_chunk)
             n_batches = -(-batcher.num_batches // chunk) * chunk
             if target_failures is not None:
-                driver = _stats_driver(self._cfg(self.batch_size), chunk)
+                driver = _stats_driver(
+                    self._cfg(self.batch_size, tele=telemetry.enabled()),
+                    chunk)
                 before = driver.dispatches
-                cnt, mw, done = 0, self.N, 0
-                for (cnt, mw), done in driver.run_keys(
+                carry, done = (0, self.N), 0
+                for carry, done in driver.run_keys(
                         key, n_batches, self._dev_state):
-                    if int(cnt) >= int(target_failures):
+                    if int(carry[0]) >= int(target_failures):
+                        if done * self.batch_size < batcher.total:
+                            telemetry.count("driver.early_stops")
                         break
                 self.last_dispatches = driver.dispatches - before
                 self.min_logical_weight = min(
-                    self.min_logical_weight, int(mw))
-                return wer_single_shot(
-                    int(cnt), done * self.batch_size, self.K)
-            total, min_w = self._device_run_stats(
+                    self.min_logical_weight, int(carry[1]))
+                if len(carry) > 2:
+                    telemetry.publish_device_tele(carry[2])
+                return self._wer_result(
+                    int(carry[0]), done * self.batch_size)
+            total, min_w, tele_vec = self._device_run_stats(
                 key, self.batch_size, n_batches
             )
             self.min_logical_weight = min(self.min_logical_weight, int(min_w))
-            return wer_single_shot(
-                int(total), n_batches * self.batch_size, self.K
+            if tele_vec is not None:
+                telemetry.publish_device_tele(tele_vec)
+            return self._wer_result(
+                int(total), n_batches * self.batch_size
             )
         keys = [jax.random.fold_in(key, i) for i in batcher]
+        self.last_dispatches = len(keys)  # windowed path: one launch per key
         # host-postprocess (OSD) path: bounded in-flight window so device
         # compute overlaps the host transfers
         error_count = windowed_count(
             lambda k: self._sample_and_bp(k, self.batch_size),
             self._drain_batch, keys,
         )
-        return wer_single_shot(error_count, batcher.total, self.K)
+        return self._wer_result(error_count, batcher.total)

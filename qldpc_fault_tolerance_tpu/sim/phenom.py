@@ -37,11 +37,13 @@ from ..ops.gf2_packed import (
     unpack_shots,
 )
 from ..parallel.shots import MegabatchDriver, count_min_driver
+from ..utils import telemetry
 from .common import (
     apply_worker_batch_fence,
     fence_batch_value,
     ShotBatcher,
     mesh_batch_stats,
+    record_wer_run,
     wer_per_cycle,
     wer_single_shot,
     windowed_count,
@@ -190,30 +192,47 @@ def _check_stats(cfg, state, cur_x, cur_z, dec_x, dec_z):
         z_weight_excludes_stab=True)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _batch_stats(cfg, state, key, num_rounds):
-    """Whole batch on device -> (failure count, min weight) scalars — the
-    unit the mesh path shards (parallel/shots.py)."""
+def _tele_on(cfg) -> bool:
+    return len(cfg) > 8 and cfg[8]
+
+
+def _stats_one_batch(cfg, state, key, num_rounds):
+    """One batch fully on device -> (failure count, min weight) scalars —
+    the unit both the mesh path and the megabatch driver run.
+
+    With the telemetry flag (cfg[8]) the stats tuple carries the int32
+    decoder-statistics vector (utils.telemetry).  Only the FINAL-round
+    (decoder-2) aux is counted: the per-round decoder-1 aux lives inside
+    the ``fori_loop`` body and never escapes the scan — documented scope,
+    not an oversight."""
     k_rounds, k_final = jax.random.split(key)
     data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
-    cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
-        cfg, state, k_final, data_x, data_z
-    )
-    return _check_stats(cfg, state, cur_x, cur_z, dx, dz)
+    cur_x, cur_z, _, _, dx, dz, ax, az = _final_round(
+        cfg, state, k_final, data_x, data_z)
+    cnt, mw = _check_stats(cfg, state, cur_x, cur_z, dx, dz)
+    if _tele_on(cfg):
+        tele = telemetry.device_tele_vec([(cfg[5], ax), (cfg[6], az)])
+        return cnt, mw, tele
+    return cnt, mw
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _batch_stats(cfg, state, key, num_rounds):
+    """Jitted ``_stats_one_batch`` — the unit the mesh path shards
+    (parallel/shots.py)."""
+    return _stats_one_batch(cfg, state, key, num_rounds)
 
 
 def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
     """Dispatch-amortized megabatch driver for the phenom stats unit, shared
     across same-shape simulator instances (p- and cycle-sweeps compile
     once); ``num_rounds`` rides through as a traced extra."""
-    def stats(key, state, num_rounds):
-        k_rounds, k_final = jax.random.split(key)
-        data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
-        cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
-            cfg, state, k_final, data_x, data_z)
-        return _check_stats(cfg, state, cur_x, cur_z, dx, dz)
-
-    return count_min_driver("phenl", cfg, k_inner, stats, min_init=cfg[1])
+    return count_min_driver(
+        "phenl", cfg, k_inner,
+        lambda key, state, num_rounds: _stats_one_batch(
+            cfg, state, key, num_rounds),
+        min_init=cfg[1],
+        tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
 
 
 class CodeSimulator_Phenon:
@@ -275,11 +294,12 @@ class CodeSimulator_Phenon:
             "d2x": decoder2_x.device_state, "d2z": decoder2_z.device_state,
         }
 
-    def _cfg(self, batch_size: int, packed: bool | None = None):
+    def _cfg(self, batch_size: int, packed: bool | None = None,
+             tele: bool = False):
         return (batch_size, self.N, self.eval_logical_type,
                 self.decoder1_x.device_static, self.decoder1_z.device_static,
                 self.decoder2_x.device_static, self.decoder2_z.device_static,
-                self._packed if packed is None else bool(packed))
+                self._packed if packed is None else bool(packed), bool(tele))
 
     # ------------------------------------------------------------------
     def _sample_ext(self, key, batch_size):
@@ -352,9 +372,10 @@ class CodeSimulator_Phenon:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, num_rounds, 1)[0])
 
-    def _device_batch_stats(self, key, num_rounds: int, batch_size: int):
+    def _device_batch_stats(self, key, num_rounds: int, batch_size: int,
+                            tele: bool = False):
         """Whole batch on device -> (failure count, min weight) scalars (no
-        host sync).
+        host sync; + the telemetry vector when ``tele``).
 
         Dispatched as three programs (rounds / final / check) rather than
         the fused ``_batch_stats``: on the current libtpu the fused program
@@ -363,13 +384,8 @@ class CodeSimulator_Phenon:
         see sim/circuit.py).  Intermediate arrays stay on device and the
         key split matches ``_batch_stats`` exactly, so results are
         identical.  The mesh path still shards the fused program."""
-        cfg = self._cfg(batch_size)
-        state = self._dev_state
-        k_rounds, k_final = jax.random.split(key)
-        data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
-        cur_x, cur_z, _, _, dx, dz, _, _ = _final_round(
-            cfg, state, k_final, data_x, data_z)
-        return _check_stats(cfg, state, cur_x, cur_z, dx, dz)
+        return _stats_one_batch(self._cfg(batch_size, tele=tele),
+                                self._dev_state, key, num_rounds)
 
     def _count_failures(self, num_rounds, num_samples, key=None):
         apply_worker_batch_fence(self)
@@ -379,13 +395,17 @@ class CodeSimulator_Phenon:
                      or self.decoder2_z.needs_host_postprocess)
         if self._dec1_on_device and not dec2_host:
             if self._mesh is not None:
+                tele_on = telemetry.enabled()
                 count, total, min_w = mesh_batch_stats(
-                    self, ("phenl", num_rounds, self.batch_size, self._packed),
+                    self, ("phenl", num_rounds, self.batch_size, self._packed,
+                           tele_on),
                     lambda k: self._device_batch_stats(
-                        k, num_rounds, self.batch_size),
-                    num_samples, key,
+                        k, num_rounds, self.batch_size, tele=tele_on),
+                    num_samples, key, has_tele=tele_on,
                 )
                 self.min_logical_weight = min(self.min_logical_weight, min_w)
+                self.last_dispatches = total // (
+                    self.batch_size * self._mesh.devices.size)
                 return count, total
             # dispatch-amortized megabatch driver: scan_chunk batches per
             # compiled dispatch, donated carry, one host sync at the end.
@@ -394,29 +414,44 @@ class CodeSimulator_Phenon:
             batcher = ShotBatcher(num_samples, self.batch_size)
             chunk = min(batcher.num_batches, self._scan_chunk)
             n_batches = -(-batcher.num_batches // chunk) * chunk
-            driver = _stats_driver(self._cfg(self.batch_size), chunk)
+            driver = _stats_driver(
+                self._cfg(self.batch_size, tele=telemetry.enabled()), chunk)
             before = driver.dispatches
-            (cnt, mw), _ = driver.run(
+            carry, _ = driver.run(
                 key, n_batches, self._dev_state,
                 jnp.asarray(num_rounds, jnp.int32))
             self.last_dispatches = driver.dispatches - before
-            cnt, mw = jax.device_get((cnt, mw))  # one host round-trip
+            carry = jax.device_get(carry)  # one host round-trip
+            cnt, mw = carry[0], carry[1]
+            if len(carry) > 2:
+                telemetry.publish_device_tele(carry[2])
             self.min_logical_weight = min(self.min_logical_weight, int(mw))
             return int(cnt), n_batches * self.batch_size
         batcher = ShotBatcher(num_samples, self.batch_size)
         keys = [jax.random.fold_in(key, i) for i in batcher]
+        self.last_dispatches = len(keys)  # windowed path: one launch per key
         count = windowed_count(
             lambda k: self._launch_batch(k, num_rounds, self.batch_size),
             self._finish_batch, keys,
         )
         return count, batcher.total
 
+    def _record_run(self, count: int, total: int, wer: float) -> None:
+        record_wer_run("phenl", count, total, wer,
+                       dispatches=self.last_dispatches)
+
     def WordErrorRate(self, num_rounds: int, num_samples: int, key=None):
         """Per-qubit-per-cycle WER (src/Simulators.py:334-362)."""
-        count, total = self._count_failures(num_rounds, num_samples, key)
-        return wer_per_cycle(count, total, self.K, num_rounds)
+        with telemetry.span("wer.phenl"):
+            count, total = self._count_failures(num_rounds, num_samples, key)
+        wer = wer_per_cycle(count, total, self.K, num_rounds)
+        self._record_run(count, total, wer[0])
+        return wer
 
     def WordErrorProbability(self, num_rounds: int, num_samples: int, key=None):
         """End-of-run word error probability (src/Simulators.py:365-383)."""
-        count, total = self._count_failures(num_rounds, num_samples, key)
-        return wer_single_shot(count, total, self.K)
+        with telemetry.span("wer.phenl"):
+            count, total = self._count_failures(num_rounds, num_samples, key)
+        wer = wer_single_shot(count, total, self.K)
+        self._record_run(count, total, wer[0])
+        return wer

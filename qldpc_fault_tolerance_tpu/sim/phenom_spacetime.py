@@ -30,6 +30,7 @@ from .common import (
     ShotBatcher,
     accumulate_device,
     mesh_batch_stats,
+    record_wer_run,
     wer_per_cycle,
     windowed_count,
 )
@@ -275,6 +276,15 @@ class CodeSimulator_Phenon_SpaceTime:
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into
         windows of num_rep; total cycle count must come out odd."""
+        from ..utils import telemetry
+
+        with telemetry.span("wer.phenl_st"):
+            wer, count, total = self._word_error_rate(
+                num_cycles, num_samples, key)
+        record_wer_run("phenl_st", count, total, wer[0])
+        return wer
+
+    def _word_error_rate(self, num_cycles: int, num_samples: int, key=None):
         apply_worker_batch_fence(self)
         self._assert_window_decoders_device()
         num_rounds = int((num_cycles - 1) / self.num_rep + 1)
@@ -292,7 +302,8 @@ class CodeSimulator_Phenon_SpaceTime:
                     num_samples, key,
                 )
                 self.min_logical_weight = min(self.min_logical_weight, min_w)
-                return wer_per_cycle(count, total, self.K, total_num_cycles)
+                return (wer_per_cycle(count, total, self.K, total_num_cycles),
+                        count, total)
             batcher = ShotBatcher(num_samples, self.batch_size)
             keys = [jax.random.fold_in(key, i) for i in batcher]
             stats = accumulate_device(
@@ -301,12 +312,14 @@ class CodeSimulator_Phenon_SpaceTime:
                 lambda a, b: (a[0] + b[0], jnp.minimum(a[1], b[1])),
             )
             self.min_logical_weight = min(self.min_logical_weight, int(stats[1]))
-            return wer_per_cycle(int(stats[0]), batcher.total, self.K,
-                                 total_num_cycles)
+            return (wer_per_cycle(int(stats[0]), batcher.total, self.K,
+                                  total_num_cycles),
+                    int(stats[0]), batcher.total)
         batcher = ShotBatcher(num_samples, self.batch_size)
         keys = [jax.random.fold_in(key, i) for i in batcher]
         count = windowed_count(
             lambda k: self._launch_batch(k, num_rounds, self.batch_size),
             self._finish_batch, keys,
         )
-        return wer_per_cycle(count, batcher.total, self.K, total_num_cycles)
+        return (wer_per_cycle(count, batcher.total, self.K, total_num_cycles),
+                count, batcher.total)

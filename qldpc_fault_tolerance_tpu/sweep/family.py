@@ -137,6 +137,7 @@ class CodeFamily:
             "eval_type should be one of [X, Y, Total]"
         )
         from ..parallel.grid import merge_cell_results, process_cell_owner
+        from ..utils import telemetry
         from ..utils.observability import get_logger, log_record, stage_timer
 
         if noise_model == "circuit" and eval_logical_type == "X":
@@ -190,7 +191,11 @@ class CodeFamily:
                         num_cycles, data_synd_noise_ratio, circuit_type,
                         circuit_error_params,
                     )
+            # per-cell record: one structured log line (always) plus the
+            # telemetry event sink (JSONL stream / report) when enabled
             log_record(logger, "cell_done", **cell_key, wer=float(wer))
+            telemetry.event("cell_done", **cell_key, wer=float(wer))
+            telemetry.count("sweep.cells")
             if checkpoint is not None:
                 checkpoint.put(cell_key, {"wer": float(wer)})
             eval_wer_list.append(wer)

@@ -85,7 +85,9 @@ def ThresholdEst_extrapolation(sweep_p_list, sweep_pl_total_list,
         plt.xlabel("p")
         plt.ylabel("WER")
     if verbose:
-        print("p_c:", p_c)
+        from ..utils.observability import get_logger, log_record
+
+        log_record(get_logger(), "threshold_fit", p_c=float(p_c), A=float(A))
     return p_c
 
 

@@ -1,5 +1,6 @@
-"""Utilities: par2gen teaching tools, observability, sweep checkpointing."""
-from . import par2gen
+"""Utilities: par2gen teaching tools, observability, telemetry, sweep
+checkpointing."""
+from . import par2gen, telemetry
 from .checkpoint import SweepCheckpoint
 from .observability import (
     get_logger,
@@ -14,5 +15,5 @@ from .par2gen import GtoH, GtoP, HtoG, HtoP, LinearBlockCode
 __all__ = [
     "par2gen", "HtoG", "GtoH", "HtoP", "GtoP", "LinearBlockCode",
     "SweepCheckpoint", "stage_timer", "timings", "reset_timings",
-    "profile_trace", "get_logger", "log_record",
+    "profile_trace", "get_logger", "log_record", "telemetry",
 ]

@@ -10,6 +10,7 @@ from __future__ import annotations
 import contextlib
 import json
 import logging
+import threading
 import time
 from collections import defaultdict
 
@@ -17,6 +18,9 @@ __all__ = ["stage_timer", "timings", "reset_timings", "profile_trace",
            "get_logger", "log_record"]
 
 _TIMINGS: dict[str, list[float]] = defaultdict(list)
+# windowed_count / drain_double_buffered launch from multiple in-flight
+# batches; append and snapshot interleave without this lock
+_TIMINGS_LOCK = threading.Lock()
 
 
 @contextlib.contextmanager
@@ -25,28 +29,40 @@ def stage_timer(name: str):
 
     with stage_timer("decode"):
         sim.WordErrorRate(...)
+
+    When utils.telemetry is enabled, every stage timer is ALSO a telemetry
+    span: the duration lands in the span histogram and the region is
+    annotated on the xprof timeline (utils/telemetry.span).
     """
+    from . import telemetry
+
     t0 = time.perf_counter()
     try:
-        yield
+        with telemetry.span(name):
+            yield
     finally:
-        _TIMINGS[name].append(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        with _TIMINGS_LOCK:
+            _TIMINGS[name].append(dt)
 
 
 def timings() -> dict[str, dict]:
     """Summary of accumulated stage timings: count / total / mean seconds."""
+    with _TIMINGS_LOCK:
+        items = {name: list(vals) for name, vals in _TIMINGS.items()}
     return {
         name: {
             "count": len(vals),
             "total_s": round(sum(vals), 6),
             "mean_s": round(sum(vals) / len(vals), 6),
         }
-        for name, vals in _TIMINGS.items()
+        for name, vals in items.items() if vals
     }
 
 
 def reset_timings() -> None:
-    _TIMINGS.clear()
+    with _TIMINGS_LOCK:
+        _TIMINGS.clear()
 
 
 @contextlib.contextmanager

@@ -1,0 +1,711 @@
+"""Engine-wide telemetry: metrics registry, trace spans, run events, sinks.
+
+The reference has no instrumentation at all (notebooks time whole sweeps with
+``time.time()`` prints, SURVEY §5) and the port so far exposed only the
+``stage_timer`` wall-clock dict.  This module is the observability substrate
+every perf/robustness decision cites numbers from:
+
+  * a process-wide, thread-safe **metrics registry** — counters, gauges and
+    fixed-bucket histograms — with an in-memory snapshot and a
+    Prometheus-style text exposition;
+  * hierarchical **trace spans** that wrap ``jax.named_scope`` +
+    ``jax.profiler.TraceAnnotation`` so host-side stages line up with XLA
+    regions in xprof traces, and whose wall-clock lands in per-span duration
+    histograms;
+  * a **JAX compile/retrace tracker** riding ``jax.monitoring`` duration
+    events (``/jax/core/compile/*``), with a pjit cache-miss-count fallback
+    for builds that drop the monitoring hooks;
+  * pluggable **sinks**: the in-memory snapshot, a JSONL event stream
+    (rendered by ``scripts/telemetry_report.py``), and ``prometheus_text()``.
+
+Everything is behind one enable switch and costs **nothing when disabled**:
+every hot-path helper (``count`` / ``observe`` / ``set_gauge`` / ``span`` /
+``event``) starts with a single module-global boolean check and returns a
+shared no-op immediately.  Enabled, the host-side cost is a dict lookup and a
+lock per record — negligible next to a device dispatch.
+
+Device-side accumulation: per-shot decoder statistics (BP convergence,
+iteration counts, OSD routing) never trigger host syncs of their own.  The
+sim engines fold a small int32 telemetry vector (``TELE_LEN`` slots, layout
+below) through the same megabatch carry as the failure counts, and publish
+it with ``publish_device_tele`` at the one host sync the run already pays.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import math
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "enable", "disable", "reset", "session",
+    "count", "observe", "set_gauge", "span", "event",
+    "counter", "gauge", "histogram", "snapshot", "prometheus_text",
+    "registry", "add_sink", "remove_sink", "JsonlSink", "MemorySink",
+    "write_snapshot_event", "compile_stats",
+    "ITER_BUCKETS", "TELE_LEN", "device_tele_vec", "publish_device_tele",
+    "record_bp_aux",
+]
+
+# ---------------------------------------------------------------------------
+# Metric primitives
+# ---------------------------------------------------------------------------
+
+# span-duration histogram edges (seconds, ~half-decade): dispatch latencies
+# span 1e-4 (eager CPU op) .. 1e2 (whole sweeps)
+DEFAULT_TIME_BUCKETS = (
+    1e-4, 3.2e-4, 1e-3, 3.2e-3, 1e-2, 3.2e-2, 0.1, 0.32, 1.0, 3.2, 10.0,
+    32.0, 100.0,
+)
+
+# BP iterations-to-convergence histogram (upper-inclusive edges + overflow);
+# shared by the device telemetry vector and the host-side recorder so the
+# two accumulation paths merge into ONE registry histogram
+ITER_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+
+
+class Counter:
+    """Monotonic counter.  ``inc`` under the registry lock."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.value = 0
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+
+    def to_dict(self):
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    """Last-write-wins scalar (plus a high-water mark for depth-style gauges)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, lock: threading.Lock):
+        self.name = name
+        self._lock = lock
+        self.value = 0
+        self.max_value = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+            if v > self.max_value:
+                self.max_value = v
+
+    def to_dict(self):
+        return {"type": "gauge", "value": self.value, "max": self.max_value}
+
+
+class Histogram:
+    """Fixed-bucket histogram: counts per upper-inclusive edge + overflow,
+    plus exact ``sum``/``count`` (Prometheus-histogram compatible)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, lock: threading.Lock, buckets=None):
+        self.name = name
+        self._lock = lock
+        self.buckets = tuple(buckets if buckets is not None
+                             else DEFAULT_TIME_BUCKETS)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.sum = 0.0
+        self.count = 0
+
+    def _bucket_index(self, v) -> int:
+        for i, edge in enumerate(self.buckets):
+            if v <= edge:
+                return i
+        return len(self.buckets)
+
+    def observe(self, v):
+        with self._lock:
+            self.counts[self._bucket_index(v)] += 1
+            self.sum += v
+            self.count += 1
+
+    def merge_counts(self, counts, total_sum, total_count):
+        """Fold pre-bucketed counts (device-side accumulation) in one shot.
+        ``counts`` must have len(buckets)+1 entries (overflow last)."""
+        assert len(counts) == len(self.counts), (
+            f"{self.name}: bucket shape mismatch "
+            f"({len(counts)} vs {len(self.counts)})")
+        with self._lock:
+            for i, c in enumerate(counts):
+                self.counts[i] += int(c)
+            self.sum += float(total_sum)
+            self.count += int(total_count)
+
+    def to_dict(self):
+        return {
+            "type": "histogram", "buckets": list(self.buckets),
+            "counts": list(self.counts), "sum": self.sum, "count": self.count,
+            "mean": (self.sum / self.count) if self.count else None,
+        }
+
+
+class MetricsRegistry:
+    """Process-wide, thread-safe name -> metric map.
+
+    One lock guards creation and every mutation (metrics share it): the
+    enabled-path cost is one lock round-trip per record, far below the
+    dispatch latencies being measured; the disabled path never gets here.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, name: str, cls, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = self._metrics[name] = cls(name, self._lock, **kw)
+        if not isinstance(m, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {type(m).__name__}, "
+                f"requested {cls.__name__}")
+        return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str, buckets=None) -> Histogram:
+        return self._get(name, Histogram, buckets=buckets)
+
+    def snapshot(self) -> dict:
+        """In-memory sink: {name: metric dict}, a deep copy safe to mutate.
+        Built entirely under the shared lock (metrics mutate under the same
+        lock) so a concurrent ``observe`` can't tear a histogram's
+        counts/sum/count mid-copy."""
+        with self._lock:
+            return {name: m.to_dict()
+                    for name, m in sorted(self._metrics.items())}
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+# ---------------------------------------------------------------------------
+# Module state: the global registry, the enable switch, sinks
+# ---------------------------------------------------------------------------
+_REGISTRY = MetricsRegistry()
+_ENABLED = False            # the single hot-path check
+_SINKS: list = []
+_SINK_LOCK = threading.Lock()
+_SPAN_STACK = threading.local()
+
+
+def registry() -> MetricsRegistry:
+    return _REGISTRY
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def counter(name: str) -> Counter:
+    return _REGISTRY.counter(name)
+
+
+def gauge(name: str) -> Gauge:
+    return _REGISTRY.gauge(name)
+
+
+def histogram(name: str, buckets=None) -> Histogram:
+    return _REGISTRY.histogram(name, buckets)
+
+
+def snapshot() -> dict:
+    return _REGISTRY.snapshot()
+
+
+def reset() -> None:
+    """Clear all metrics (the enable switch and sinks are untouched)."""
+    _REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# Hot-path helpers — one boolean check when disabled
+# ---------------------------------------------------------------------------
+def count(name: str, n=1) -> None:
+    if not _ENABLED:
+        return
+    _REGISTRY.counter(name).inc(n)
+
+
+def set_gauge(name: str, value) -> None:
+    if not _ENABLED:
+        return
+    _REGISTRY.gauge(name).set(value)
+
+
+def observe(name: str, value, buckets=None) -> None:
+    if not _ENABLED:
+        return
+    _REGISTRY.histogram(name, buckets).observe(value)
+
+
+class _NullContext:
+    """Shared allocation-free no-op context (disabled spans)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_CONTEXT = _NullContext()
+
+
+@contextlib.contextmanager
+def _span_enabled(name: str):
+    stack = getattr(_SPAN_STACK, "stack", None)
+    if stack is None:
+        stack = _SPAN_STACK.stack = []
+    path = "/".join(stack + [name]) if stack else name
+    stack.append(name)
+    # xprof alignment: named_scope tags any ops traced inside the span;
+    # TraceAnnotation puts the host slice itself on the profiler timeline.
+    # Both are best-effort — telemetry must work without a live jax.
+    cms = []
+    try:
+        import jax
+
+        cms.append(jax.named_scope(name))
+        cms.append(jax.profiler.TraceAnnotation(path))
+    except Exception:
+        cms = []
+    t0 = time.perf_counter()
+    try:
+        with contextlib.ExitStack() as es:
+            for cm in cms:
+                try:
+                    es.enter_context(cm)
+                except Exception:
+                    pass
+            yield
+    finally:
+        dt = time.perf_counter() - t0
+        stack.pop()
+        _REGISTRY.histogram(f"span.{path}.seconds").observe(dt)
+
+
+def span(name: str):
+    """Hierarchical trace span.  Nested spans join into a ``/``-path (per
+    thread); each span records wall-clock into ``span.<path>.seconds`` and
+    annotates the xprof timeline.  A shared no-op when disabled."""
+    if not _ENABLED:
+        return _NULL_CONTEXT
+    return _span_enabled(name)
+
+
+def event(kind: str, **fields) -> None:
+    """Emit one structured run event to every installed sink (JSONL etc.).
+    No-op when disabled."""
+    if not _ENABLED:
+        return
+    rec = {"ts": round(time.time(), 6), "kind": kind, **fields}
+    with _SINK_LOCK:
+        sinks = list(_SINKS)
+    for s in sinks:
+        try:
+            s.emit(rec)
+        except Exception:  # a broken sink must not kill the run
+            pass
+
+
+# ---------------------------------------------------------------------------
+# Sinks
+# ---------------------------------------------------------------------------
+class JsonlSink:
+    """Append-only JSONL event stream; one json object per line, flushed per
+    event so crashed runs keep their tail.  Render with
+    ``scripts/telemetry_report.py``."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._lock = threading.Lock()
+        self._fh = open(self.path, "a", encoding="utf-8")
+
+    def emit(self, record: dict):
+        line = json.dumps(record, sort_keys=True, default=str)
+        with self._lock:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def close(self):
+        with self._lock:
+            if not self._fh.closed:
+                self._fh.close()
+
+
+class MemorySink:
+    """Collects events in a list (tests, notebooks)."""
+
+    def __init__(self):
+        self.records: list[dict] = []
+        self._lock = threading.Lock()
+
+    def emit(self, record: dict):
+        with self._lock:
+            self.records.append(record)
+
+    def close(self):
+        pass
+
+
+def add_sink(sink) -> None:
+    with _SINK_LOCK:
+        _SINKS.append(sink)
+
+
+def remove_sink(sink) -> None:
+    with _SINK_LOCK:
+        if sink in _SINKS:
+            _SINKS.remove(sink)
+
+
+def write_snapshot_event(**extra_fields) -> dict:
+    """Emit the full metrics snapshot (plus compile stats) as one
+    ``kind="snapshot"`` event; returns the snapshot dict."""
+    snap = snapshot()
+    stats = compile_stats()
+    event("snapshot", metrics=snap, compile=stats, **extra_fields)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# Enable switch
+# ---------------------------------------------------------------------------
+_OWNED_SINKS: list = []
+
+
+def enable(jsonl_path: str | None = None) -> None:
+    """Turn telemetry on.  ``jsonl_path``: additionally stream run events to
+    a JSONL file (``scripts/telemetry_report.py`` renders it).  Idempotent —
+    a second ``enable`` while already on keeps the switch and existing
+    sinks (never duplicating a stream), though an explicit NEW ``jsonl_path``
+    still gets its sink.  Honors the ``QLDPC_TELEMETRY_JSONL`` env var when
+    no path is given.  Installs the JAX compile/retrace tracker on first
+    call."""
+    global _ENABLED
+    if _ENABLED:
+        # already on: honor an EXPLICIT new stream path (a dropped path
+        # would silently lose the run's events), but never duplicate a
+        # sink on a path already streaming
+        if jsonl_path is not None:
+            with _SINK_LOCK:
+                streaming = any(isinstance(s, JsonlSink)
+                                and s.path == str(jsonl_path)
+                                for s in _SINKS)
+            if not streaming:
+                s = JsonlSink(jsonl_path)
+                _OWNED_SINKS.append(s)
+                add_sink(s)
+        return
+    _install_compile_tracker()
+    if not _TRACKER_STATE["listener_fired"]:
+        # scope the cache-miss fallback delta to this enabled region, not
+        # process lifetime (warmups compile before the first enable)
+        _TRACKER_STATE["miss_baseline"] = _cache_miss_count()
+    if jsonl_path is None:
+        jsonl_path = os.environ.get("QLDPC_TELEMETRY_JSONL") or None
+    if jsonl_path is not None:
+        s = JsonlSink(jsonl_path)
+        _OWNED_SINKS.append(s)
+        add_sink(s)
+    _ENABLED = True
+    event("telemetry_enabled", pid=os.getpid())
+
+
+def disable() -> None:
+    """Turn telemetry off and close sinks ``enable`` opened.  Metrics stay
+    in the registry until ``reset()``."""
+    global _ENABLED
+    _ENABLED = False
+    while _OWNED_SINKS:
+        s = _OWNED_SINKS.pop()
+        remove_sink(s)
+        try:
+            s.close()
+        except Exception:
+            pass
+
+
+@contextlib.contextmanager
+def session(jsonl_path: str | None = None, reset_metrics: bool = True):
+    """One telemetry-enabled region: enable, yield the registry, emit a
+    final snapshot event, disable.  The bench and tests use this so runs
+    can't leak an enabled switch.  Nested inside an already-enabled region
+    (e.g. a parity sweep enabled via env var) it leaves the outer enable,
+    sinks, and accumulated metrics untouched — ``reset_metrics`` is ignored
+    (the registry belongs to the outer region) but ``jsonl_path`` still
+    gets its own stream for the session's events + final snapshot."""
+    was_enabled = _ENABLED
+    own_sink = None
+    if was_enabled:
+        if jsonl_path is not None:
+            own_sink = JsonlSink(jsonl_path)
+            add_sink(own_sink)
+    else:
+        if reset_metrics:
+            reset()
+        enable(jsonl_path)
+    try:
+        yield _REGISTRY
+    finally:
+        write_snapshot_event()
+        if own_sink is not None:
+            remove_sink(own_sink)
+            own_sink.close()
+        if not was_enabled:
+            disable()
+
+
+# ---------------------------------------------------------------------------
+# JAX compile / retrace tracker
+# ---------------------------------------------------------------------------
+# jax.monitoring duration events -> counter names (jax 0.4.x dispatch.py)
+_COMPILE_EVENTS = {
+    "/jax/core/compile/jaxpr_trace_duration": "jax.retraces",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "jax.lowerings",
+    "/jax/core/compile/backend_compile_duration": "jax.backend_compiles",
+}
+_TRACKER_STATE = {"installed": False, "listener_fired": False,
+                  "miss_baseline": None}
+
+
+def _cache_miss_count():
+    """Fallback signal: cumulative pjit jaxpr-cache misses (each miss is a
+    retrace).  Internal API, so best-effort — returns None when the cache
+    object moved."""
+    try:
+        from jax._src import pjit as _pjit
+
+        for attr in ("_create_pjit_jaxpr", "_infer_params_cached"):
+            fn = getattr(_pjit, attr, None)
+            info = getattr(fn, "cache_info", None)
+            if info is not None:
+                return int(info().misses)
+    except Exception:
+        pass
+    return None
+
+
+def _install_compile_tracker() -> None:
+    """Register jax.monitoring listeners counting retraces / lowerings /
+    backend compiles and their wall-clock.  Listeners cannot be
+    unregistered individually, so they are installed once and check the
+    enable switch themselves (one boolean when disabled)."""
+    if _TRACKER_STATE["installed"]:
+        return
+    _TRACKER_STATE["installed"] = True
+    _TRACKER_STATE["miss_baseline"] = _cache_miss_count()
+    try:
+        from jax import monitoring as _mon
+
+        def _on_duration(ev, duration_secs, **kw):
+            if not _ENABLED:
+                return
+            name = _COMPILE_EVENTS.get(ev)
+            if name is None:
+                return
+            _TRACKER_STATE["listener_fired"] = True
+            reg = _REGISTRY
+            reg.counter(name).inc()
+            reg.counter(name + ".seconds").inc(float(duration_secs))
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+    except Exception:
+        pass
+
+
+def compile_stats() -> dict:
+    """Retrace/compile counts for the snapshot.  ``retraces`` prefers the
+    jax.monitoring listener; when it never fired (hookless builds) the
+    pjit cache-miss delta since the tracker was installed stands in."""
+    snap = _REGISTRY.snapshot()
+    out = {name: snap.get(name, {}).get("value", 0)
+           for name in _COMPILE_EVENTS.values()}
+    out["source"] = "jax.monitoring"
+    if not _TRACKER_STATE["listener_fired"]:
+        misses = _cache_miss_count()
+        base = _TRACKER_STATE["miss_baseline"]
+        if misses is not None and base is not None:
+            out["jax.retraces"] = misses - base
+            out["source"] = "pjit_cache_misses"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Prometheus-style text exposition
+# ---------------------------------------------------------------------------
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    return "qldpc_" + (s if not s[:1].isdigit() else "_" + s)
+
+
+def _prom_num(v) -> str:
+    if isinstance(v, float) and math.isinf(v):
+        return "+Inf" if v > 0 else "-Inf"
+    return repr(float(v)) if isinstance(v, float) else str(v)
+
+
+def prometheus_text(snap: dict | None = None) -> str:
+    """Render a snapshot in the Prometheus text exposition format (counters,
+    gauges, cumulative-bucket histograms)."""
+    snap = snapshot() if snap is None else snap
+    lines = []
+    for name, m in snap.items():
+        pn = _prom_name(name)
+        kind = m["type"]
+        lines.append(f"# TYPE {pn} {kind}")
+        if kind == "counter":
+            lines.append(f"{pn} {_prom_num(m['value'])}")
+        elif kind == "gauge":
+            lines.append(f"{pn} {_prom_num(m['value'])}")
+            lines.append(f"{pn}_max {_prom_num(m['max'])}")
+        else:  # histogram: cumulative buckets + +Inf + _sum/_count
+            acc = 0
+            for edge, c in zip(m["buckets"], m["counts"]):
+                acc += c
+                lines.append(f'{pn}_bucket{{le="{_prom_num(edge)}"}} {acc}')
+            acc += m["counts"][-1]
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {acc}')
+            lines.append(f"{pn}_sum {_prom_num(m['sum'])}")
+            lines.append(f"{pn}_count {m['count']}")
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Device-side telemetry vector (folded through the megabatch carry)
+# ---------------------------------------------------------------------------
+# int32 slot layout — counts fold across batches on device and publish at
+# the run's one host sync.  int32 bounds: shot counts fit to ~2e9 shots per
+# WordErrorRate call; the iteration sum covers CONVERGED shots only, so it
+# holds ~2^31 / mean_iters shots per call (~1.5e9 at the p=0.01 mean of
+# 1.35, ~1.4e8 at a worst-case mean of 15) — publish_device_tele detects a
+# wrapped sum and falls back to a bucket-midpoint estimate.
+TELE_BP_SHOTS = 0        # decoder shots counted (both sectors)
+TELE_BP_CONVERGED = 1    # ... of which BP converged within max_iter
+TELE_OSD_SHOTS = 2       # shots routed to a device-OSD stage
+TELE_ITER_SUM = 3        # sum of iterations over CONVERGED shots
+TELE_ITER_HIST0 = 4      # + len(ITER_BUCKETS)+1 histogram slots
+TELE_LEN = TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1
+
+
+def device_tele_vec(aux_by_static) -> "object":
+    """Build the (TELE_LEN,) int32 telemetry vector INSIDE a jitted stats
+    batch.  ``aux_by_static``: iterable of ``(decoder_device_static, aux)``
+    pairs as returned by ``decoders.bp_decoders.decode_device``.  Decoders
+    without BP aux (FirstMin) contribute nothing; BPOSD device statics
+    additionally count their OSD-routed shots (= BP non-converged).
+    Iteration stats cover CONVERGED shots only — non-converged shots sit at
+    ``iterations == max_iter`` and would inflate the mean under a label
+    that claims convergence semantics."""
+    import jax.numpy as jnp
+
+    edges = jnp.asarray(ITER_BUCKETS, jnp.int32)
+    nb = len(ITER_BUCKETS) + 1
+    shots = jnp.zeros((), jnp.int32)
+    conv = jnp.zeros((), jnp.int32)
+    osd = jnp.zeros((), jnp.int32)
+    it_sum = jnp.zeros((), jnp.int32)
+    hist = jnp.zeros((nb,), jnp.int32)
+    for static, aux in aux_by_static:
+        c = aux.get("converged")
+        if c is None:
+            continue
+        shots = shots + jnp.asarray(c.shape[0], jnp.int32)
+        conv = conv + c.sum(dtype=jnp.int32)
+        if static and static[0] == "bposd_dev":
+            osd = osd + (~c).sum(dtype=jnp.int32)
+        it = aux.get("iterations")
+        if it is not None:
+            cmask = c.astype(jnp.int32)
+            it_sum = it_sum + (it.astype(jnp.int32) * cmask).sum()
+            idx = jnp.searchsorted(edges, it.astype(jnp.int32))
+            hist = hist.at[idx].add(cmask)
+    return jnp.concatenate([
+        shots[None], conv[None], osd[None], it_sum[None], hist,
+    ]).astype(jnp.int32)
+
+
+def _approx_iter_sum(counts) -> int:
+    """Bucket-midpoint estimate of the iteration sum — the fallback when
+    the device int32 sum slot wrapped on a huge run."""
+    total, lo = 0, 0
+    for edge, c in zip(ITER_BUCKETS, counts):
+        total += int(c) * (lo + 1 + edge) // 2
+        lo = edge
+    total += int(counts[len(ITER_BUCKETS)]) * (ITER_BUCKETS[-1] * 3 // 2)
+    return total
+
+
+def publish_device_tele(vec) -> None:
+    """Fold a host-fetched device telemetry vector into the registry (the
+    engines call this right after their one host sync)."""
+    if not _ENABLED:
+        return
+    import numpy as np
+
+    v = np.asarray(vec).astype(np.int64)
+    if int(v[TELE_BP_SHOTS]) == 0:
+        return
+    _REGISTRY.counter("bp.shots").inc(int(v[TELE_BP_SHOTS]))
+    _REGISTRY.counter("bp.converged").inc(int(v[TELE_BP_CONVERGED]))
+    if int(v[TELE_OSD_SHOTS]):
+        _REGISTRY.counter("osd.device_shots").inc(int(v[TELE_OSD_SHOTS]))
+    hist = _REGISTRY.histogram("bp.iterations", ITER_BUCKETS)
+    counts = v[TELE_ITER_HIST0:TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1]
+    it_sum = int(v[TELE_ITER_SUM])
+    if it_sum < 0:  # int32 carry slot wrapped (see TELE_ITER_SUM bound)
+        it_sum = _approx_iter_sum(counts)
+    hist.merge_counts(counts, it_sum, int(counts.sum()))
+
+
+def record_bp_aux(aux) -> None:
+    """Host-side twin of ``device_tele_vec`` for the windowed / OSD-host
+    paths, where the decoder aux is already being fetched: records into the
+    SAME registry metrics (converged-only iteration stats included) so both
+    accumulation paths merge.  OSD routing is counted where it happens
+    (``osd_postprocess``), not here."""
+    if not _ENABLED:
+        return
+    import numpy as np
+
+    conv = aux.get("converged") if isinstance(aux, dict) else None
+    if conv is None:
+        return
+    conv = np.asarray(conv).astype(bool).ravel()
+    _REGISTRY.counter("bp.shots").inc(int(conv.size))
+    _REGISTRY.counter("bp.converged").inc(int(conv.sum()))
+    it = aux.get("iterations")
+    if it is not None:
+        it = np.asarray(it).ravel().astype(np.int64)[conv]
+        edges = np.asarray(ITER_BUCKETS, np.int64)
+        idx = np.searchsorted(edges, it)
+        counts = np.bincount(idx, minlength=len(ITER_BUCKETS) + 1)
+        _REGISTRY.histogram("bp.iterations", ITER_BUCKETS).merge_counts(
+            counts, int(it.sum()), int(it.size))

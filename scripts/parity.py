@@ -412,6 +412,15 @@ def main():
                          "one in the same kernel session)")
     args = ap.parse_args()
     global RESULTS
+    if os.environ.get("QLDPC_TELEMETRY_JSONL"):
+        # bench.py (and operators) opt sweeps into the telemetry event
+        # stream via env; the final snapshot lands when the run exits
+        import atexit
+
+        from qldpc_fault_tolerance_tpu.utils import telemetry
+
+        telemetry.enable()  # enable() reads QLDPC_TELEMETRY_JSONL itself
+        atexit.register(telemetry.write_snapshot_event)
     if args.no_record:
         RESULTS = os.devnull
     if args.warmup:
@@ -424,6 +433,16 @@ def main():
                        circuit_type=args.circuit_type, members=args.members,
                        msf=args.msf, p_scale=args.p_scale)
         RESULTS = real_results
+        if os.environ.get("QLDPC_TELEMETRY_JSONL"):
+            # elapsed_s measures the warm sweep alone, so the final
+            # snapshot's counters must not include the warmup pass either;
+            # the disable/enable cycle also re-baselines the pjit
+            # cache-miss retrace fallback past the warmup compiles
+            from qldpc_fault_tolerance_tpu.utils import telemetry
+
+            telemetry.disable()
+            telemetry.reset()
+            telemetry.enable()
     exp = EXPERIMENTS[args.experiment]
     cycles_list = args.cycles or sorted(exp["published"])
     run_experiment(args.experiment, cycles_list, args.seeds, args.scale,

@@ -1,0 +1,211 @@
+"""Render a telemetry JSONL run (utils.telemetry JsonlSink) into a human
+summary table.
+
+    python scripts/telemetry_report.py RUN.jsonl            # text table
+    python scripts/telemetry_report.py RUN.jsonl --json     # summary json
+    python scripts/telemetry_report.py RUN.jsonl --prometheus
+
+The stream is the one ``telemetry.enable(jsonl_path=...)`` (or
+``QLDPC_TELEMETRY_JSONL=...``) writes: ``wer_run`` / ``cell_done`` events as
+the run progresses and a final ``snapshot`` event carrying the full metrics
+registry + compile stats (``telemetry.write_snapshot_event`` /
+``telemetry.session``).  Metrics are cumulative, so the LAST snapshot wins.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def load_events(path: str) -> list[dict]:
+    """Parse one JSONL stream; unparseable lines are skipped (a crashed run
+    may truncate its last line) but counted."""
+    events, bad = [], 0
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                bad += 1
+    if bad:
+        print(f"warning: skipped {bad} unparseable line(s)", file=sys.stderr)
+    return events
+
+
+def _metric(snap: dict, name: str, field: str = "value", default=0):
+    return snap.get(name, {}).get(field, default)
+
+
+def summarize(events: list[dict]) -> dict:
+    """Aggregate an event stream into one summary dict (the --json output;
+    the text table renders from this)."""
+    kinds: dict[str, int] = {}
+    for e in events:
+        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
+    snapshots = [e for e in events if e.get("kind") == "snapshot"]
+    snap = snapshots[-1].get("metrics", {}) if snapshots else {}
+    compile_stats = snapshots[-1].get("compile", {}) if snapshots else {}
+    ts = [e["ts"] for e in events if "ts" in e]
+
+    bp_shots = _metric(snap, "bp.shots")
+    bp_conv = _metric(snap, "bp.converged")
+    iters = snap.get("bp.iterations", {})
+    osd_host_shots = _metric(snap, "osd.shots")
+    osd_dev_shots = _metric(snap, "osd.device_shots")
+    spans = {
+        name[len("span."):-len(".seconds")]: m
+        for name, m in snap.items()
+        if name.startswith("span.") and m.get("type") == "histogram"
+    }
+    return {
+        "events": kinds,
+        "wall_s": (round(max(ts) - min(ts), 3) if len(ts) > 1 else 0.0),
+        "shots": _metric(snap, "sim.shots"),
+        "failures": _metric(snap, "sim.failures"),
+        "runs": _metric(snap, "sim.runs"),
+        "sweep_cells": _metric(snap, "sweep.cells"),
+        "dispatches": _metric(snap, "driver.dispatches"),
+        "batches": _metric(snap, "driver.batches"),
+        "early_stops": _metric(snap, "driver.early_stops"),
+        "drain_depth_max": _metric(snap, "driver.drain_depth", "max"),
+        "bp": {
+            "shots": bp_shots,
+            "converged": bp_conv,
+            "converged_fraction": (round(bp_conv / bp_shots, 6)
+                                   if bp_shots else None),
+            "iterations_mean": iters.get("mean"),
+            "iterations_buckets": iters.get("buckets"),
+            "iterations_counts": iters.get("counts"),
+        },
+        "osd": {
+            "invocations": _metric(snap, "osd.invocations"),
+            "host_shots": osd_host_shots,
+            "device_shots": osd_dev_shots,
+            "shots": osd_host_shots + osd_dev_shots,
+            "host_round_trips": _metric(snap, "osd.host_round_trips"),
+        },
+        "jax": {
+            "retraces": compile_stats.get(
+                "jax.retraces", _metric(snap, "jax.retraces")),
+            "lowerings": compile_stats.get(
+                "jax.lowerings", _metric(snap, "jax.lowerings")),
+            "backend_compiles": compile_stats.get(
+                "jax.backend_compiles", _metric(snap, "jax.backend_compiles")),
+            "backend_compile_s": round(
+                _metric(snap, "jax.backend_compiles.seconds"), 3),
+            "retrace_source": compile_stats.get("source"),
+        },
+        "spans": {
+            name: {"count": m["count"], "total_s": round(m["sum"], 4),
+                   "mean_s": (round(m["sum"] / m["count"], 5)
+                              if m["count"] else None)}
+            for name, m in sorted(spans.items())
+        },
+        "snapshot": snap,
+    }
+
+
+def _bar(n: int, peak: int, width: int = 30) -> str:
+    return "#" * max(1 if n else 0, round(width * n / peak)) if peak else ""
+
+
+def render(summary: dict, title: str = "") -> str:
+    """The human table."""
+    s = summary
+    L = [f"== qldpc telemetry report{': ' + title if title else ''} =="]
+    ev = ", ".join(f"{v} {k}" for k, v in sorted(s["events"].items()))
+    L.append(f"events: {ev}   (span {s['wall_s']}s wall)")
+    L.append("")
+    L.append("-- runs --")
+    rows = [
+        ("shots", s["shots"]), ("failures", s["failures"]),
+        ("wer runs", s["runs"]), ("sweep cells", s["sweep_cells"]),
+        ("dispatches", s["dispatches"]), ("batches", s["batches"]),
+        ("early stops", s["early_stops"]),
+        ("drain depth (max)", s["drain_depth_max"]),
+    ]
+    if s["shots"]:
+        rows.insert(2, ("failure fraction",
+                        round(s["failures"] / s["shots"], 6)))
+    for k, v in rows:
+        L.append(f"  {k:<22}{v}")
+    bp = s["bp"]
+    L.append("-- bp decoder --")
+    L.append(f"  {'decoder shots':<22}{bp['shots']}")
+    if bp["shots"]:
+        L.append(f"  {'converged':<22}{bp['converged']}"
+                 f"  ({100 * bp['converged_fraction']:.2f}%)")
+        if bp["iterations_mean"] is not None:
+            L.append(f"  iterations to convergence "
+                     f"(mean {bp['iterations_mean']:.2f}):")
+            buckets = bp["iterations_buckets"] or []
+            counts = bp["iterations_counts"] or []
+            peak = max(counts) if counts else 0
+            labels = [f"<={b}" for b in buckets] + [f">{buckets[-1]}"
+                                                    if buckets else ">"]
+            for lab, n in zip(labels, counts):
+                if n:
+                    L.append(f"    {lab:>6} {n:>10}  {_bar(n, peak)}")
+    osd = s["osd"]
+    L.append("-- osd --")
+    L.append(f"  {'invocations':<22}{osd['invocations']}")
+    L.append(f"  {'shots':<22}{osd['shots']}"
+             f"  (host {osd['host_shots']}, device {osd['device_shots']})")
+    L.append(f"  {'host round-trips':<22}{osd['host_round_trips']}")
+    j = s["jax"]
+    L.append("-- jax compile --")
+    L.append(f"  retraces {j['retraces']}   lowerings {j['lowerings']}   "
+             f"backend compiles {j['backend_compiles']} "
+             f"({j['backend_compile_s']}s)"
+             + (f"   [source: {j['retrace_source']}]"
+                if j.get("retrace_source") else ""))
+    if s["spans"]:
+        L.append("-- spans --")
+        w = max(len(n) for n in s["spans"]) + 2
+        L.append(f"  {'name':<{w}}{'count':>7}{'total_s':>12}{'mean_s':>12}")
+        for name, m in s["spans"].items():
+            L.append(f"  {name:<{w}}{m['count']:>7}{m['total_s']:>12}"
+                     f"{m['mean_s']:>12}")
+    return "\n".join(L)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="telemetry JSONL stream to render")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the summary as json instead of the table")
+    ap.add_argument("--prometheus", action="store_true",
+                    help="emit the final snapshot in Prometheus text format")
+    args = ap.parse_args(argv)
+
+    events = load_events(args.jsonl)
+    if not events:
+        print(f"no events in {args.jsonl}", file=sys.stderr)
+        return 1
+    summary = summarize(events)
+    if args.prometheus:
+        from qldpc_fault_tolerance_tpu.utils import telemetry
+
+        sys.stdout.write(telemetry.prometheus_text(summary["snapshot"]))
+        return 0
+    if args.json:
+        out = dict(summary)
+        out.pop("snapshot")  # the raw registry dump is --prometheus/json-able
+        print(json.dumps(out, indent=1, default=str))
+        return 0
+    print(render(summary, title=os.path.basename(args.jsonl)))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:  # e.g. `... | head` — not an error
+        raise SystemExit(0)

@@ -124,7 +124,7 @@ def test_fused_sim_stats_backends_agree(spec):
     )
     key = jax.random.PRNGKey(77)
     cfg = sim._cfg(256)
-    cnt_xla, mw_xla = de._stats_fused(cfg, sim._dev_state, key)
+    (cnt_xla, mw_xla), _, _ = de._stats_fused(cfg, sim._dev_state, key)
     # force the pallas-interpret route through the public dispatchers
     spec_ = sim._dev_state["fspec"]
     sxp, szp = gp.sample_syndrome(spec_, key, 256, backend="pallas",

@@ -1,0 +1,458 @@
+"""Telemetry layer tests (ISSUE 2): registry semantics, span nesting,
+disabled-mode no-op, JSONL round-trip through scripts/telemetry_report,
+device/host metric accumulation from real WordErrorRate runs on CPU, and
+the no-bare-print library guard."""
+import importlib
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.utils import telemetry
+
+LIB_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "qldpc_fault_tolerance_tpu")
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    """Every test starts disabled with an empty registry and leaves no
+    enabled switch behind."""
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+def test_counter_gauge_histogram_semantics():
+    telemetry.enable()
+    telemetry.count("c", 2)
+    telemetry.count("c")
+    telemetry.set_gauge("g", 7)
+    telemetry.set_gauge("g", 3)
+    for v in (0.5, 1.5, 99.0):
+        telemetry.observe("h", v, buckets=(1.0, 10.0))
+    snap = telemetry.snapshot()
+    assert snap["c"] == {"type": "counter", "value": 3}
+    assert snap["g"]["value"] == 3 and snap["g"]["max"] == 7
+    h = snap["h"]
+    assert h["counts"] == [1, 1, 1]  # <=1, <=10, overflow
+    assert h["count"] == 3 and h["sum"] == pytest.approx(101.0)
+    assert h["mean"] == pytest.approx(101.0 / 3)
+
+
+def test_metric_kind_collision_raises():
+    telemetry.enable()
+    telemetry.count("m")
+    with pytest.raises(TypeError):
+        telemetry.registry().gauge("m")
+
+
+def test_histogram_merge_counts_matches_observe():
+    telemetry.enable()
+    h = telemetry.histogram("merge", buckets=telemetry.ITER_BUCKETS)
+    h.merge_counts([1] * (len(telemetry.ITER_BUCKETS) + 1), 100.0, 13)
+    assert h.count == 13
+    assert sum(h.counts) == 13
+    with pytest.raises(AssertionError):
+        h.merge_counts([1, 2], 0, 3)  # wrong bucket shape must not corrupt
+
+
+def test_registry_thread_safety():
+    telemetry.enable()
+
+    def work():
+        for _ in range(1000):
+            telemetry.count("t.c")
+            telemetry.observe("t.h", 0.01)
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = telemetry.snapshot()
+    assert snap["t.c"]["value"] == 8000
+    assert snap["t.h"]["count"] == 8000
+
+
+def test_stage_timer_thread_safety():
+    """Satellite: the legacy _TIMINGS global must survive concurrent
+    append + snapshot (windowed_count launches from in-flight batches)."""
+    from qldpc_fault_tolerance_tpu.utils.observability import (
+        reset_timings, stage_timer, timings)
+
+    reset_timings()
+    stop = threading.Event()
+
+    def writer():
+        while not stop.is_set():
+            with stage_timer("mt-stage"):
+                pass
+
+    def reader():
+        while not stop.is_set():
+            timings()
+
+    threads = [threading.Thread(target=writer) for _ in range(4)] + [
+        threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(0.2)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert timings()["mt-stage"]["count"] > 0
+    reset_timings()
+
+
+# ---------------------------------------------------------------------------
+# enable switch / disabled no-op
+# ---------------------------------------------------------------------------
+def test_disabled_mode_is_noop():
+    assert not telemetry.enabled()
+    telemetry.count("nope")
+    telemetry.set_gauge("nope.g", 1)
+    telemetry.observe("nope.h", 1.0)
+    telemetry.event("nope_event", x=1)
+    with telemetry.span("nope.span"):
+        pass
+    assert telemetry.snapshot() == {}
+
+
+def test_disabled_span_is_shared_noop_object():
+    a = telemetry.span("x")
+    b = telemetry.span("y")
+    assert a is b  # no per-call allocation on the disabled hot path
+
+
+def test_span_nesting_builds_paths():
+    telemetry.enable()
+    with telemetry.span("outer"):
+        with telemetry.span("inner"):
+            pass
+    snap = telemetry.snapshot()
+    assert "span.outer.seconds" in snap
+    assert "span.outer/inner.seconds" in snap
+    assert snap["span.outer/inner.seconds"]["count"] == 1
+
+
+def test_stage_timer_feeds_spans_when_enabled():
+    from qldpc_fault_tolerance_tpu.utils.observability import (
+        reset_timings, stage_timer, timings)
+
+    reset_timings()
+    telemetry.enable()
+    with stage_timer("bridged"):
+        pass
+    assert timings()["bridged"]["count"] == 1  # legacy dict still fed
+    assert "span.bridged.seconds" in telemetry.snapshot()
+    reset_timings()
+
+
+def test_session_nested_inside_enabled_region(tmp_path):
+    """A session() inside an already-enabled region (parity.py env-var
+    scenario) must keep the outer enable + metrics alive, not duplicate
+    sinks, and still stream its own JSONL."""
+    outer = telemetry.MemorySink()
+    telemetry.add_sink(outer)
+    try:
+        telemetry.enable()
+        telemetry.enable()  # idempotent: no second sink, no error
+        telemetry.count("outer.c", 7)
+        inner_path = str(tmp_path / "inner.jsonl")
+        with telemetry.session(inner_path):
+            telemetry.count("outer.c", 1)
+        assert telemetry.enabled(), "nested session killed the outer enable"
+        # reset_metrics must not wipe the outer region's registry
+        assert telemetry.snapshot()["outer.c"]["value"] == 8
+        telemetry.event("after_inner")
+        assert any(r["kind"] == "after_inner" for r in outer.records)
+        inner = [json.loads(line) for line in open(inner_path)]
+        assert any(e["kind"] == "snapshot" for e in inner)
+        assert not any(e["kind"] == "after_inner" for e in inner)
+    finally:
+        telemetry.remove_sink(outer)
+
+
+def test_session_context_round_trip(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with telemetry.session(path):
+        assert telemetry.enabled()
+        telemetry.count("s.c", 5)
+    assert not telemetry.enabled()
+    events = [json.loads(line) for line in open(path)]
+    kinds = [e["kind"] for e in events]
+    assert "telemetry_enabled" in kinds and "snapshot" in kinds
+    snap = [e for e in events if e["kind"] == "snapshot"][-1]
+    assert snap["metrics"]["s.c"]["value"] == 5
+
+
+# ---------------------------------------------------------------------------
+# sinks / exposition / report CLI
+# ---------------------------------------------------------------------------
+def test_memory_sink_receives_events():
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        telemetry.event("unit", a=1)
+        assert sink.records[-1]["kind"] == "unit"
+        assert sink.records[-1]["a"] == 1
+        assert "ts" in sink.records[-1]
+    finally:
+        telemetry.remove_sink(sink)
+
+
+def test_prometheus_text_format():
+    telemetry.enable()
+    telemetry.count("p.c", 4)
+    telemetry.observe("p.h", 0.5, buckets=(1.0,))
+    text = telemetry.prometheus_text()
+    assert "# TYPE qldpc_p_c counter" in text
+    assert "qldpc_p_c 4" in text
+    assert 'qldpc_p_h_bucket{le="1.0"} 1' in text
+    assert 'qldpc_p_h_bucket{le="+Inf"} 1' in text
+    assert "qldpc_p_h_count 1" in text
+
+
+def test_jsonl_report_round_trip(tmp_path):
+    report = importlib.import_module("scripts.telemetry_report")
+    path = str(tmp_path / "run.jsonl")
+    with telemetry.session(path):
+        telemetry.count("sim.shots", 1000)
+        telemetry.count("sim.failures", 10)
+        telemetry.count("driver.dispatches", 4)
+        telemetry.count("bp.shots", 2000)
+        telemetry.count("bp.converged", 1900)
+        telemetry.count("osd.invocations", 3)
+        telemetry.histogram("bp.iterations",
+                            telemetry.ITER_BUCKETS).observe(2)
+        telemetry.event("wer_run", engine="data", shots=1000, failures=10,
+                        wer=0.01)
+    events = report.load_events(path)
+    summary = report.summarize(events)
+    assert summary["shots"] == 1000
+    assert summary["failures"] == 10
+    assert summary["dispatches"] == 4
+    assert summary["bp"]["converged_fraction"] == pytest.approx(0.95)
+    assert summary["osd"]["invocations"] == 3
+    assert summary["events"]["wer_run"] == 1
+    text = report.render(summary)
+    assert "telemetry report" in text
+    assert "converged" in text
+    # --json path exercises the argparse front door too
+    assert report.main([path, "--json"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# compile/retrace tracker
+# ---------------------------------------------------------------------------
+def test_retrace_tracker_counts_fresh_compiles():
+    import jax
+    import jax.numpy as jnp
+
+    telemetry.enable()
+
+    @jax.jit
+    def fresh(x):
+        return x * 2 + 1
+
+    fresh(jnp.ones((3,))).block_until_ready()
+    stats = telemetry.compile_stats()
+    assert stats["jax.retraces"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# engine smoke: metric names populated by real runs on CPU
+# ---------------------------------------------------------------------------
+def _small_code():
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+
+    return hgp(rep_code(3), rep_code(3))
+
+
+def test_wer_run_populates_metrics_bp():
+    """Pure-device BP run: metrics arrive via the device telemetry vector
+    folded through the megabatch carry."""
+    import jax
+
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError)
+
+    code = _small_code()
+    p = 0.05
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=10)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=10)
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=32, seed=0)
+    wer_off = sim.WordErrorRate(128, key=jax.random.PRNGKey(3))
+    telemetry.enable()
+    sim2 = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=32, seed=0)
+    wer_on = sim2.WordErrorRate(128, key=jax.random.PRNGKey(3))
+    # telemetry must not perturb the estimate (bit-exact, same keys)
+    assert wer_on == wer_off
+    snap = telemetry.snapshot()
+    for name in ("sim.shots", "sim.failures", "sim.runs",
+                 "driver.dispatches", "bp.shots", "bp.converged",
+                 "bp.iterations"):
+        assert name in snap, f"missing metric {name}"
+    assert snap["sim.shots"]["value"] == 128
+    assert snap["bp.shots"]["value"] == 256  # both sectors
+    # iteration stats cover converged shots only (non-converged sit at
+    # max_iter and would inflate the mean)
+    assert snap["bp.iterations"]["count"] == snap["bp.converged"]["value"]
+    assert 0 < snap["bp.converged"]["value"] <= 256
+    assert "span.wer.data.seconds" in snap
+
+
+def test_wer_run_populates_metrics_bposd_host():
+    """Host-OSD run (CPU default for BPOSD): BP stats ride the aux already
+    crossing to the host; OSD invocations/round-trips are counted."""
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError)
+
+    code = _small_code()
+    p = 0.12  # high p so some shots fail BP and exercise OSD
+    dec_x = BPOSD_Decoder(code.hz, np.full(code.N, p), max_iter=3,
+                          osd_method="osd_e", osd_order=2)
+    dec_z = BPOSD_Decoder(code.hx, np.full(code.N, p), max_iter=3,
+                          osd_method="osd_e", osd_order=2)
+    assert dec_x.needs_host_postprocess  # CPU => host OSD path
+    telemetry.enable()
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=64, seed=0)
+    sim.WordErrorRate(128)
+    snap = telemetry.snapshot()
+    assert snap["sim.shots"]["value"] == 128
+    assert snap["bp.shots"]["value"] == 256
+    assert snap["osd.invocations"]["value"] >= 1
+    assert snap["osd.shots"]["value"] >= 1
+    assert snap["osd.host_round_trips"]["value"] >= 1
+    assert snap["driver.dispatches"]["value"] == 2
+    assert "span.wer.data/finish/osd_host.seconds" in snap
+
+
+def test_wer_run_populates_metrics_phenom():
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+
+    code = _small_code()
+    p, q = 0.03, 0.03
+    ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+    extz = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+    d1x = BPDecoder(extz, np.full(extz.shape[1], p), max_iter=8)
+    d1z = BPDecoder(ext, np.full(ext.shape[1], p), max_iter=8)
+    d2x = BPDecoder(code.hz, np.full(code.N, p), max_iter=8)
+    d2z = BPDecoder(code.hx, np.full(code.N, p), max_iter=8)
+    telemetry.enable()
+    sim = CodeSimulator_Phenon(
+        code=code, decoder1_x=d1x, decoder1_z=d1z, decoder2_x=d2x,
+        decoder2_z=d2z, pauli_error_probs=[p / 3] * 3, q=q,
+        batch_size=32, seed=0)
+    sim.WordErrorRate(num_rounds=3, num_samples=64)
+    snap = telemetry.snapshot()
+    assert snap["sim.shots"]["value"] == 64
+    # final-round (decoder-2) aux only — documented scope
+    assert snap["bp.shots"]["value"] == 128
+    assert "span.wer.phenl.seconds" in snap
+
+
+def test_wer_run_populates_metrics_mesh():
+    """Sharded (mesh) runs must report decoder statistics too: the
+    telemetry vector psum-reduces over the mesh alongside the failure
+    count (conftest forces 8 virtual CPU devices)."""
+    import jax
+
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.parallel import shot_mesh
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError)
+
+    code = _small_code()
+    p = 0.05
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=10)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=10)
+
+    def make():
+        return CodeSimulator_DataError(
+            code=code, decoder_x=dec_x, decoder_z=dec_z,
+            pauli_error_probs=[p / 3] * 3, batch_size=16, seed=0,
+            mesh=shot_mesh())
+
+    key = jax.random.PRNGKey(7)
+    wer_off = make().WordErrorRate(256, key=key)
+    telemetry.enable()
+    wer_on = make().WordErrorRate(256, key=key)
+    assert wer_on == wer_off  # the tele fold must not perturb the stats
+    snap = telemetry.snapshot()
+    assert snap["sim.shots"]["value"] == 256
+    assert snap["bp.shots"]["value"] == 512  # both sectors
+    assert snap["bp.iterations"]["count"] == snap["bp.converged"]["value"]
+
+
+def test_target_failures_early_stop_counted():
+    import jax
+
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError)
+
+    code = _small_code()
+    p = 0.2  # fails fast => early stop fires on the first megabatch
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=5)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=5)
+    telemetry.enable()
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=32, seed=0,
+        scan_chunk=2)
+    sim.WordErrorRate(64 * 32, key=jax.random.PRNGKey(0), target_failures=1)
+    snap = telemetry.snapshot()
+    assert snap["driver.early_stops"]["value"] == 1
+    assert snap["sim.shots"]["value"] < 64 * 32
+
+
+# ---------------------------------------------------------------------------
+# guard: no bare print() in library code
+# ---------------------------------------------------------------------------
+def test_no_bare_print_in_library():
+    """Library code must log/warn/count, never print.  utils/par2gen.py is
+    the teaching module (its prints ARE the product) and is exempt, as is
+    its compat re-export."""
+    allowed = {os.path.join("utils", "par2gen.py")}
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(LIB_ROOT):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, LIB_ROOT)
+            if rel in allowed:
+                continue
+            with open(path, encoding="utf-8") as fh:
+                for lineno, line in enumerate(fh, 1):
+                    stripped = line.lstrip()
+                    if stripped.startswith("#"):
+                        continue
+                    if "print(" in stripped and not stripped.startswith(
+                            ("\"", "'")):
+                        offenders.append(f"{rel}:{lineno}: {stripped.rstrip()}")
+    assert not offenders, (
+        "bare print() in library code (use utils.observability logging or "
+        "utils.telemetry counters):\n" + "\n".join(offenders))
