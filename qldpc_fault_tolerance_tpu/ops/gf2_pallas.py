@@ -416,12 +416,21 @@ def _residual_check_xla(spec: FusedSpec, key, batch_size: int,
 # Public dispatchers: Pallas on TPU when the batch tiles, XLA twin otherwise
 _DEFAULT_BLOCK_W = 8  # 256 shots per kernel block
 
+# Degradation override (utils.resilience ladder): when the fused Pallas
+# kernels repeatedly fault on a worker, the engines flip this to route every
+# "auto" dispatch through the bit-exact XLA twins.  The flip takes effect on
+# the next trace — the retry path's reset_device_state() clears the jit
+# caches that baked in the old branch.
+FORCE_XLA_TWIN = False
+
 
 def pallas_feasible(batch_size: int, block_w: int = _DEFAULT_BLOCK_W) -> bool:
     return batch_size % (block_w * LANE) == 0
 
 
 def _use_pallas(batch_size: int, backend) -> bool:
+    if FORCE_XLA_TWIN and backend != "pallas":
+        return False
     if backend in ("xla", "cpu"):
         return False
     if backend == "pallas":

@@ -30,7 +30,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.bp import _LruCache  # shared bounded memo (see ops/bp.py)
-from ..utils import telemetry
+from ..utils import faultinject, resilience, telemetry
 
 __all__ = [
     "shot_mesh",
@@ -152,55 +152,84 @@ class MegabatchDriver:
             return carry
 
         try:
-            donate = jax.default_backend() not in ("cpu",)
+            self._donated = jax.default_backend() not in ("cpu",)
         except Exception:
-            donate = False
-        self._mega = jax.jit(mega, donate_argnums=(0,) if donate else ())
+            self._donated = False
+        self._mega = jax.jit(
+            mega, donate_argnums=(0,) if self._donated else ())
 
-    def run(self, key, n_batches: int, *extra):
-        """Fold ``n_batches`` batches (rounded UP to a k_inner multiple so
-        every dispatch reuses one compiled scan shape).  Returns
-        ``(carry, batches_run)``; the carry is unsynced device values."""
-        k = self.k_inner
-        n_run = -(-int(n_batches) // k) * k
-        carry = self._init_fn()
-        for start in range(0, n_run, k):
+    def _dispatch(self, carry, key, start, *extra):
+        """One guarded megabatch dispatch.  Transient faults retry under the
+        active resilience policy with the SAME pre-dispatch carry (intact
+        for injected faults and submit-time failures) — but only on
+        non-donating backends: with donation the failed dispatch may
+        already have consumed the carry buffer, so the fault escalates to
+        the engine-level retry, which restarts or resumes the run."""
+
+        def attempt():
+            faultinject.site("megabatch_dispatch")
             with telemetry.span("megabatch_dispatch"):
-                carry = self._mega(carry, key, jnp.asarray(start, jnp.int32),
-                                   *extra)
+                out = self._mega(carry, key, jnp.asarray(start, jnp.int32),
+                                 *extra)
             self.dispatches += 1
             telemetry.count("driver.dispatches")
-        telemetry.count("driver.batches", n_run)
+            return out
+
+        if self._donated:
+            return attempt()
+        return resilience.run_cell(attempt, label="megabatch_dispatch")
+
+    def run(self, key, n_batches: int, *extra, start: int = 0, carry0=None):
+        """Fold ``n_batches`` batches (rounded UP to a k_inner multiple so
+        every dispatch reuses one compiled scan shape).  Returns
+        ``(carry, batches_run)``; the carry is unsynced device values.
+        ``start``/``carry0`` resume the fold mid-stream: batches before
+        ``start`` are skipped and ``carry0`` (their recorded fold) seeds
+        the carry — the key stream is positional (``fold_in(key, start+j)``)
+        so a resumed run replays the exact remaining draws."""
+        k = self.k_inner
+        n_run = -(-int(n_batches) // k) * k
+        carry = self._init_fn() if carry0 is None else carry0
+        for s in range(int(start), n_run, k):
+            carry = self._dispatch(carry, key, s, *extra)
+        telemetry.count("driver.batches", max(0, n_run - int(start)))
         return carry, n_run
 
-    def run_keys(self, key, n_batches: int, *extra):
+    def run_keys(self, key, n_batches: int, *extra, start: int = 0,
+                 carry0=None):
         """Like ``run`` but yields ``(carry_after_megabatch, batches_so_far)``
         per dispatch, double-buffered via ``drain_double_buffered``:
         megabatch d's carry is snapshotted while d+1 computes, so
         early-stopping callers see fresh counts at ~zero added latency.
         The snapshot copies the carry (the live carry keeps accumulating /
-        being donated)."""
+        being donated).  Drain fetches run under the resilience watchdog
+        (a ``device_get`` on a dead worker otherwise blocks forever) and a
+        timed-out or transiently-failed fetch retries against the live
+        snapshot — bit-exact, the device values survive the retry.
+        ``start``/``carry0`` resume mid-stream as in ``run``."""
         k = self.k_inner
         n_run = -(-int(n_batches) // k) * k
-        carry_box = [self._init_fn()]
+        carry_box = [self._init_fn() if carry0 is None else carry0]
 
-        def launch(start):
-            with telemetry.span("megabatch_dispatch"):
-                carry_box[0] = self._mega(carry_box[0], key,
-                                          jnp.asarray(start, jnp.int32),
-                                          *extra)
-            self.dispatches += 1
-            telemetry.count("driver.dispatches")
+        def launch(s):
+            carry_box[0] = self._dispatch(carry_box[0], key, s, *extra)
             telemetry.count("driver.batches", k)
             snap = jax.tree_util.tree_map(lambda x: x + 0, carry_box[0])
-            return snap, start + k
+            return snap, s + k
 
         def finish(item):
             snap, done = item
-            with telemetry.span("megabatch_drain"):
-                return jax.device_get(snap), done
 
-        yield from drain_double_buffered(launch, finish, range(0, n_run, k))
+            def fetch():
+                faultinject.site("megabatch_drain")
+                return jax.device_get(snap)
+
+            with telemetry.span("megabatch_drain"):
+                return resilience.guarded_fetch(
+                    fetch, label="megabatch_drain"), done
+
+        yield from drain_double_buffered(launch, finish,
+                                         range(int(start), n_run, k))
 
 
 def count_min_driver(tag: str, cfg, k_inner: int, stats_fn,

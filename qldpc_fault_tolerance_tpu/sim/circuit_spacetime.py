@@ -39,6 +39,7 @@ from .circuit import _swap_xz_inplace, build_memory_circuit
 from .common import (
     apply_worker_batch_fence,
     fence_batch_value,
+    resilient_engine_run,
     ShotBatcher,
     accumulate_counts,
     mesh_batch_stats,
@@ -296,12 +297,20 @@ class CodeSimulator_Circuit_SpaceTime:
         )
 
     def _count_failures(self, num_samples: int, key=None):
-        """(failure count, shots actually run) over the right dispatch path."""
+        """(failure count, shots actually run) over the right dispatch path,
+        executed under the active resilience policy (utils.resilience):
+        transient worker faults retry with backoff (bit-exact — the run is
+        deterministic in its key), deterministic errors fail fast."""
         apply_worker_batch_fence(self)
         self._ensure_ready()
         self._assert_window_decoder_device()
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
+        return resilient_engine_run(
+            self, lambda: self._count_failures_once(num_samples, key),
+            site="wer.circuit_st")
+
+    def _count_failures_once(self, num_samples: int, key):
         if not self.decoder2_z.needs_host_postprocess:
             if self._mesh is not None:
                 count, total, _ = mesh_batch_stats(

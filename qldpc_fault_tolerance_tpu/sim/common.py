@@ -24,6 +24,10 @@ __all__ = [
     "accumulate_counts",
     "windowed_count",
     "mesh_batch_stats",
+    "run_signature",
+    "resumable_stream",
+    "resilient_engine_run",
+    "engine_ladder_step",
     "on_tunneled_worker",
     "apply_worker_batch_fence",
     "fence_batch_value",
@@ -53,6 +57,8 @@ def accumulate_counts(count_fn, keys) -> int:
     from ..utils import telemetry
     from ..utils.observability import stage_timer
 
+    from ..utils import resilience
+
     keys = list(keys)
     with stage_timer("device_dispatch"):
         total = accumulate_device(count_fn, keys, lambda a, b: a + b)
@@ -60,7 +66,10 @@ def accumulate_counts(count_fn, keys) -> int:
     if total is None:
         return 0
     with stage_timer("device_sync"):
-        return int(total)
+        # the int() is the blocking device->host sync — watchdog-guarded so
+        # a dead worker can't hang the sweep (utils.resilience)
+        return resilience.guarded_fetch(lambda: int(total),
+                                        label="device_sync")
 
 
 def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
@@ -74,22 +83,153 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     tracked as "osd_host" by decoders/osd.py).  With utils.telemetry
     enabled the same stages are trace spans, each launch counts as a
     dispatch, and the in-flight window depth is a gauge."""
-    from ..utils import telemetry
+    from ..utils import faultinject, resilience, telemetry
     from ..utils.observability import stage_timer
+
+    def _launch_one(k):
+        faultinject.site("windowed_launch")
+        return launch(k)
+
+    def _finish_one(item):
+        # the drain is where a dead worker manifests (blocking transfer):
+        # watchdog + retry against the still-live pending tuple
+        def fetch():
+            faultinject.site("windowed_drain")
+            return int(np.asarray(finish(item)).sum())
+
+        return resilience.guarded_fetch(fetch, label="windowed_drain")
 
     window, count = [], 0
     for k in keys:
         with stage_timer("launch"):
-            window.append(launch(k))
+            window.append(resilience.run_cell(
+                lambda: _launch_one(k), label="windowed_launch"))
         telemetry.count("driver.dispatches")
         telemetry.set_gauge("driver.drain_depth", len(window))
         if len(window) >= in_flight:
             with stage_timer("finish"):
-                count += int(np.asarray(finish(window.pop(0))).sum())
+                count += _finish_one(window.pop(0))
     while window:
         with stage_timer("finish"):
-            count += int(np.asarray(finish(window.pop(0))).sum())
+            count += _finish_one(window.pop(0))
     return count
+
+
+def run_signature(engine: str, key, **fields) -> dict:
+    """Identity of a megabatch shot stream, stored with mid-cell progress
+    records (utils.checkpoint.CellProgress): the PRNG key bytes plus the
+    batch layout.  A resume is honored only when the fingerprint matches —
+    resuming a cursor under a different stream would silently change the
+    estimate."""
+    import jax
+
+    try:
+        data = jax.random.key_data(key)
+    except Exception:  # old-style uint32 key arrays
+        data = key
+    return {"engine": engine,
+            "key": np.asarray(data).astype(np.uint32).ravel().tolist(),
+            **fields}
+
+
+def resilient_engine_run(sim, fn, *, site, degrade=None):
+    """Shared engine-level resilience wrapper (all five engines): one
+    fault-injection site + the force-CPU degradation context around the
+    run, executed under the active RetryPolicy (utils.resilience).
+
+    Scope of THIS retry level: faults that leave the simulator's
+    per-instance device state alive — injected faults, transient dispatch
+    flakes, stalls on a live worker, OOM (via the ladder).  A real worker
+    restart kills `sim`'s device buffers, which no in-place retry can
+    rebuild; that recovery belongs one level up, where the sweep drivers /
+    scripts/parity.py retry the CELL closure — it reconstructs decoders and
+    simulator from host data, and mid-cell progress turns the rebuild into
+    a resume."""
+    import contextlib
+
+    import jax
+
+    from ..utils import faultinject, resilience
+
+    def attempt():
+        ctx = (jax.default_device(jax.devices("cpu")[0])
+               if getattr(sim, "_force_cpu", False)
+               else contextlib.nullcontext())
+        with ctx:
+            faultinject.site(site)
+            return fn()
+
+    return resilience.run_cell(attempt, label=site, degrade=degrade)
+
+
+def engine_ladder_step(sim, extra_rungs=()):
+    """Lazily build and step the engine's degradation ladder
+    (utils.resilience.DegradationLadder): ``extra_rungs`` (engine-specific,
+    e.g. the fused-sampler rungs) in front of the shared
+    packed -> dense -> CPU tail.  Returns the rung taken or None."""
+    import jax
+
+    from ..utils import resilience
+
+    if sim._ladder is None:
+        rungs = list(extra_rungs)
+        if getattr(sim, "_packed", False):
+            rungs.append(("packed->dense",
+                          lambda: setattr(sim, "_packed", False)))
+        try:
+            on_cpu = jax.default_backend() == "cpu"
+        except Exception:
+            on_cpu = True
+        if not on_cpu:
+            rungs.append(("device->cpu",
+                          lambda: setattr(sim, "_force_cpu", True)))
+        sim._ladder = resilience.DegradationLadder(rungs)
+    return sim._ladder.step()
+
+
+def resumable_stream(driver, key, n_batches, extra, *, signature, progress,
+                     tele_on, min_init):
+    """Shared mid-cell-resume protocol for the megabatch engines: wrap
+    ``driver.run_keys`` with cursor load/save against a
+    ``utils.checkpoint.CellProgress``.
+
+    Returns ``((carry, batches_done), stream)``: the initial host carry —
+    the persisted one on resume, ``(0, min_init)`` fresh — and an iterator
+    of ``(carry, done)`` per drained megabatch that persists the cursor
+    after each yield-side save.  The resume rules live HERE, once, for
+    every engine: the cursor is honored only when ``signature``
+    (run_signature: key bytes + batch layout) matches, and the telemetry
+    flag is NOT part of that identity — it changes the carry shape but not
+    the shot stream, so a run killed with telemetry off may resume with it
+    on (missing tele slots restart from zero and cover the remaining
+    megabatches only)."""
+    import jax.numpy as jnp
+
+    from ..utils import telemetry
+
+    start, carry0 = 0, None
+    state = progress.load(signature) if progress is not None else None
+    if state:
+        start = int(state["batches_done"])
+        carry0 = [jnp.asarray(state["failures"], jnp.int32),
+                  jnp.asarray(state["min_w"], jnp.int32)]
+        if tele_on:
+            carry0.append(jnp.asarray(
+                state.get("tele") or [0] * telemetry.TELE_LEN, jnp.int32))
+        carry0 = tuple(carry0)
+    initial = ((state["failures"], state["min_w"]) if state
+               else (0, min_init))
+
+    def stream():
+        for carry, done in driver.run_keys(key, n_batches, *extra,
+                                           start=start, carry0=carry0):
+            if progress is not None:
+                progress.save(signature, batches_done=done,
+                              failures=int(carry[0]), min_w=int(carry[1]),
+                              tele=(carry[2] if len(carry) > 2 else None))
+            yield carry, done
+
+    return (initial, start), stream()
 
 
 def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
@@ -137,10 +277,13 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
     if run is None:
         run = runners[cache_key] = sharded_batch_stats(stats_fn, mesh,
                                                        has_tele=has_tele)
+    from ..utils import faultinject, resilience
+
     n_dev = mesh.devices.size
     batcher = ShotBatcher(num_samples, sim.batch_size * n_dev)
     count, min_w, tele = None, None, None
     for i in batcher:
+        faultinject.site("mesh_dispatch")
         keys = split_keys_for_mesh(jax.random.fold_in(key, i), mesh)
         out = run(keys)
         telemetry.count("driver.dispatches")
@@ -148,8 +291,9 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
         min_w = out[1] if min_w is None else jnp.minimum(min_w, out[1])
         if has_tele:
             tele = out[2] if tele is None else tele + out[2]
-    # one host round-trip
-    count, min_w, tele = jax.device_get((count, min_w, tele))
+    # one host round-trip — watchdog-guarded (utils.resilience)
+    count, min_w, tele = resilience.guarded_fetch(
+        lambda: jax.device_get((count, min_w, tele)), label="mesh_drain")
     if tele is not None:
         telemetry.publish_device_tele(tele)
     return int(count), batcher.total, int(min_w)

@@ -41,10 +41,14 @@ from ..parallel.shots import MegabatchDriver, count_min_driver
 from ..utils import telemetry
 from .common import (
     apply_worker_batch_fence,
+    engine_ladder_step,
     fence_batch_value,
     ShotBatcher,
     mesh_batch_stats,
     record_wer_run,
+    resilient_engine_run,
+    resumable_stream,
+    run_signature,
     wer_single_shot,
     windowed_count,
 )
@@ -249,6 +253,10 @@ class CodeSimulator_DataError:
         self._base_key = jax.random.PRNGKey(seed)
         self._mesh = mesh
         self.last_dispatches = 0  # dispatches of the most recent stats run
+        # resilience (utils.resilience): the degradation ladder steps these
+        # when a substrate rung repeatedly faults on a worker
+        self._force_cpu = False
+        self._ladder = None
 
         # syndromes / residual stabilizer checks as sparse parity gathers
         # (row weight <= ~12 for codes_lib matrices — far cheaper than the
@@ -374,7 +382,27 @@ class CodeSimulator_DataError:
         self._base_key, sub = jax.random.split(self._base_key)
         return int(self.run_batch(sub, 1)[0])
 
-    def WordErrorRate(self, num_run: int, key=None, target_failures=None):
+    def _degrade_once(self):
+        """One rung down the graceful-degradation ladder (utils.resilience):
+        fused-Pallas -> XLA twin -> packed -> dense -> CPU.  Every rung
+        below the opt-in fused sampler is bit-exact with the one above, so
+        a degraded run still reproduces the fault-free result seed-for-seed
+        (the fused sampler's own stream is already non-comparable).  Config
+        flags feed ``_cfg``, so the next attempt memoizes a fresh driver
+        and compiles the degraded program."""
+        fused_rungs = []
+        if self._fused_sampler:
+            if not gf2_pallas.FORCE_XLA_TWIN:
+                fused_rungs.append((
+                    "fused_pallas->fused_xla",
+                    lambda: setattr(gf2_pallas, "FORCE_XLA_TWIN", True)))
+            fused_rungs.append(("fused->packed",
+                                lambda: setattr(self, "_fused_sampler",
+                                                False)))
+        return engine_ladder_step(self, fused_rungs)
+
+    def WordErrorRate(self, num_run: int, key=None, target_failures=None,
+                      progress=None):
         """WER over ``num_run`` shots (src/Simulators.py:170-188 contract).
 
         ``target_failures`` caps the run adaptively: the megabatch stream is
@@ -383,7 +411,19 @@ class CodeSimulator_DataError:
         after the first megabatch whose cumulative failure count reaches
         the target, with the denominator being the shots actually run.
         Standard Monte-Carlo practice for WER curves: deep points stop on
-        failure count, not on a worst-case shot budget."""
+        failure count, not on a worst-case shot budget.
+
+        ``progress``: optional ``utils.checkpoint.CellProgress`` — the run
+        periodically persists (batches_done, failures, min_w) so a killed
+        run resumes mid-cell, seed-for-seed identical to an uninterrupted
+        one (pure-device single-chip path only; ignored on mesh /
+        host-postprocess paths, which have no megabatch cursor).
+
+        The whole run executes under the active resilience policy
+        (utils.resilience): transient worker faults retry with backoff —
+        with ``progress``, the retry resumes from the persisted cursor —
+        deterministic errors fail fast, and repeated faults step the
+        degradation ladder (``_degrade_once``)."""
         apply_worker_batch_fence(self)
         if target_failures is not None and (self._needs_host
                                             or self._mesh is not None):
@@ -392,9 +432,14 @@ class CodeSimulator_DataError:
                 "single-chip path (no host-postprocess decoders, no mesh)")
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
-        with telemetry.span("wer.data"):
-            wer = self._word_error_rate(num_run, key, target_failures)
-        return wer
+
+        def run():
+            with telemetry.span("wer.data"):
+                return self._word_error_rate(num_run, key, target_failures,
+                                             progress)
+
+        return resilient_engine_run(self, run, site="wer.data",
+                                    degrade=self._degrade_once)
 
     def _wer_result(self, failures: int, shots: int):
         """WER + telemetry bookkeeping shared by every WordErrorRate path."""
@@ -403,7 +448,7 @@ class CodeSimulator_DataError:
                        dispatches=self.last_dispatches)
         return wer
 
-    def _word_error_rate(self, num_run, key, target_failures):
+    def _word_error_rate(self, num_run, key, target_failures, progress=None):
         if self._mesh is not None and not self._needs_host:
             tele_on = telemetry.enabled()
             count, total, min_w = mesh_batch_stats(
@@ -423,25 +468,9 @@ class CodeSimulator_DataError:
             # the denominator rounds up to the chunk multiple actually run
             chunk = min(batcher.num_batches, self._scan_chunk)
             n_batches = -(-batcher.num_batches // chunk) * chunk
-            if target_failures is not None:
-                driver = _stats_driver(
-                    self._cfg(self.batch_size, tele=telemetry.enabled()),
-                    chunk)
-                before = driver.dispatches
-                carry, done = (0, self.N), 0
-                for carry, done in driver.run_keys(
-                        key, n_batches, self._dev_state):
-                    if int(carry[0]) >= int(target_failures):
-                        if done * self.batch_size < batcher.total:
-                            telemetry.count("driver.early_stops")
-                        break
-                self.last_dispatches = driver.dispatches - before
-                self.min_logical_weight = min(
-                    self.min_logical_weight, int(carry[1]))
-                if len(carry) > 2:
-                    telemetry.publish_device_tele(carry[2])
-                return self._wer_result(
-                    int(carry[0]), done * self.batch_size)
+            if target_failures is not None or progress is not None:
+                return self._streaming_run(key, batcher, chunk, n_batches,
+                                           target_failures, progress)
             total, min_w, tele_vec = self._device_run_stats(
                 key, self.batch_size, n_batches
             )
@@ -460,3 +489,48 @@ class CodeSimulator_DataError:
             self._drain_batch, keys,
         )
         return self._wer_result(error_count, batcher.total)
+
+    def _streaming_run(self, key, batcher, chunk, n_batches, target_failures,
+                       progress):
+        """Megabatch stream drained per-dispatch (double-buffered): the path
+        for target-failure early stopping and/or mid-cell resume.
+
+        Resume protocol: the fold-in key stream is positional, so the
+        persisted ``batches_done`` cursor plus the recorded carry replay
+        exactly the remaining draws — a resumed run is seed-for-seed
+        identical to an uninterrupted one.  The cursor is honored only when
+        the run fingerprint (key bytes + batch layout) matches."""
+        tele_on = telemetry.enabled()
+        driver = _stats_driver(self._cfg(self.batch_size, tele=tele_on),
+                               chunk)
+        before = driver.dispatches
+        fp = run_signature(
+            "data", key, batch_size=self.batch_size, chunk=chunk,
+            n_batches=n_batches, fused=self._fused_sampler)
+        (carry, done), stream = resumable_stream(
+            driver, key, n_batches, (self._dev_state,), signature=fp,
+            progress=progress, tele_on=tele_on, min_init=self.N)
+
+        def _target_hit(c):
+            return (target_failures is not None
+                    and int(c[0]) >= int(target_failures))
+
+        # a resumed cursor may ALREADY sit past the early-stop threshold
+        # (killed between the crossing megabatch's save and the cell
+        # record): stopping here returns the same (failures, shots) the
+        # uninterrupted run returned — streaming one more megabatch would
+        # silently change the estimate
+        if _target_hit(carry):
+            if done * self.batch_size < batcher.total:
+                telemetry.count("driver.early_stops")
+        else:
+            for carry, done in stream:
+                if _target_hit(carry):
+                    if done * self.batch_size < batcher.total:
+                        telemetry.count("driver.early_stops")
+                    break
+        self.last_dispatches = driver.dispatches - before
+        self.min_logical_weight = min(self.min_logical_weight, int(carry[1]))
+        if len(carry) > 2:
+            telemetry.publish_device_tele(carry[2])
+        return self._wer_result(int(carry[0]), done * self.batch_size)

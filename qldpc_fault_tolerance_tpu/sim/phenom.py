@@ -37,13 +37,17 @@ from ..ops.gf2_packed import (
     unpack_shots,
 )
 from ..parallel.shots import MegabatchDriver, count_min_driver
-from ..utils import telemetry
+from ..utils import resilience, telemetry
 from .common import (
     apply_worker_batch_fence,
+    engine_ladder_step,
     fence_batch_value,
     ShotBatcher,
     mesh_batch_stats,
     record_wer_run,
+    resilient_engine_run,
+    resumable_stream,
+    run_signature,
     wer_per_cycle,
     wer_single_shot,
     windowed_count,
@@ -262,6 +266,9 @@ class CodeSimulator_Phenon:
         self._base_key = jax.random.PRNGKey(seed)
         self._mesh = mesh
         self.last_dispatches = 0
+        # resilience (utils.resilience): degradation ladder state
+        self._force_cpu = False
+        self._ladder = None
 
         self._mx = code.hx.shape[0]
         self._mz = code.hz.shape[0]
@@ -387,10 +394,33 @@ class CodeSimulator_Phenon:
         return _stats_one_batch(self._cfg(batch_size, tele=tele),
                                 self._dev_state, key, num_rounds)
 
-    def _count_failures(self, num_rounds, num_samples, key=None):
+    def _degrade_once(self):
+        """One rung down the graceful-degradation ladder (utils.resilience):
+        packed -> dense -> CPU.  Packed and dense are bit-exact, so a
+        degraded run still reproduces the fault-free result seed-for-seed."""
+        return engine_ladder_step(self)
+
+    def _count_failures(self, num_rounds, num_samples, key=None,
+                        progress=None):
+        """(failure count, shots run) under the active resilience policy:
+        transient worker faults retry with backoff (resuming from the
+        ``progress`` cursor when one is attached), deterministic errors
+        fail fast, repeated faults step the degradation ladder.
+        ``progress`` is honored on the pure-device single-chip megabatch
+        path and silently ignored elsewhere (mesh / host-postprocess paths
+        have no megabatch cursor)."""
         apply_worker_batch_fence(self)
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
+
+        return resilient_engine_run(
+            self,
+            lambda: self._count_failures_once(num_rounds, num_samples, key,
+                                              progress),
+            site="wer.phenl", degrade=self._degrade_once)
+
+    def _count_failures_once(self, num_rounds, num_samples, key,
+                             progress=None):
         dec2_host = (self.decoder2_x.needs_host_postprocess
                      or self.decoder2_z.needs_host_postprocess)
         if self._dec1_on_device and not dec2_host:
@@ -414,14 +444,34 @@ class CodeSimulator_Phenon:
             batcher = ShotBatcher(num_samples, self.batch_size)
             chunk = min(batcher.num_batches, self._scan_chunk)
             n_batches = -(-batcher.num_batches // chunk) * chunk
+            tele_on = telemetry.enabled()
             driver = _stats_driver(
-                self._cfg(self.batch_size, tele=telemetry.enabled()), chunk)
+                self._cfg(self.batch_size, tele=tele_on), chunk)
             before = driver.dispatches
-            carry, _ = driver.run(
-                key, n_batches, self._dev_state,
-                jnp.asarray(num_rounds, jnp.int32))
+            if progress is not None:
+                # mid-cell resume path: stream per-megabatch carries
+                # (double-buffered) and persist the cursor; the positional
+                # fold-in key stream makes a resume seed-for-seed identical
+                # to an uninterrupted run (sim/common.resumable_stream owns
+                # the cursor/fingerprint rules for every engine)
+                fp = run_signature(
+                    "phenl", key, batch_size=self.batch_size, chunk=chunk,
+                    n_batches=n_batches, rounds=int(num_rounds))
+                (carry, _), stream = resumable_stream(
+                    driver, key, n_batches,
+                    (self._dev_state, jnp.asarray(num_rounds, jnp.int32)),
+                    signature=fp, progress=progress, tele_on=tele_on,
+                    min_init=self.N)
+                for carry, _done in stream:
+                    pass
+            else:
+                carry, _ = driver.run(
+                    key, n_batches, self._dev_state,
+                    jnp.asarray(num_rounds, jnp.int32))
+                # one host round-trip — watchdog-guarded (utils.resilience)
+                carry = resilience.guarded_fetch(
+                    lambda: jax.device_get(carry), label="phenl_drain")
             self.last_dispatches = driver.dispatches - before
-            carry = jax.device_get(carry)  # one host round-trip
             cnt, mw = carry[0], carry[1]
             if len(carry) > 2:
                 telemetry.publish_device_tele(carry[2])
@@ -440,18 +490,24 @@ class CodeSimulator_Phenon:
         record_wer_run("phenl", count, total, wer,
                        dispatches=self.last_dispatches)
 
-    def WordErrorRate(self, num_rounds: int, num_samples: int, key=None):
-        """Per-qubit-per-cycle WER (src/Simulators.py:334-362)."""
+    def WordErrorRate(self, num_rounds: int, num_samples: int, key=None,
+                      progress=None):
+        """Per-qubit-per-cycle WER (src/Simulators.py:334-362).
+        ``progress``: optional utils.checkpoint.CellProgress for mid-cell
+        resume (see ``_count_failures``)."""
         with telemetry.span("wer.phenl"):
-            count, total = self._count_failures(num_rounds, num_samples, key)
+            count, total = self._count_failures(num_rounds, num_samples, key,
+                                                progress)
         wer = wer_per_cycle(count, total, self.K, num_rounds)
         self._record_run(count, total, wer[0])
         return wer
 
-    def WordErrorProbability(self, num_rounds: int, num_samples: int, key=None):
+    def WordErrorProbability(self, num_rounds: int, num_samples: int,
+                             key=None, progress=None):
         """End-of-run word error probability (src/Simulators.py:365-383)."""
         with telemetry.span("wer.phenl"):
-            count, total = self._count_failures(num_rounds, num_samples, key)
+            count, total = self._count_failures(num_rounds, num_samples, key,
+                                                progress)
         wer = wer_single_shot(count, total, self.K)
         self._record_run(count, total, wer[0])
         return wer

@@ -27,6 +27,7 @@ from ..ops.linalg import gf2_matmul
 from .common import (
     apply_worker_batch_fence,
     fence_batch_value,
+    resilient_engine_run,
     ShotBatcher,
     accumulate_device,
     mesh_batch_stats,
@@ -291,6 +292,16 @@ class CodeSimulator_Phenon_SpaceTime:
         total_num_cycles = (num_rounds - 1) * self.num_rep + 1
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
+        # active resilience policy: transient faults retry bit-exact (the
+        # run is deterministic in its key), deterministic errors fail fast
+        return resilient_engine_run(
+            self,
+            lambda: self._word_error_rate_once(num_rounds, total_num_cycles,
+                                               num_samples, key),
+            site="wer.phenl_st")
+
+    def _word_error_rate_once(self, num_rounds: int, total_num_cycles: int,
+                              num_samples: int, key):
         dec2_host = (self.decoder2_x.needs_host_postprocess
                      or self.decoder2_z.needs_host_postprocess)
         if not dec2_host:

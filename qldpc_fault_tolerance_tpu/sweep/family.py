@@ -44,7 +44,8 @@ class CodeFamily:
         self.mesh = mesh  # chip mesh every simulator shards its shots over
 
     # ------------------------------------------------------------------
-    def _data_wer(self, code, eval_p, eval_logical_type, num_samples):
+    def _data_wer(self, code, eval_p, eval_logical_type, num_samples,
+                  progress=None):
         """src/Simulators.py:759-777."""
         p = eval_p * 3 / 2
         decoder_x = self.decoder2_class.GetDecoder({"h": code.hz, "p_data": eval_p})
@@ -55,10 +56,12 @@ class CodeFamily:
             eval_logical_type=eval_logical_type,
             batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
-        return sim.WordErrorRate(num_samples)[0]
+        # the engine honors progress only on its pure-device single-chip
+        # megabatch path and ignores it elsewhere (documented contract)
+        return sim.WordErrorRate(num_samples, progress=progress)[0]
 
     def _phenl_wer(self, code, eval_p, eval_logical_type, num_samples,
-                   num_cycles):
+                   num_cycles, progress=None):
         """src/Simulators.py:780-811."""
         p = 3 / 2 * eval_p
         q = eval_p
@@ -76,7 +79,11 @@ class CodeFamily:
             eval_logical_type=eval_logical_type,
             batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
-        return sim.WordErrorRate(num_rounds=num_cycles, num_samples=num_samples)[0]
+        # the engine honors progress only on its pure-device single-chip
+        # megabatch path and ignores it elsewhere (documented contract)
+        return sim.WordErrorRate(num_rounds=num_cycles,
+                                 num_samples=num_samples,
+                                 progress=progress)[0]
 
     def _circuit_wer(self, code, eval_p, eval_logical_type, num_samples,
                      num_cycles, data_synd_noise_ratio, circuit_type,
@@ -120,12 +127,22 @@ class CodeFamily:
                 eval_p_list: list, num_samples: int, num_cycles=1,
                 data_synd_noise_ratio=1, circuit_type="coloration",
                 circuit_error_params=None, if_plot=True, checkpoint=None,
-                shard_across_processes: bool = False):
+                shard_across_processes: bool = False,
+                progress_every: int = 1):
         """(len(code_list), len(eval_p_list)) WER array
         (src/Simulators.py:752-908).
 
         ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
-        (code, p) cells are persisted as they complete and skipped on rerun.
+        (code, p) cells are persisted as they complete and skipped on rerun,
+        and the megabatch engines additionally persist MID-cell progress so
+        a killed run resumes inside the running cell (seed-for-seed
+        identical; utils.checkpoint.CellProgress).
+        ``progress_every``: persist the in-cell cursor every that-many
+        drained megabatches.  Mid-cell progress routes the cell through the
+        double-buffered streamed drain (one overlapped host fetch per
+        megabatch instead of one per cell) plus one fsync'd JSONL append
+        per save — raise this on slow storage / fast cells, or pass 0 to
+        disable mid-cell resume and keep the single-sync fold.
         ``shard_across_processes``: in a multi-host JAX program, each process
         computes a round-robin subset of the grid; the scalar results merge
         over DCN at the end (parallel/grid.py).
@@ -137,7 +154,8 @@ class CodeFamily:
             "eval_type should be one of [X, Y, Total]"
         )
         from ..parallel.grid import merge_cell_results, process_cell_owner
-        from ..utils import telemetry
+        from ..utils import resilience, telemetry
+        from ..utils.checkpoint import CellProgress
         from ..utils.observability import get_logger, log_record, stage_timer
 
         if noise_model == "circuit" and eval_logical_type == "X":
@@ -178,19 +196,36 @@ class CodeFamily:
             if checkpoint is not None and (rec := checkpoint.get(cell_key)):
                 eval_wer_list.append(rec["wer"])
                 continue
+            # mid-cell resume (utils.checkpoint.CellProgress): megabatch
+            # engines persist their in-cell cursor against the same
+            # checkpoint, so a killed sweep resumes INSIDE the running cell
+            progress = (CellProgress(checkpoint, cell_key,
+                                     every=progress_every)
+                        if checkpoint is not None and progress_every
+                        else None)
+            # cell-level retry (utils.resilience): the closure reconstructs
+            # decoders AND simulator from host data on every attempt, so
+            # this is the level that survives a REAL worker restart (the
+            # engine-level retry inside WordErrorRate reuses per-instance
+            # device buffers, which die with the worker); with ``progress``
+            # attached the rebuilt cell resumes mid-cell instead of
+            # restarting
+            if noise_model == "data":
+                cell = lambda: self._data_wer(  # noqa: E731
+                    code, eval_p, eval_logical_type, num_samples,
+                    progress=progress)
+            elif noise_model == "phenl":
+                cell = lambda: self._phenl_wer(  # noqa: E731
+                    code, eval_p, eval_logical_type, num_samples,
+                    num_cycles, progress=progress)
+            else:
+                cell = lambda: self._circuit_wer(  # noqa: E731
+                    code, eval_p, eval_logical_type, num_samples,
+                    num_cycles, data_synd_noise_ratio, circuit_type,
+                    circuit_error_params)
             with stage_timer(f"cell:{noise_model}"):
-                if noise_model == "data":
-                    wer = self._data_wer(code, eval_p, eval_logical_type,
-                                         num_samples)
-                elif noise_model == "phenl":
-                    wer = self._phenl_wer(code, eval_p, eval_logical_type,
-                                          num_samples, num_cycles)
-                else:
-                    wer = self._circuit_wer(
-                        code, eval_p, eval_logical_type, num_samples,
-                        num_cycles, data_synd_noise_ratio, circuit_type,
-                        circuit_error_params,
-                    )
+                wer = resilience.run_cell(cell,
+                                          label=f"cell:{noise_model}")
             # per-cell record: one structured log line (always) plus the
             # telemetry event sink (JSONL stream / report) when enabled
             log_record(logger, "cell_done", **cell_key, wer=float(wer))

@@ -43,12 +43,16 @@ class CodeFamily_SpaceTime:
                 eval_p_list: list, num_samples: int, num_cycles=1, num_rep=1,
                 circuit_type="coloration", circuit_error_params=None,
                 if_plot=True, if_adaptive=False, adaptive_params=None,
-                checkpoint=None, shard_across_processes: bool = False):
+                checkpoint=None, shard_across_processes: bool = False,
+                progress_every: int = 1):
         """(ragged) per-code WER/p lists
         (src/Simulators_SpaceTime.py:1158-1307).
 
         ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
-        cells are persisted as they complete and skipped on rerun.
+        cells are persisted as they complete and skipped on rerun; the data
+        branch additionally persists mid-cell progress every
+        ``progress_every`` megabatches (0 disables — see
+        sweep/family.py for the cost trade-off).
         ``shard_across_processes``: in a multi-host JAX program, each process
         computes a round-robin subset of the (code, p) cells (the adaptive
         pruning predicate is deterministic, so every process enumerates the
@@ -62,6 +66,8 @@ class CodeFamily_SpaceTime:
             "eval_type should be one of [X, Y, Total]"
         )
         from ..parallel.grid import merge_cell_results, process_cell_owner
+        from ..utils import resilience, telemetry
+        from ..utils.checkpoint import CellProgress
         from ..utils.observability import get_logger, log_record, stage_timer
 
         logger = get_logger()
@@ -98,20 +104,33 @@ class CodeFamily_SpaceTime:
             if checkpoint is not None and (rec := checkpoint.get(cell_key)):
                 flat_wer[idx] = rec["wer"]
                 continue
+            # mid-cell resume for the data branch (the only ST branch on
+            # the megabatch driver); see sweep/family.py
+            progress = (CellProgress(checkpoint, cell_key,
+                                     every=progress_every)
+                        if checkpoint is not None and progress_every
+                        else None)
+            # cell-level retry survives a real worker restart: each attempt
+            # reconstructs decoders + simulator from host data, and
+            # ``progress`` turns the rebuild into a resume (sweep/family.py)
+            if noise_model == "data":
+                cell = lambda: self._data_wer(  # noqa: E731
+                    code, eval_p, eval_logical_type, num_samples,
+                    progress=progress)
+            elif noise_model == "phenl":
+                cell = lambda: self._phenl_wer(  # noqa: E731
+                    code, eval_p, eval_logical_type, num_samples,
+                    num_cycles, num_rep)
+            else:
+                cell = lambda: self._circuit_wer(  # noqa: E731
+                    code, eval_p, eval_logical_type, num_samples,
+                    num_cycles, num_rep, circuit_type, circuit_error_params)
             with stage_timer(f"cell:st-{noise_model}"):
-                if noise_model == "data":
-                    wer = self._data_wer(code, eval_p, eval_logical_type,
-                                         num_samples)
-                elif noise_model == "phenl":
-                    wer = self._phenl_wer(code, eval_p, eval_logical_type,
-                                          num_samples, num_cycles, num_rep)
-                else:
-                    wer = self._circuit_wer(
-                        code, eval_p, eval_logical_type, num_samples,
-                        num_cycles, num_rep, circuit_type,
-                        circuit_error_params,
-                    )
+                wer = resilience.run_cell(cell,
+                                          label=f"cell:st-{noise_model}")
             log_record(logger, "cell_done", **cell_key, wer=float(wer))
+            telemetry.event("cell_done", **cell_key, wer=float(wer))
+            telemetry.count("sweep.cells")
             if checkpoint is not None:
                 checkpoint.put(cell_key, {"wer": float(wer)})
             flat_wer[idx] = wer
@@ -126,7 +145,8 @@ class CodeFamily_SpaceTime:
         return eval_wer_list, eval_p_adapt_list
 
     # ------------------------------------------------------------------
-    def _data_wer(self, code, eval_p, eval_logical_type, num_samples):
+    def _data_wer(self, code, eval_p, eval_logical_type, num_samples,
+                  progress=None):
         """src/Simulators_SpaceTime.py:1165-1186 — note the decoder params
         carry 'code_h'/'channel_probs' so circuit-style factory classes work
         on the data branch too."""
@@ -145,7 +165,9 @@ class CodeFamily_SpaceTime:
             eval_logical_type=eval_logical_type,
             batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
-        return sim.WordErrorRate(num_samples)[0]
+        # the engine honors progress only on its pure-device single-chip
+        # megabatch path and ignores it elsewhere (documented contract)
+        return sim.WordErrorRate(num_samples, progress=progress)[0]
 
     def _phenl_wer(self, code, eval_p, eval_logical_type, num_samples,
                    num_cycles, num_rep):

@@ -1,7 +1,7 @@
 """Utilities: par2gen teaching tools, observability, telemetry, sweep
-checkpointing."""
-from . import par2gen, telemetry
-from .checkpoint import SweepCheckpoint
+checkpointing, resilience (retry/watchdog/degradation), fault injection."""
+from . import faultinject, par2gen, resilience, telemetry
+from .checkpoint import CellProgress, SweepCheckpoint
 from .observability import (
     get_logger,
     log_record,
@@ -11,9 +11,12 @@ from .observability import (
     timings,
 )
 from .par2gen import GtoH, GtoP, HtoG, HtoP, LinearBlockCode
+from .resilience import RetryPolicy, WatchdogTimeout
 
 __all__ = [
     "par2gen", "HtoG", "GtoH", "HtoP", "GtoP", "LinearBlockCode",
-    "SweepCheckpoint", "stage_timer", "timings", "reset_timings",
-    "profile_trace", "get_logger", "log_record", "telemetry",
+    "SweepCheckpoint", "CellProgress", "stage_timer", "timings",
+    "reset_timings", "profile_trace", "get_logger", "log_record",
+    "telemetry", "resilience", "faultinject", "RetryPolicy",
+    "WatchdogTimeout",
 ]

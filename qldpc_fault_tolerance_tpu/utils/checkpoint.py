@@ -1,18 +1,32 @@
-"""Shard-level sweep checkpointing.
+"""Shard-level sweep checkpointing with mid-cell resume records.
 
 The reference restarts killed sweeps from scratch (SURVEY §5: "checkpoint /
 resume: none").  Here each (code, noise model, p, cycles) cell's outcome is
 appended to a JSONL file as soon as it finishes; re-running the same sweep
 skips completed cells.  Cells are keyed by their physical parameters, so a
 resumed sweep may change batch sizes or ordering freely.
+
+v2 adds **mid-cell progress records**: the megabatch engines periodically
+persist ``(batches_done, failures, min_w, ...)`` plus a run fingerprint
+while a cell is running, so a killed run resumes INSIDE the cell — the
+remaining megabatches replay the same fold-in key stream from the recorded
+cursor and the result is seed-for-seed identical to an uninterrupted run
+(tests/test_resilience.py).  A finished cell's ``put`` supersedes its
+progress records.
+
+Loading is crash-tolerant: a truncated / corrupt line (the tail a kill
+mid-append leaves behind — reproduced by the ``truncate`` fault kind in
+utils.faultinject) is skipped with a warning and a ``ckpt.corrupt_lines``
+telemetry counter instead of raising ``json.JSONDecodeError`` and bricking
+the resume.
 """
 from __future__ import annotations
 
 import json
 import os
-import tempfile
+import warnings
 
-__all__ = ["SweepCheckpoint"]
+__all__ = ["SweepCheckpoint", "CellProgress"]
 
 
 def _canon(value):
@@ -22,7 +36,7 @@ def _canon(value):
 
 
 class SweepCheckpoint:
-    """Append-only JSONL store of finished sweep cells.
+    """Append-only JSONL store of finished sweep cells + in-cell progress.
 
     >>> ckpt = SweepCheckpoint("sweep.jsonl")
     >>> key = dict(code="hgp_34_n625", noise="phenl", p=0.01, cycles=5)
@@ -34,14 +48,47 @@ class SweepCheckpoint:
     def __init__(self, path: str):
         self.path = path
         self._cells: dict[str, dict] = {}
+        self._progress: dict[str, dict] = {}
+        # a crash mid-append can leave the file without a trailing newline;
+        # appending straight after it would corrupt the NEXT record too, so
+        # the first append after loading such a file starts on a fresh line
+        self._needs_newline = False
         if os.path.exists(path):
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if not line:
-                        continue
+            self._load(path)
+
+    def _load(self, path: str) -> None:
+        from . import telemetry
+
+        raw_tail = b""
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            if f.tell() > 0:
+                f.seek(-1, os.SEEK_END)
+                raw_tail = f.read(1)
+        self._needs_newline = raw_tail not in (b"", b"\n")
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
                     entry = json.loads(line)
-                    self._cells[self._key_str(entry["key"])] = entry["record"]
+                    ks = self._key_str(entry["key"])
+                    if "record" in entry:
+                        self._cells[ks] = entry["record"]
+                        self._progress.pop(ks, None)
+                    elif "progress" in entry:
+                        self._progress[ks] = entry["progress"]
+                    else:
+                        raise KeyError("record")
+                except (json.JSONDecodeError, KeyError, TypeError) as e:
+                    # crash mid-append leaves a torn tail; losing ONE cell
+                    # (it reruns) beats bricking the whole resume
+                    warnings.warn(
+                        f"{path}:{lineno}: skipping corrupt checkpoint line "
+                        f"({type(e).__name__}: {e}) — the cell it recorded "
+                        "will rerun", stacklevel=3)
+                    telemetry.count("ckpt.corrupt_lines")
 
     @staticmethod
     def _key_str(key: dict) -> str:
@@ -49,21 +96,113 @@ class SweepCheckpoint:
             {k: _canon(v) for k, v in key.items()}, sort_keys=True
         )
 
+    def _append(self, obj: dict) -> None:
+        """Atomic append + fsync, with the ``sweep_ckpt_put`` fault-injection
+        site: a ``truncate`` fault writes a torn prefix (exactly what a kill
+        mid-append leaves on disk) and then raises."""
+        from . import faultinject
+
+        line = json.dumps(obj) + "\n"
+        if self._needs_newline:
+            line = "\n" + line
+        frac = faultinject.truncate_fraction("sweep_ckpt_put")
+        # pessimistic until the full line lands: a write that dies partway
+        # (injected truncate, real I/O error) leaves a torn tail, and the
+        # NEXT append from this process must start on a fresh line or it
+        # would corrupt its own record too
+        self._needs_newline = True
+        with open(self.path, "a") as f:
+            if frac is not None:
+                f.write(line[: max(1, int(len(line) * frac))])
+                f.flush()
+                os.fsync(f.fileno())
+                raise faultinject.InjectedFault(
+                    "checkpoint append killed mid-write (injected)")
+            f.write(line)
+            f.flush()
+            os.fsync(f.fileno())
+        self._needs_newline = False
+
     def get(self, key: dict):
-        """Record for a finished cell, or None."""
+        """Record for a finished cell, or None (progress records are NOT
+        finished cells)."""
         return self._cells.get(self._key_str(key))
 
     def put(self, key: dict, record: dict) -> None:
-        """Persist a finished cell (atomic append + fsync)."""
+        """Persist a finished cell; supersedes any progress records."""
         ks = self._key_str(key)
         self._cells[ks] = record
-        with open(self.path, "a") as f:
-            f.write(json.dumps({"key": json.loads(ks), "record": record}) + "\n")
-            f.flush()
-            os.fsync(f.fileno())
+        self._progress.pop(ks, None)
+        self._append({"key": json.loads(ks), "record": record})
+
+    def get_progress(self, key: dict):
+        """Latest in-cell progress for an UNFINISHED cell, or None."""
+        ks = self._key_str(key)
+        if ks in self._cells:
+            return None
+        return self._progress.get(ks)
+
+    def put_progress(self, key: dict, progress: dict) -> None:
+        """Persist mid-cell progress (append-only; the latest line wins on
+        reload, and a subsequent ``put`` supersedes them all)."""
+        ks = self._key_str(key)
+        self._progress[ks] = progress
+        self._append({"key": json.loads(ks), "progress": progress})
 
     def __len__(self) -> int:
         return len(self._cells)
 
     def __contains__(self, key: dict) -> bool:
         return self._key_str(key) in self._cells
+
+
+class CellProgress:
+    """Binding of one sweep cell to its checkpoint for mid-cell resume.
+
+    The engine calls ``load(fingerprint)`` before the run — a stored cursor
+    is honored only when the fingerprint (batch layout + PRNG key stream)
+    matches, because resuming under a different stream would silently
+    change the estimate — and ``save(...)`` every ``every``-th megabatch
+    drain.  ``every`` trades re-done work on a crash against fsync traffic
+    (each save is one appended JSONL line)."""
+
+    def __init__(self, checkpoint: SweepCheckpoint, key: dict,
+                 every: int = 1):
+        self.checkpoint = checkpoint
+        self.key = dict(key)
+        self.every = max(1, int(every))
+        self._saves = 0
+
+    def load(self, fingerprint: dict):
+        """State dict to resume from, or None (no progress / stale
+        fingerprint)."""
+        from . import telemetry
+
+        state = self.checkpoint.get_progress(self.key)
+        if state is None:
+            return None
+        if state.get("fingerprint") != fingerprint:
+            warnings.warn(
+                "mid-cell progress found but its run fingerprint does not "
+                "match (different batch size / chunk / key); restarting the "
+                "cell from zero", stacklevel=2)
+            telemetry.count("ckpt.stale_progress")
+            return None
+        telemetry.count("resilience.resumes")
+        telemetry.event("cell_resume", key=self.key,
+                        batches_done=int(state.get("batches_done", 0)))
+        return state
+
+    def save(self, fingerprint: dict, batches_done: int, failures: int,
+             min_w: int, tele=None) -> None:
+        self._saves += 1
+        if (self._saves - 1) % self.every:
+            return
+        state = {
+            "v": 2, "fingerprint": fingerprint,
+            "batches_done": int(batches_done), "failures": int(failures),
+            "min_w": int(min_w),
+        }
+        if tele is not None:
+            state["tele"] = [int(x) for x in tele]
+        self.checkpoint.put_progress(self.key, state)

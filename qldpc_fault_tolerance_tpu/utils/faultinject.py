@@ -1,0 +1,227 @@
+"""Deterministic fault injection: exercise every recovery path in CI on CPU.
+
+None of the failure handling in utils/resilience.py is trustworthy unless it
+runs in tests, and the real failure modes (tunneled-worker death, hung
+drains, kills mid-checkpoint-append) cannot be produced on demand.  This
+module plants named **sites** at the library's failure points —
+``MegabatchDriver`` dispatch/drain, the engines' WER entries, the windowed
+OSD drain, ``SweepCheckpoint`` appends — and a seeded, deterministic
+**fault plan** decides which site hits raise, stall, or truncate.
+
+Zero cost when inactive: ``site()`` is one module-global ``None`` check.
+
+A plan is a list of fault specs::
+
+    plan = FaultPlan([
+        Fault(site="megabatch_dispatch", kind="raise", after=1),   # 2nd hit
+        Fault(site="megabatch_drain", kind="stall", stall_s=0.5),
+    ])
+    with plan.active():
+        sim.WordErrorRate(...)
+
+Fault kinds:
+  * ``raise``   — raise ``InjectedFault`` (classified TRANSIENT: simulates
+    worker death; retry/resume paths must recover);
+  * ``deterministic`` — raise ``InjectedDeterministicFault`` (a ValueError:
+    simulates a program bug; retry must fail FAST);
+  * ``stall``   — sleep ``stall_s`` at the site (simulates a hung worker;
+    drain watchdogs must fire);
+  * ``truncate``— only honored by ``SweepCheckpoint`` appends: write a
+    partial line then raise (simulates a kill mid-append; the loader must
+    skip the torn line).
+
+Env activation for subprocesses / CI: ``QLDPC_FAULT_PLAN`` holds the plan as
+JSON (``[{"site": "megabatch_dispatch", "kind": "raise", "after": 1}]`` or
+``{"seed": 0, "faults": [...]}``); it is installed on first ``site()`` call.
+Every injection emits a ``faultinject.injected`` counter + ``fault_injected``
+event so test assertions can see exactly what fired.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+
+from . import telemetry
+from .resilience import TransientFault, sleep_for
+
+__all__ = [
+    "InjectedFault",
+    "InjectedDeterministicFault",
+    "Fault",
+    "FaultPlan",
+    "active_plan",
+    "activate",
+    "deactivate",
+    "site",
+    "truncate_fraction",
+]
+
+
+class InjectedFault(TransientFault):
+    """Injected transient infrastructure fault (simulated worker death)."""
+
+
+class InjectedDeterministicFault(ValueError):
+    """Injected deterministic bug (retry must fail fast, not back off)."""
+
+
+class Fault:
+    """One fault spec: fire at hits ``after < n <= after + count`` of
+    ``site`` (``after=0, count=1`` = first hit only)."""
+
+    KINDS = ("raise", "deterministic", "stall", "truncate")
+
+    def __init__(self, site: str, kind: str = "raise", after: int = 0,
+                 count: int = 1, stall_s: float = 0.25,
+                 truncate_at: float = 0.5, message: str = ""):
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown fault kind {kind!r} (one of {self.KINDS})")
+        self.site = str(site)
+        self.kind = kind
+        self.after = int(after)
+        self.count = int(count)
+        self.stall_s = float(stall_s)
+        self.truncate_at = float(truncate_at)
+        self.message = message or f"injected {kind} at {site}"
+
+    def matches(self, hit: int) -> bool:
+        return self.after < hit <= self.after + self.count
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Fault":
+        return cls(**d)
+
+
+class FaultPlan:
+    """Deterministic plan: per-site hit counters decide which spec fires.
+    ``seed`` is recorded with every event so a failing CI run names the
+    exact plan that produced it (hit counting itself is already
+    deterministic)."""
+
+    def __init__(self, faults, seed: int = 0):
+        self.seed = int(seed)
+        self.faults = [f if isinstance(f, Fault) else Fault.from_dict(f)
+                       for f in faults]
+        self._hits: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_json(cls, text: str) -> "FaultPlan":
+        data = json.loads(text)
+        if isinstance(data, dict):
+            return cls(data.get("faults", []), seed=int(data.get("seed", 0)))
+        return cls(data)
+
+    def hits(self, site_name: str) -> int:
+        with self._lock:
+            return self._hits.get(site_name, 0)
+
+    def _fire(self, site_name: str) -> "Fault | None":
+        with self._lock:
+            hit = self._hits.get(site_name, 0) + 1
+            self._hits[site_name] = hit
+        for fault in self.faults:
+            if fault.site == site_name and fault.matches(hit):
+                return fault
+        return None
+
+    def active(self):
+        return active_plan(self)
+
+
+_ACTIVE: FaultPlan | None = None
+_ENV_CHECKED = False
+
+
+def activate(plan: FaultPlan) -> None:
+    global _ACTIVE
+    _ACTIVE = plan
+
+
+def deactivate() -> None:
+    global _ACTIVE
+    _ACTIVE = None
+
+
+@contextlib.contextmanager
+def active_plan(plan: FaultPlan):
+    """Scope a plan; restores the previous one (env-installed or None)."""
+    global _ACTIVE
+    prev = _ACTIVE
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = prev
+
+
+def _maybe_install_env_plan() -> None:
+    """Install the QLDPC_FAULT_PLAN env plan once (subprocess activation)."""
+    global _ENV_CHECKED, _ACTIVE
+    _ENV_CHECKED = True
+    text = os.environ.get("QLDPC_FAULT_PLAN", "").strip()
+    if not text:
+        return
+    if os.path.exists(text):
+        with open(text, encoding="utf-8") as fh:
+            text = fh.read()
+    _ACTIVE = FaultPlan.from_json(text)
+
+
+def _record(fault: Fault, site_name: str) -> None:
+    telemetry.count("faultinject.injected")
+    telemetry.count(f"faultinject.{fault.kind}")
+    telemetry.event("fault_injected", site=site_name, fault_kind=fault.kind,
+                    seed=_ACTIVE.seed if _ACTIVE else 0)
+
+
+def site(name: str) -> None:
+    """Named injection point.  One global ``None`` check when no plan is
+    active; under a plan, counts the hit and performs the matching fault
+    (``truncate`` specs are ignored here — they only make sense where the
+    caller owns the write, see ``truncate_fraction``)."""
+    if _ACTIVE is None:
+        if _ENV_CHECKED:
+            return
+        _maybe_install_env_plan()
+        if _ACTIVE is None:
+            return
+    fault = _ACTIVE._fire(name)
+    if fault is None:
+        return
+    _record(fault, name)
+    if fault.kind == "raise":
+        raise InjectedFault(fault.message)
+    if fault.kind == "deterministic":
+        raise InjectedDeterministicFault(fault.message)
+    if fault.kind == "stall":
+        sleep_for(fault.stall_s)
+
+
+def truncate_fraction(name: str) -> float | None:
+    """Checkpoint-append variant of ``site``: returns the fraction of the
+    line to write before dying when a ``truncate`` fault matches (the
+    caller writes the torn prefix, fsyncs, and raises ``InjectedFault`` —
+    exactly what a kill mid-append leaves on disk), else None.  Other fault
+    kinds at the same site behave as in ``site()``."""
+    if _ACTIVE is None:
+        if _ENV_CHECKED:
+            return None
+        _maybe_install_env_plan()
+        if _ACTIVE is None:
+            return None
+    fault = _ACTIVE._fire(name)
+    if fault is None:
+        return None
+    _record(fault, name)
+    if fault.kind == "truncate":
+        return fault.truncate_at
+    if fault.kind == "raise":
+        raise InjectedFault(fault.message)
+    if fault.kind == "deterministic":
+        raise InjectedDeterministicFault(fault.message)
+    if fault.kind == "stall":
+        sleep_for(fault.stall_s)
+    return None

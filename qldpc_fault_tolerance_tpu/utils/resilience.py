@@ -1,0 +1,422 @@
+"""Resilient execution layer: error classification, retry/backoff policy,
+dispatch watchdogs, graceful degradation.
+
+The tunneled-TPU worker intermittently crashes mid-dispatch and can take
+minutes to come back (scripts/parity.py round-4 postmortem); a hung worker
+additionally blocks ``jax.device_get`` forever.  Until this module, the only
+failure handling in the tree was one ad-hoc helper in scripts/parity.py —
+the library itself had no retry, no timeouts, and no way to test either.
+This module is the single sanctioned home for ALL of it:
+
+  * ``classify_error``: transient infrastructure faults (worker death,
+    collective timeouts, injected faults, watchdog timeouts) vs
+    deterministic bugs (shape errors, invalid arguments) — retrying a
+    deterministic bug burns the whole backoff budget on a guaranteed loss;
+  * ``RetryPolicy``: jittered exponential backoff that drops all
+    device-resident caches (``reset_device_state``) between attempts, with
+    an optional degradation hook stepped after repeated faults;
+  * ``fetch_with_watchdog``: a timeout around blocking device->host fetches
+    (a ``device_get`` on a dead worker otherwise hangs the whole sweep);
+  * ``DegradationLadder``: ordered fallback rungs (fused-Pallas -> XLA twin
+    -> packed -> dense -> CPU) an engine steps down when a rung repeatedly
+    faults.
+
+Every retry / fail-fast / watchdog fire / degrade emits a telemetry counter
+and a JSONL event (utils.telemetry) plus one structured log line
+(utils.observability.log_record), so recovery behavior is observable and
+identical across parity sweeps, family sweeps, and user code.
+
+Policy resolution: the module-level default policy is built from env vars
+(``QLDPC_RETRY_ATTEMPTS`` / ``QLDPC_RETRY_BASE_S`` / ``QLDPC_WATCHDOG_SECS``)
+and can be swapped with ``set_default_policy`` or scoped with
+``policy_override`` (tests, benches).  ``time.sleep`` lives ONLY here — a
+guard test (tests/test_resilience.py) keeps bare sleeps and ad-hoc retry
+loops from reappearing elsewhere in the library.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+import time
+
+from . import telemetry
+
+__all__ = [
+    "TransientFault",
+    "WatchdogTimeout",
+    "classify_error",
+    "RetryPolicy",
+    "DegradationLadder",
+    "current_policy",
+    "set_default_policy",
+    "policy_override",
+    "run_cell",
+    "fetch_with_watchdog",
+    "sleep_for",
+]
+
+
+class TransientFault(RuntimeError):
+    """Base class for errors that are transient BY CONSTRUCTION (injected
+    faults subclass this); always classified retryable."""
+
+
+class WatchdogTimeout(TimeoutError):
+    """A watchdog-wrapped host fetch exceeded its deadline (hung worker)."""
+
+
+def sleep_for(seconds: float) -> None:
+    """The single sanctioned sleep in the library (backoff waits, injected
+    drain stalls).  Centralized so the no-bare-sleep guard test has exactly
+    one exemption to police."""
+    if seconds > 0:
+        time.sleep(seconds)
+
+
+# ---------------------------------------------------------------------------
+# Error classification
+# ---------------------------------------------------------------------------
+# Status markers inside JaxRuntimeError messages.  Deterministic: the same
+# program with the same inputs will fail the same way — retrying burns the
+# budget (ISSUE fail-fast criterion).  Resource: same program -> same OOM,
+# so retrying the SAME rung is a guaranteed loss too, but stepping the
+# degradation ladder down to a cheaper rung can clear it.  Transient:
+# infrastructure state that a worker restart / cache reset can clear.
+_DETERMINISTIC_MARKERS = (
+    "INVALID_ARGUMENT",
+    "FAILED_PRECONDITION",
+    "UNIMPLEMENTED",
+    "donated",             # buffer already consumed — a programming error
+)
+_RESOURCE_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+)
+
+
+def classify_error(exc: BaseException) -> str:
+    """``"transient"`` (retry can help), ``"resource"`` (retrying the same
+    rung cannot help but degrading to a cheaper one can), or
+    ``"deterministic"`` (fail fast).
+
+    JaxRuntimeError subclasses are transient by default — worker-death
+    messages vary wildly across libtpu builds — EXCEPT for status codes
+    that name a program bug (INVALID_ARGUMENT etc.) or an allocation
+    failure.  Watchdog timeouts, connection drops, and injected
+    ``TransientFault``s are transient; everything else (ValueError,
+    TypeError, AssertionError, ...) is a deterministic bug."""
+    if isinstance(exc, (TransientFault, WatchdogTimeout)):
+        return "transient"
+    if isinstance(exc, (TimeoutError, ConnectionError, BrokenPipeError)):
+        return "transient"
+    try:
+        import jax
+
+        jax_runtime_error = jax.errors.JaxRuntimeError
+    except Exception:  # no live jax — classification must still work
+        jax_runtime_error = ()
+    if isinstance(exc, jax_runtime_error):
+        msg = str(exc)
+        if any(marker in msg for marker in _DETERMINISTIC_MARKERS):
+            return "deterministic"
+        if any(marker in msg for marker in _RESOURCE_MARKERS):
+            return "resource"
+        return "transient"
+    return "deterministic"
+
+
+# ---------------------------------------------------------------------------
+# Degradation ladder
+# ---------------------------------------------------------------------------
+class DegradationLadder:
+    """Ordered fallback rungs an execution path steps down when a rung
+    repeatedly faults.  ``rungs`` is a list of ``(name, apply_fn)`` pairs;
+    ``step()`` applies the next one (telemetry-counted) and returns its
+    name, or ``None`` when the ladder is exhausted.  Engines build their
+    ladder from their live config (sim/data_error.py: fused-Pallas -> XLA
+    twin -> packed -> dense -> CPU; sim/phenom.py: packed -> dense -> CPU);
+    every rung below the opt-in fused sampler is bit-exact with the one
+    above it, so a degraded run still reproduces the fault-free result
+    seed-for-seed."""
+
+    def __init__(self, rungs):
+        self._rungs = list(rungs)
+        self._pos = 0
+
+    @property
+    def remaining(self) -> int:
+        return len(self._rungs) - self._pos
+
+    def step(self) -> str | None:
+        if self._pos >= len(self._rungs):
+            return None
+        name, apply_fn = self._rungs[self._pos]
+        self._pos += 1
+        apply_fn()
+        telemetry.count("resilience.degrades")
+        telemetry.event("degrade", rung=name)
+        _log("degrade", rung=name)
+        return name
+
+
+# ---------------------------------------------------------------------------
+# Retry policy
+# ---------------------------------------------------------------------------
+def _log(event: str, **fields) -> None:
+    from .observability import get_logger, log_record
+
+    log_record(get_logger(), event, **fields)
+
+
+def _reset_device_caches() -> None:
+    """Drop all device-resident memos + jit caches (promoted from the
+    scripts/parity.py copy): after a worker restart every cached buffer is
+    dead, and the persistent compilation cache absorbs the recompiles."""
+    from .. import reset_device_state
+
+    reset_device_state()
+
+
+class RetryPolicy:
+    """Jittered-exponential-backoff retry for transient infrastructure
+    faults.
+
+    * deterministic errors (``classify_error``) re-raise IMMEDIATELY — no
+      attempt of the backoff budget is burned on a guaranteed loss;
+    * between transient attempts the policy resets device caches
+      (``reset_device_state``) and sleeps ``base_delay * backoff**i``
+      clamped to ``max_delay``, with multiplicative jitter of ±``jitter``
+      drawn from a policy-seeded PRNG (deterministic per policy instance);
+    * ``degrade_after``: every that-many consecutive transient failures the
+      ``degrade`` hook passed to ``run`` is stepped once (an engine's
+      ``DegradationLadder``);
+    * ``watchdog_s``: deadline handed to ``fetch_with_watchdog`` for host
+      fetches guarded under this policy (None = no watchdog).
+
+    ``run(fn)`` executes ``fn()`` under the policy.  ``fn`` must be safe to
+    re-execute from scratch (engine WER runs are: deterministic in their
+    key, accumulation is idempotent-by-restart, and mid-cell progress
+    records turn a restart into a resume).
+    """
+
+    def __init__(self, max_attempts: int = 3, base_delay: float = 2.0,
+                 backoff: float = 4.0, max_delay: float = 240.0,
+                 jitter: float = 0.25, watchdog_s: float | None = None,
+                 degrade_after: int = 2, reset_caches: bool = True,
+                 seed: int = 0):
+        self.max_attempts = max(1, int(max_attempts))
+        self.base_delay = float(base_delay)
+        self.backoff = float(backoff)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self.watchdog_s = watchdog_s
+        self.degrade_after = max(1, int(degrade_after))
+        self.reset_caches = bool(reset_caches)
+        self._rng = random.Random(seed)
+
+    def delay(self, failure_index: int) -> float:
+        d = min(self.base_delay * self.backoff ** failure_index,
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+    @property
+    def trivial(self) -> bool:
+        """True when ``run`` can be a plain call (no retries, no watchdog) —
+        the zero-fault fast path."""
+        return self.max_attempts <= 1 and self.watchdog_s is None
+
+    def run(self, fn, *, label: str = "", degrade=None):
+        """Execute ``fn()``; retry transient faults with backoff, fail fast
+        on deterministic ones, step ``degrade`` after repeated faults."""
+        failures = 0
+        while True:
+            try:
+                return fn()
+            except Exception as exc:  # noqa: BLE001 — classification decides
+                kind = classify_error(exc)
+                summary = f"{type(exc).__name__}: " + \
+                    str(exc).splitlines()[0][:120] if str(exc) else \
+                    type(exc).__name__
+                if kind == "deterministic":
+                    telemetry.count("resilience.deterministic_failures")
+                    telemetry.event("fail_fast", label=label, error=summary)
+                    _log("fail_fast", label=label, error=summary)
+                    raise
+                if kind == "resource":
+                    # retrying the SAME rung cannot help (same program ->
+                    # same OOM): only a ladder step makes another attempt
+                    # worthwhile — no ladder / exhausted ladder fails fast.
+                    # A successful step re-attempts IMMEDIATELY: nothing
+                    # transient is being waited out, so no backoff sleep,
+                    # and no transient-budget burn (the ladder length bounds
+                    # the loop).
+                    if degrade is None or degrade() is None:
+                        telemetry.count("resilience.deterministic_failures")
+                        telemetry.event("fail_fast", label=label,
+                                        error=summary)
+                        _log("fail_fast", label=label, error=summary)
+                        raise
+                    telemetry.event("retry", label=label, attempt=failures,
+                                    wait_s=0.0, error=summary)
+                    _log("retry", label=label, attempt=failures, wait_s=0.0,
+                         error=summary)
+                    continue
+                failures += 1
+                if failures >= self.max_attempts:
+                    telemetry.count("resilience.exhausted")
+                    telemetry.event("retry_exhausted", label=label,
+                                    attempts=failures, error=summary)
+                    _log("retry_exhausted", label=label, attempts=failures,
+                         error=summary)
+                    raise
+                if kind == "transient" and degrade is not None \
+                        and failures % self.degrade_after == 0:
+                    degrade()
+                wait = self.delay(failures - 1)
+                telemetry.count("resilience.retries")
+                telemetry.event("retry", label=label, attempt=failures,
+                                wait_s=round(wait, 3), error=summary)
+                _log("retry", label=label, attempt=failures,
+                     wait_s=round(wait, 3), error=summary)
+                if self.reset_caches:
+                    try:
+                        _reset_device_caches()
+                    except Exception:  # cache reset must never mask the retry
+                        pass
+                sleep_for(wait)
+
+
+# ---------------------------------------------------------------------------
+# Default policy: env-configured, swap-able, scope-able
+# ---------------------------------------------------------------------------
+def _env_policy() -> "RetryPolicy | None":
+    """Build the process default from env vars.  ``QLDPC_RETRY_ATTEMPTS=1``
+    with no watchdog yields a trivial policy (pure pass-through);
+    ``QLDPC_RETRY_ATTEMPTS=0`` disables the layer entirely."""
+    attempts = int(os.environ.get("QLDPC_RETRY_ATTEMPTS", "3"))
+    if attempts <= 0:
+        return None
+    base = float(os.environ.get("QLDPC_RETRY_BASE_S", "2.0"))
+    watchdog = float(os.environ.get("QLDPC_WATCHDOG_SECS", "0")) or None
+    return RetryPolicy(max_attempts=attempts, base_delay=base,
+                       watchdog_s=watchdog)
+
+
+_POLICY_LOCK = threading.Lock()
+_DEFAULT_POLICY: RetryPolicy | None = None
+_POLICY_INITIALIZED = False
+_OVERRIDE = threading.local()
+
+
+def current_policy() -> RetryPolicy | None:
+    """The active policy: a thread-local override if one is in scope, else
+    the process default (env-configured on first use)."""
+    override = getattr(_OVERRIDE, "stack", None)
+    if override:
+        return override[-1]
+    global _POLICY_INITIALIZED, _DEFAULT_POLICY
+    if not _POLICY_INITIALIZED:
+        with _POLICY_LOCK:
+            if not _POLICY_INITIALIZED:
+                _DEFAULT_POLICY = _env_policy()
+                _POLICY_INITIALIZED = True
+    return _DEFAULT_POLICY
+
+
+def set_default_policy(policy: RetryPolicy | None) -> None:
+    """Replace the process-wide default (None disables the layer)."""
+    global _DEFAULT_POLICY, _POLICY_INITIALIZED
+    with _POLICY_LOCK:
+        _DEFAULT_POLICY = policy
+        _POLICY_INITIALIZED = True
+
+
+@contextlib.contextmanager
+def policy_override(policy: RetryPolicy | None):
+    """Scope a policy (or None = resilience off) to the current thread —
+    tests and the bench A/B use this; nesting restores the outer policy."""
+    stack = getattr(_OVERRIDE, "stack", None)
+    if stack is None:
+        stack = _OVERRIDE.stack = []
+    stack.append(policy)
+    try:
+        yield policy
+    finally:
+        stack.pop()
+
+
+def run_cell(fn, *, label: str = "", degrade=None):
+    """Run one unit of recoverable work (an engine WER run, a sweep cell, a
+    megabatch dispatch) under the active policy.  The zero-fault fast path
+    is one ``current_policy()`` read and a ``trivial`` check."""
+    policy = current_policy()
+    if policy is None or policy.trivial:
+        return fn()
+    return policy.run(fn, label=label, degrade=degrade)
+
+
+# ---------------------------------------------------------------------------
+# Dispatch watchdog
+# ---------------------------------------------------------------------------
+def fetch_with_watchdog(fn, *, label: str = "", timeout_s: float | None = None):
+    """Run a blocking host fetch with a deadline.  ``timeout_s`` defaults to
+    the active policy's ``watchdog_s``; with no deadline the call is direct
+    (zero overhead).  With one, the fetch runs on its own DAEMON thread and
+    a ``WatchdogTimeout`` (transient — the surrounding RetryPolicy retries
+    or resumes) is raised if it misses the deadline.  Daemon threads are
+    deliberate: an abandoned fetch blocked in ``device_get`` on a
+    dead-hung worker must neither block interpreter shutdown nor exhaust a
+    shared pool and un-time later fetches (one thread per fetch; creation
+    cost is microseconds against the ~100 ms transfers being guarded)."""
+    if timeout_s is None:
+        policy = current_policy()
+        timeout_s = policy.watchdog_s if policy is not None else None
+    if timeout_s is None:
+        return fn()
+    box: dict = {}
+    done = threading.Event()
+
+    def _runner():
+        try:
+            box["value"] = fn()
+        except BaseException as exc:  # noqa: BLE001 — re-raised in caller
+            box["error"] = exc
+        finally:
+            done.set()
+
+    threading.Thread(target=_runner, daemon=True,
+                     name=f"qldpc-watchdog:{label or 'fetch'}").start()
+    if done.wait(timeout=float(timeout_s)):
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+    telemetry.count("resilience.watchdog_fires")
+    telemetry.event("watchdog_timeout", label=label,
+                    timeout_s=float(timeout_s))
+    _log("watchdog_timeout", label=label, timeout_s=float(timeout_s))
+    raise WatchdogTimeout(
+        f"host fetch {label or 'fetch'!r} exceeded {timeout_s}s "
+        "(hung device->host transfer — dead or wedged worker)")
+
+
+def guarded_fetch(fn, *, label: str = ""):
+    """Watchdog + retry around one blocking host fetch: the deadline comes
+    from the active policy, and a timed-out (or transiently failed) fetch
+    re-runs under the same policy — the device values being fetched stay
+    alive across attempts, so a retried fetch is bit-exact.  Callers must
+    pass an ``fn`` that is pure or idempotent (device_get of a live buffer,
+    OSD postprocess of a pending batch): a fetch that timed out but is
+    still limping along on its abandoned thread may complete concurrently
+    with the retry, so side effects would race (telemetry counters inside
+    ``fn`` can double-count in that window; estimator state may not)."""
+    policy = current_policy()
+    if policy is None or policy.trivial:
+        return fn()
+    return policy.run(
+        lambda: fetch_with_watchdog(fn, label=label,
+                                    timeout_s=policy.watchdog_s),
+        label=label)

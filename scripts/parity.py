@@ -291,30 +291,29 @@ EXPERIMENTS = {
 }
 
 
-def _run_cell_with_retry(cell, *args, retries: int = 5, **kwargs):
-    """The tunneled TPU worker intermittently crashes mid-dispatch on large
-    programs (infrastructure flake — it auto-restarts).  Retry the cell
-    after dropping all device-resident caches; results are unaffected
-    (cells are deterministic in their seed).  Backoff grows because a
-    crashed worker can take minutes to come back — three quick retries in
-    ~30 s all land on the dead worker and burn the whole budget (observed
-    round 4, hgp_phenl 4-member run)."""
-    import jax
+# Cell-level retry: the tunneled TPU worker intermittently crashes
+# mid-dispatch on large programs (infrastructure flake — it auto-restarts)
+# and can take minutes to come back, so the backoff grows: quick retries in
+# ~30 s all land on the dead worker and burn the whole budget (observed
+# round 4, hgp_phenl 4-member run).  The library RetryPolicy
+# (utils.resilience) replaces the ad-hoc loop this script used to carry:
+# same 15/30/60/120 s schedule (now jittered), same reset_device_state()
+# between attempts, but retry decisions/counters/structured log lines are
+# identical across parity, sweeps, and user code — and deterministic bugs
+# fail FAST instead of burning five attempts.  The engines' own (shorter)
+# default policy handles quick flakes underneath; this outer policy is the
+# worker-comeback belt.
+from qldpc_fault_tolerance_tpu.utils.resilience import RetryPolicy  # noqa: E402
 
-    import qldpc_fault_tolerance_tpu as q
+_CELL_POLICY = RetryPolicy(max_attempts=5, base_delay=15.0, backoff=2.0,
+                           max_delay=240.0, jitter=0.25, seed=0)
 
-    for attempt in range(retries):
-        try:
-            return cell(*args, **kwargs)
-        except jax.errors.JaxRuntimeError as e:
-            if attempt == retries - 1:
-                raise
-            wait = 15 * 2 ** attempt  # 15/30/60/120 s
-            print(f"TPU worker error ({str(e).splitlines()[0][:90]}); "
-                  f"resetting device caches, retrying in {wait}s "
-                  f"({attempt + 1}/{retries})", file=sys.stderr)
-            q.reset_device_state()
-            time.sleep(wait)
+
+def _run_cell_with_retry(cell, *args, **kwargs):
+    """Run one parity cell under the worker-comeback retry policy (results
+    are unaffected: cells are deterministic in their seed)."""
+    return _CELL_POLICY.run(lambda: cell(*args, **kwargs),
+                            label="parity_cell")
 
 
 def run_experiment(name, cycles_list, seeds, scale, batch_size,

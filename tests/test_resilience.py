@@ -1,0 +1,571 @@
+"""Resilient execution layer: fault injection, retry/backoff, watchdogs,
+degradation ladder, mid-cell resume (utils/resilience.py +
+utils/faultinject.py), plus the SweepCheckpoint crash-tolerance satellites.
+
+Every recovery path runs here on CPU via the deterministic fault plans in
+utils.faultinject — the real failure modes (tunneled-worker death, hung
+drains, kills mid-checkpoint-append) cannot be produced on demand in CI.
+"""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+from qldpc_fault_tolerance_tpu.utils import faultinject, resilience, telemetry
+from qldpc_fault_tolerance_tpu.utils.checkpoint import (
+    CellProgress,
+    SweepCheckpoint,
+)
+
+LIB_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))
+) + "/qldpc_fault_tolerance_tpu"
+
+pytestmark = pytest.mark.faults
+
+
+def fast_policy(**kw):
+    """Retry policy with no real backoff (tests must not sleep)."""
+    kw.setdefault("max_attempts", 4)
+    kw.setdefault("base_delay", 0.0)
+    kw.setdefault("jitter", 0.0)
+    kw.setdefault("reset_caches", False)  # keep CPU tests snappy
+    return resilience.RetryPolicy(**kw)
+
+
+def data_sim(**kw):
+    code = hgp(rep_code(3), rep_code(3))
+    p = kw.pop("p", 0.05)
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=6)  # noqa: E731
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("scan_chunk", 2)
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 3] * 3, seed=0, **kw)
+
+
+def phenom_sim(**kw):
+    code = hgp(rep_code(3), rep_code(3))
+    p = kw.pop("p", 0.04)
+    ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+    extz = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+    d1 = lambda h: BPDecoder(  # noqa: E731
+        h, np.full(h.shape[1], p), max_iter=4)
+    d2 = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=6)  # noqa: E731
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("scan_chunk", 2)
+    return CodeSimulator_Phenon(
+        code=code, decoder1_x=d1(extz), decoder1_z=d1(ext),
+        decoder2_x=d2(code.hz), decoder2_z=d2(code.hx),
+        pauli_error_probs=[p / 3] * 3, q=p, seed=0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# error classification
+# ---------------------------------------------------------------------------
+def test_classify_error():
+    assert resilience.classify_error(
+        faultinject.InjectedFault("boom")) == "transient"
+    assert resilience.classify_error(
+        resilience.WatchdogTimeout("hung")) == "transient"
+    assert resilience.classify_error(TimeoutError("t")) == "transient"
+    assert resilience.classify_error(ValueError("bad")) == "deterministic"
+    assert resilience.classify_error(
+        faultinject.InjectedDeterministicFault("bug")) == "deterministic"
+    assert resilience.classify_error(
+        jax.errors.JaxRuntimeError("INTERNAL: worker died")) == "transient"
+    assert resilience.classify_error(
+        jax.errors.JaxRuntimeError("INVALID_ARGUMENT: bad shape")
+    ) == "deterministic"
+
+
+def test_resource_errors_step_ladder_not_retry_in_place():
+    """RESOURCE_EXHAUSTED: retrying the same rung is a guaranteed loss, but
+    a ladder step can clear it; with no ladder left it fails fast."""
+    assert resilience.classify_error(
+        jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: oom")) == "resource"
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise jax.errors.JaxRuntimeError("RESOURCE_EXHAUSTED: oom")
+        return "ok"
+
+    ladder = resilience.DegradationLadder([("a->b", lambda: None)])
+    assert fast_policy().run(flaky, label="t", degrade=ladder.step) == "ok"
+    assert ladder.remaining == 0  # the rung was actually consumed
+    calls["n"] = 0
+    with pytest.raises(jax.errors.JaxRuntimeError):
+        fast_policy().run(flaky, label="t")  # no ladder -> fail fast
+    assert calls["n"] == 1
+
+
+def test_retry_policy_backoff_is_jittered_exponential():
+    pol = resilience.RetryPolicy(base_delay=1.0, backoff=2.0, max_delay=16.0,
+                                 jitter=0.25, seed=7)
+    delays = [pol.delay(i) for i in range(4)]
+    for i, d in enumerate(delays):
+        nominal = min(1.0 * 2.0 ** i, 16.0)
+        assert 0.75 * nominal <= d <= 1.25 * nominal
+    # deterministic per seed
+    pol2 = resilience.RetryPolicy(base_delay=1.0, backoff=2.0, max_delay=16.0,
+                                  jitter=0.25, seed=7)
+    assert delays == [pol2.delay(i) for i in range(4)]
+
+
+# ---------------------------------------------------------------------------
+# (a) transient faults retry and converge bit-exact
+# ---------------------------------------------------------------------------
+def test_transient_fault_mid_megabatch_retries_bitexact_data():
+    key = jax.random.PRNGKey(11)
+    clean = data_sim().WordErrorRate(64 * 8, key=key)
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=1),
+    ])
+    with resilience.policy_override(fast_policy()), plan.active():
+        with telemetry.session(reset_metrics=True) as reg:
+            faulted = data_sim().WordErrorRate(64 * 8, key=key)
+            snap = reg.snapshot()
+    assert faulted == clean
+    assert snap["faultinject.injected"]["value"] == 1
+    assert snap["resilience.retries"]["value"] == 1
+
+
+def test_transient_fault_retries_bitexact_phenom():
+    key = jax.random.PRNGKey(12)
+    clean = phenom_sim().WordErrorRate(num_rounds=3, num_samples=64 * 4,
+                                       key=key)
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="wer.phenl", kind="raise", after=0),
+    ])
+    with resilience.policy_override(fast_policy()), plan.active():
+        with telemetry.session(reset_metrics=True) as reg:
+            faulted = phenom_sim().WordErrorRate(num_rounds=3,
+                                                 num_samples=64 * 4, key=key)
+            snap = reg.snapshot()
+    assert faulted == clean
+    assert snap["resilience.retries"]["value"] == 1
+
+
+# ---------------------------------------------------------------------------
+# (b) deterministic faults fail fast without burning the backoff budget
+# ---------------------------------------------------------------------------
+def test_deterministic_fault_fails_fast():
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="deterministic",
+                          count=99),
+    ])
+    # a policy whose backoff would be unmissable if it ran
+    pol = fast_policy(max_attempts=5, base_delay=30.0)
+    t0 = time.perf_counter()
+    with resilience.policy_override(pol), plan.active():
+        with telemetry.session(reset_metrics=True) as reg:
+            with pytest.raises(faultinject.InjectedDeterministicFault):
+                data_sim().WordErrorRate(64 * 4, key=jax.random.PRNGKey(0))
+            snap = reg.snapshot()
+    assert time.perf_counter() - t0 < 10.0  # no 30 s backoff was burned
+    assert plan.hits("megabatch_dispatch") == 1  # exactly one attempt
+    # counted once per policy layer that saw it (dispatch + engine)
+    assert snap["resilience.deterministic_failures"]["value"] >= 1
+    assert "resilience.retries" not in snap
+
+
+def test_retry_budget_exhaustion_reraises():
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="wer.data", kind="raise", count=99),
+    ])
+    with resilience.policy_override(fast_policy(max_attempts=2)):
+        with plan.active():
+            with telemetry.session(reset_metrics=True) as reg:
+                with pytest.raises(faultinject.InjectedFault):
+                    data_sim().WordErrorRate(64 * 2,
+                                             key=jax.random.PRNGKey(1))
+                snap = reg.snapshot()
+    assert snap["resilience.exhausted"]["value"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# (c) watchdog fires on a stalled drain
+# ---------------------------------------------------------------------------
+def test_watchdog_fires_on_stalled_drain_and_run_completes():
+    key = jax.random.PRNGKey(13)
+    clean = data_sim(p=0.2).WordErrorRate(64 * 8, key=key, target_failures=10 ** 9)
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_drain", kind="stall", stall_s=2.0),
+    ])
+    pol = fast_policy(watchdog_s=0.2)
+    with resilience.policy_override(pol), plan.active():
+        with telemetry.session(reset_metrics=True) as reg:
+            faulted = data_sim(p=0.2).WordErrorRate(64 * 8, key=key,
+                                                    target_failures=10 ** 9)
+            snap = reg.snapshot()
+    assert faulted == clean
+    assert snap["resilience.watchdog_fires"]["value"] >= 1
+    assert snap["resilience.retries"]["value"] >= 1
+
+
+def test_fetch_with_watchdog_direct():
+    with pytest.raises(resilience.WatchdogTimeout):
+        resilience.fetch_with_watchdog(lambda: time.sleep(1.0) or 1,
+                                       label="t", timeout_s=0.05)
+    assert resilience.fetch_with_watchdog(lambda: 42, label="t",
+                                          timeout_s=5.0) == 42
+    assert resilience.fetch_with_watchdog(lambda: 43, label="t") == 43
+
+
+# ---------------------------------------------------------------------------
+# (d) mid-cell resume reproduces the uninterrupted WER seed-for-seed
+# ---------------------------------------------------------------------------
+def test_mid_cell_resume_bitexact_data(tmp_path):
+    key = jax.random.PRNGKey(21)
+    shots = 64 * 16  # 16 batches = 8 megabatches at scan_chunk 2
+    clean = data_sim().WordErrorRate(shots, key=key)
+
+    ckpt_path = str(tmp_path / "cells.jsonl")
+    cell_key = {"code": "rep3hgp", "noise": "data", "p": 0.05}
+
+    # run 1: killed mid-cell after a few megabatches persisted progress
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=3,
+                          count=99),
+    ])
+    progress = CellProgress(SweepCheckpoint(ckpt_path), cell_key, every=1)
+    with resilience.policy_override(fast_policy(max_attempts=1)):
+        with plan.active():
+            with pytest.raises(faultinject.InjectedFault):
+                data_sim().WordErrorRate(shots, key=key, progress=progress)
+
+    # run 2: fresh process state, no faults — resumes from the cursor.
+    # Megabatches 1-3 computed but the double-buffered drain only persisted
+    # 1-2 before the kill (megabatch 3's carry never crossed the wire), so
+    # the cursor sits at 4 batches and the resume replays the remaining 6
+    # megabatches.
+    ckpt = SweepCheckpoint(ckpt_path)
+    st = ckpt.get_progress(cell_key)
+    assert st is not None and st["batches_done"] == 4
+    progress2 = CellProgress(ckpt, cell_key, every=1)
+    with telemetry.session(reset_metrics=True) as reg:
+        sim = data_sim()
+        resumed = sim.WordErrorRate(shots, key=key, progress=progress2)
+        snap = reg.snapshot()
+    assert resumed == clean  # seed-for-seed identical
+    assert snap["resilience.resumes"]["value"] == 1
+    assert sim.last_dispatches == 6  # only the remaining 6 of 8 megabatches
+
+
+def test_mid_cell_resume_bitexact_phenom(tmp_path):
+    key = jax.random.PRNGKey(22)
+    samples = 64 * 8
+    clean = phenom_sim().WordErrorRate(num_rounds=3, num_samples=samples,
+                                       key=key)
+    ckpt_path = str(tmp_path / "cells.jsonl")
+    cell_key = {"code": "rep3hgp", "noise": "phenl", "p": 0.04}
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=2,
+                          count=99),
+    ])
+    progress = CellProgress(SweepCheckpoint(ckpt_path), cell_key)
+    with resilience.policy_override(fast_policy(max_attempts=1)):
+        with plan.active():
+            with pytest.raises(faultinject.InjectedFault):
+                phenom_sim().WordErrorRate(num_rounds=3, num_samples=samples,
+                                           key=key, progress=progress)
+    ckpt = SweepCheckpoint(ckpt_path)
+    # double-buffered drain: only megabatch 1 (2 batches) was persisted
+    # before the kill on megabatch 3's dispatch
+    assert ckpt.get_progress(cell_key)["batches_done"] == 2
+    resumed = phenom_sim().WordErrorRate(
+        num_rounds=3, num_samples=samples, key=key,
+        progress=CellProgress(ckpt, cell_key))
+    assert resumed == clean
+
+
+def test_resume_with_crossed_target_does_not_overrun(tmp_path):
+    """A cursor persisted at the early-stop crossing (run killed between
+    the crossing megabatch's save and the cell record) must resume to the
+    SAME (failures, shots) — not stream another megabatch."""
+    key = jax.random.PRNGKey(24)
+    ckpt = SweepCheckpoint(str(tmp_path / "cells.jsonl"))
+    cell_key = {"code": "rep3hgp", "noise": "data", "p": 0.2}
+    sim = data_sim(p=0.2)
+    first = sim.WordErrorRate(64 * 16, key=key, target_failures=1,
+                              progress=CellProgress(ckpt, cell_key))
+    assert ckpt.get_progress(cell_key) is not None  # cursor left behind
+    # "resume" from the leftover cursor (as after a kill before put):
+    sim2 = data_sim(p=0.2)
+    resumed = sim2.WordErrorRate(64 * 16, key=key, target_failures=1,
+                                 progress=CellProgress(ckpt, cell_key))
+    assert resumed == first
+    assert sim2.last_dispatches == 0  # nothing re-streamed
+
+
+def test_resume_ignores_stale_fingerprint(tmp_path):
+    key = jax.random.PRNGKey(23)
+    ckpt_path = str(tmp_path / "cells.jsonl")
+    cell_key = {"code": "rep3hgp", "noise": "data", "p": 0.05}
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=2,
+                          count=99),
+    ])
+    progress = CellProgress(SweepCheckpoint(ckpt_path), cell_key)
+    with resilience.policy_override(fast_policy(max_attempts=1)):
+        with plan.active():
+            with pytest.raises(faultinject.InjectedFault):
+                data_sim().WordErrorRate(64 * 16, key=key, progress=progress)
+    # different key => different stream => the cursor must NOT be honored
+    ckpt = SweepCheckpoint(ckpt_path)
+    other_key = jax.random.PRNGKey(99)
+    clean = data_sim().WordErrorRate(64 * 16, key=other_key)
+    with pytest.warns(UserWarning, match="fingerprint"):
+        resumed = data_sim().WordErrorRate(
+            64 * 16, key=other_key, progress=CellProgress(ckpt, cell_key))
+    assert resumed == clean
+
+
+def test_combined_kill_plus_stall_plan_bitexact_both_engines(tmp_path):
+    """The acceptance scenario: one plan with a kill mid-megabatch AND a
+    drain stall; a data_error and a phenom WER run both complete bit-exact
+    vs the fault-free run, with retry/watchdog counters in the snapshot."""
+    pol = fast_policy(max_attempts=4, watchdog_s=0.2)
+
+    def make_plan():
+        return faultinject.FaultPlan([
+            faultinject.Fault(site="megabatch_dispatch", kind="raise",
+                              after=1),
+            faultinject.Fault(site="megabatch_drain", kind="stall",
+                              stall_s=2.0),
+        ])
+
+    key = jax.random.PRNGKey(41)
+    # data engine: target_failures engages the streamed (drained) path
+    clean_d = data_sim().WordErrorRate(64 * 8, key=key,
+                                       target_failures=10 ** 9)
+    with resilience.policy_override(pol), make_plan().active():
+        with telemetry.session(reset_metrics=True) as reg:
+            faulted_d = data_sim().WordErrorRate(64 * 8, key=key,
+                                                 target_failures=10 ** 9)
+            snap_d = reg.snapshot()
+    assert faulted_d == clean_d
+    assert snap_d["faultinject.injected"]["value"] == 2
+    assert snap_d["resilience.retries"]["value"] >= 2
+    assert snap_d["resilience.watchdog_fires"]["value"] >= 1
+
+    # phenom engine: a progress cursor engages the streamed path
+    clean_p = phenom_sim().WordErrorRate(num_rounds=3, num_samples=64 * 8,
+                                         key=key)
+    ckpt = SweepCheckpoint(str(tmp_path / "cells.jsonl"))
+    with resilience.policy_override(pol), make_plan().active():
+        with telemetry.session(reset_metrics=True) as reg:
+            faulted_p = phenom_sim().WordErrorRate(
+                num_rounds=3, num_samples=64 * 8, key=key,
+                progress=CellProgress(ckpt, {"cell": "phenl"}))
+            snap_p = reg.snapshot()
+    assert faulted_p == clean_p
+    assert snap_p["resilience.retries"]["value"] >= 2
+    assert snap_p["resilience.watchdog_fires"]["value"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+def test_degradation_ladder_steps_packed_to_dense_bitexact():
+    key = jax.random.PRNGKey(31)
+    clean = data_sim().WordErrorRate(64 * 4, key=key)
+    # every engine-level attempt faults twice => degrade_after=1 steps the
+    # ladder after the first failure; the packed->dense rung is bit-exact
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="wer.data", kind="raise", count=2),
+    ])
+    pol = fast_policy(max_attempts=4, degrade_after=1)
+    with resilience.policy_override(pol), plan.active():
+        with telemetry.session(reset_metrics=True) as reg:
+            sim = data_sim()
+            degraded = sim.WordErrorRate(64 * 4, key=key)
+            snap = reg.snapshot()
+    assert degraded == clean
+    assert not sim._packed  # the ladder actually stepped
+    assert snap["resilience.degrades"]["value"] >= 1
+
+
+def test_degradation_ladder_order_data():
+    sim = data_sim()
+    assert sim._degrade_once() == "packed->dense"
+    assert sim._packed is False
+    assert sim._degrade_once() is None  # CPU backend: ladder exhausted
+    sim2 = phenom_sim()
+    assert sim2._degrade_once() == "packed->dense"
+    assert sim2._degrade_once() is None
+
+
+# ---------------------------------------------------------------------------
+# SweepCheckpoint hardening satellites
+# ---------------------------------------------------------------------------
+def test_checkpoint_skips_corrupt_trailing_line(tmp_path):
+    path = str(tmp_path / "sweep.jsonl")
+    good = {"key": {"p": 0.01}, "record": {"wer": 0.5}}
+    with open(path, "w") as f:
+        f.write(json.dumps(good) + "\n")
+        f.write('{"key": {"p": 0.02}, "record": {"wer"')  # torn mid-append
+    with telemetry.session(reset_metrics=True) as reg:
+        with pytest.warns(UserWarning, match="corrupt checkpoint line"):
+            ckpt = SweepCheckpoint(path)
+        snap = reg.snapshot()
+    assert len(ckpt) == 1
+    assert ckpt.get({"p": 0.01}) == {"wer": 0.5}
+    assert ckpt.get({"p": 0.02}) is None
+    assert snap["ckpt.corrupt_lines"]["value"] == 1
+    # the resume still works: the lost cell simply reruns
+    ckpt.put({"p": 0.02}, {"wer": 0.25})
+    ckpt2 = SweepCheckpoint(path)  # trailing garbage now mid-file; still ok
+    assert len(ckpt2) == 2
+
+
+def test_checkpoint_write_kill_injection_roundtrip(tmp_path):
+    path = str(tmp_path / "sweep.jsonl")
+    ckpt = SweepCheckpoint(path)
+    ckpt.put({"p": 0.01}, {"wer": 0.5})
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="sweep_ckpt_put", kind="truncate"),
+    ])
+    with plan.active():
+        with pytest.raises(faultinject.InjectedFault):
+            ckpt.put({"p": 0.02}, {"wer": 0.25})
+    # the SAME (surviving) process appends again: the torn tail must not
+    # corrupt the next record (the writer starts it on a fresh line)
+    ckpt.put({"p": 0.03}, {"wer": 0.125})
+    with pytest.warns(UserWarning, match="corrupt checkpoint line"):
+        ckpt2 = SweepCheckpoint(path)
+    assert len(ckpt2) == 2
+    assert ckpt2.get({"p": 0.01}) == {"wer": 0.5}
+    assert ckpt2.get({"p": 0.03}) == {"wer": 0.125}
+    assert ckpt2.get({"p": 0.02}) is None  # the killed append is lost
+
+
+def test_checkpoint_progress_records_roundtrip(tmp_path):
+    path = str(tmp_path / "sweep.jsonl")
+    ckpt = SweepCheckpoint(path)
+    key = {"p": 0.01}
+    ckpt.put_progress(key, {"v": 2, "batches_done": 4, "failures": 1,
+                            "min_w": 9, "fingerprint": {"k": 1}})
+    ckpt.put_progress(key, {"v": 2, "batches_done": 8, "failures": 3,
+                            "min_w": 9, "fingerprint": {"k": 1}})
+    # latest progress line wins on reload; cell is NOT finished
+    ckpt2 = SweepCheckpoint(path)
+    assert key not in ckpt2 and len(ckpt2) == 0
+    assert ckpt2.get_progress(key)["batches_done"] == 8
+    # a finished cell supersedes its progress
+    ckpt2.put(key, {"wer": 0.1})
+    ckpt3 = SweepCheckpoint(path)
+    assert ckpt3.get(key) == {"wer": 0.1}
+    assert ckpt3.get_progress(key) is None
+
+
+def test_sweep_eval_wer_resumes_through_checkpoint(tmp_path):
+    """End-to-end: a CodeFamily sweep killed mid-cell resumes through the
+    SAME checkpoint file and produces the uninterrupted result."""
+    from qldpc_fault_tolerance_tpu.decoders import (
+        BPOSD_Decoder_Class,
+        BP_Decoder_Class,
+    )
+    from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+
+    # plain-BP decoder2 keeps the data engine on the pure-device megabatch
+    # path (host-postprocess paths have no mid-cell cursor)
+    fam_args = dict(
+        decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        decoder2_class=BP_Decoder_Class(6, "minimum_sum", 0.625),
+        batch_size=64, seed=1)
+    codes = [hgp(rep_code(3), rep_code(3))]
+    # 32 batches of 64 at the engine's default scan_chunk 8 = 4 megabatches
+    shots = 64 * 32
+    clean = CodeFamily(codes, **fam_args).EvalWER(
+        "data", "Total", [0.05], num_samples=shots, if_plot=False)
+
+    path = str(tmp_path / "sweep.jsonl")
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=2,
+                          count=99),
+    ])
+    with resilience.policy_override(fast_policy(max_attempts=1)):
+        with plan.active():
+            with pytest.raises(faultinject.InjectedFault):
+                CodeFamily(codes, **fam_args).EvalWER(
+                    "data", "Total", [0.05], num_samples=shots,
+                    if_plot=False, checkpoint=SweepCheckpoint(path))
+    resumed = CodeFamily(codes, **fam_args).EvalWER(
+        "data", "Total", [0.05], num_samples=shots, if_plot=False,
+        checkpoint=SweepCheckpoint(path))
+    np.testing.assert_array_equal(resumed, clean)
+
+
+# ---------------------------------------------------------------------------
+# guard: no bare sleeps / ad-hoc retry loops outside utils/resilience.py
+# ---------------------------------------------------------------------------
+def test_no_bare_sleep_or_retry_loops_in_library():
+    """All backoff/retry machinery must live in utils/resilience.py so
+    retry behavior and counters stay identical across parity, sweeps, and
+    user code (mirrors the PR-2 no-bare-print guard).  scripts/parity.py is
+    included: its ad-hoc loop is what this PR replaced."""
+    allowed = {os.path.join("utils", "resilience.py")}
+    scripts_dir = os.path.join(os.path.dirname(LIB_ROOT), "scripts")
+    targets = []
+    for dirpath, _dirnames, filenames in os.walk(LIB_ROOT):
+        targets += [os.path.join(dirpath, fn) for fn in filenames
+                    if fn.endswith(".py")]
+    targets.append(os.path.join(scripts_dir, "parity.py"))
+    offenders = []
+    for path in targets:
+        rel = os.path.relpath(path, LIB_ROOT)
+        if rel in allowed:
+            continue
+        with open(path, encoding="utf-8") as fh:
+            for lineno, line in enumerate(fh, 1):
+                stripped = line.lstrip()
+                if stripped.startswith("#"):
+                    continue
+                if "time.sleep(" in stripped or \
+                        "for attempt in range" in stripped:
+                    offenders.append(f"{rel}:{lineno}: {stripped.rstrip()}")
+    assert not offenders, (
+        "bare sleep / ad-hoc retry loop outside utils/resilience.py "
+        "(use resilience.RetryPolicy / sleep_for):\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# env-var plan activation (subprocess/CI path)
+# ---------------------------------------------------------------------------
+def test_env_var_plan_json_roundtrip():
+    plan = faultinject.FaultPlan.from_json(
+        '{"seed": 3, "faults": [{"site": "wer.data", "kind": "raise", '
+        '"after": 1, "count": 2}]}')
+    assert plan.seed == 3
+    f = plan.faults[0]
+    assert (f.site, f.kind, f.after, f.count) == ("wer.data", "raise", 1, 2)
+    assert not f.matches(1) and f.matches(2) and f.matches(3) \
+        and not f.matches(4)
+    # bare-list form
+    plan2 = faultinject.FaultPlan.from_json('[{"site": "s", "kind": "stall"}]')
+    assert plan2.faults[0].kind == "stall"
+
+
+def test_env_plan_activation(monkeypatch):
+    """QLDPC_FAULT_PLAN installs a plan on the first site() call — the
+    subprocess/CI activation path."""
+    monkeypatch.setenv("QLDPC_FAULT_PLAN",
+                       '[{"site": "env_site", "kind": "raise"}]')
+    monkeypatch.setattr(faultinject, "_ENV_CHECKED", False)
+    monkeypatch.setattr(faultinject, "_ACTIVE", None)
+    faultinject.site("other_site")  # no fault for other sites
+    with pytest.raises(faultinject.InjectedFault):
+        faultinject.site("env_site")
+    faultinject.site("env_site")  # count=1: fired once, then inert
+    faultinject.deactivate()
