@@ -656,12 +656,161 @@ def mode_circuit_cell():
     }
 
 
+def mode_sweep():
+    """Whole-GRID wall clock: the metric the ROADMAP north star actually
+    serves (threshold/distance fits are grids of (code, p) cells, and
+    BENCH_r05 showed the chip nearly idle between cells — hbm_util 0.012 —
+    because the serial grid loop pays per-cell dispatch chains, warmups and
+    host syncs).
+
+    Runs a 2-code x 4-p data-noise grid through CodeFamily.EvalWER twice —
+    fused cell path (sweep/fused.py, the default) vs the serial per-cell
+    loop — with the order-alternating min-of-N protocol from BASELINE.md
+    (sequential A/B showed ±30% phantom deltas on a shared CPU).  Both arms
+    rebuild decoders/simulators per call, exactly as a user sweep does; the
+    warmup rep compiles both arms' programs (the serial value-based
+    pipeline also compiles once per shape bucket).
+
+    The headline grid sits in the DISPATCH-BOUND regime (per-cell device
+    work small against per-cell dispatch/sync/build overhead) — the regime
+    the tunneled TPU lives in at ~50-100ms fixed latency per dispatch,
+    emulated on CPU by keeping per-cell compute small.  A secondary
+    ``compute_bound`` A/B reports the opposite regime (large per-cell
+    compute on this 2-core CPU, where fused and serial pay identical
+    decode flops and the fused win shrinks to the overhead share).
+
+    Extra fields: aggregate cells/s and shots/s of the fused arm, per-cell
+    WER bit-exactness fused-vs-serial (the fused path's acceptance gate),
+    and an adaptive-reallocation pass (target_failures early stop) whose
+    reallocated-shot count and lane-idle fraction come from the telemetry
+    registry.  Env knobs: BENCH_SWEEP_SAMPLES / BENCH_SWEEP_BATCH /
+    BENCH_SWEEP_REPS.
+    """
+    import logging
+
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+    from qldpc_fault_tolerance_tpu.utils.observability import get_logger
+
+    # the per-cell cell_done INFO lines are equal absolute cost in both
+    # arms — which still biases the RATIO (they weigh more against the
+    # faster arm) — so the timed region runs at WARNING, like bench's
+    # telemetry-JSONL suppression
+    _bench_log_level = logging.WARNING
+
+    samples = int(os.environ.get("BENCH_SWEEP_SAMPLES", "128"))
+    batch = int(os.environ.get("BENCH_SWEEP_BATCH", "128"))
+    reps = int(os.environ.get("BENCH_SWEEP_REPS", "9"))
+    codes = [hgp(rep_code(3), rep_code(3), name="hgp_rep3"),
+             hgp(rep_code(4), rep_code(4), name="hgp_rep4")]
+    p_list = [0.02, 0.04, 0.06, 0.08]
+    fam_args = dict(
+        decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        decoder2_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        batch_size=batch, seed=1,
+    )
+
+    def grid(fused, n=None):
+        return CodeFamily(codes, **fam_args).EvalWER(
+            "data", "Total", p_list, num_samples=n or samples,
+            if_plot=False, fused=fused)
+
+    def ab(run, n_reps):
+        """Order-alternating min-of-N over both arms (BASELINE.md)."""
+        t_fused, t_serial = [], []
+        for rep in range(n_reps):
+            arms = ((t_fused, True), (t_serial, False))
+            if rep % 2:
+                arms = arms[::-1]
+            for sink, fused in arms:
+                t0 = time.perf_counter()
+                run(fused)
+                sink.append(time.perf_counter() - t0)
+        return min(t_fused), min(t_serial)
+
+    # warmup/compile both arms (programs memoize module-wide, so fresh
+    # CodeFamily instances in the timed reps hit warm caches — the steady
+    # state a threshold/distance fit loop runs in)
+    wer_fused = grid(True)
+    wer_serial = grid(False)
+    logger = get_logger()
+    saved_level = logger.level
+    logger.setLevel(_bench_log_level)
+    try:
+        fused_s, serial_s = ab(grid, reps)
+        # secondary regime: 8x the shot budget per cell -> compute-dominated
+        cb_samples = 8 * samples
+        grid(True, cb_samples)
+        grid(False, cb_samples)
+        cb_fused, cb_serial = ab(lambda f: grid(f, cb_samples),
+                                 max(2, reps - 2))
+    finally:
+        logger.setLevel(saved_level)
+    n_cells = len(codes) * len(p_list)
+    # per-cell shots: ShotBatcher rounds to whole chunk-multiples of batch
+    shots_per_cell = -(-samples // batch) * batch
+    compute_bound = {
+        "samples_per_cell": cb_samples,
+        "fused_s": round(cb_fused, 3),
+        "serial_s": round(cb_serial, 3),
+        "fused_speedup_vs_serial": round(cb_serial / cb_fused, 2),
+    }
+
+    # adaptive-reallocation pass: early-stop grid with a shot budget of
+    # many megabatches per cell, so converged (high-p) cells actually hand
+    # lanes to the undecided (near-threshold) ones; counters from telemetry
+    with _tele_region():
+        target = 40
+        CodeFamily(codes, **fam_args).EvalWER(
+            "data", "Total", p_list, num_samples=32 * samples,
+            if_plot=False, target_failures=target)
+        snap = telemetry.snapshot()
+
+        def val(name):
+            return snap.get(name, {}).get("value", 0)
+
+        adaptive = {
+            "target_failures": target,
+            "reallocated_shots": val("sweep.reallocated_shots"),
+            "lane_idle_fraction": val("sweep.lane_idle_fraction"),
+            "early_stopped_cells": val("driver.early_stops"),
+            "shots_run": val("sim.shots"),
+        }
+
+    return {
+        "metric": "whole-grid data-noise sweep wall-clock "
+                  f"({len(codes)} codes x {len(p_list)} p, fused vs serial)",
+        "value": round(fused_s, 3),
+        "unit": "s",
+        "vs_baseline": round(serial_s / fused_s, 2),  # >1 = fused faster
+        "grid": {
+            "codes": [c.name for c in codes],
+            "p_points": len(p_list), "samples_per_cell": samples,
+            "batch": batch, "cells": n_cells,
+        },
+        "fused_s": round(fused_s, 3),
+        "serial_s": round(serial_s, 3),
+        "fused_speedup_vs_serial": round(serial_s / fused_s, 2),
+        "cells_per_s": round(n_cells / fused_s, 1),
+        "shots_per_s": round(n_cells * shots_per_cell / fused_s, 1),
+        "wer_bitexact_vs_serial": bool(np.array_equal(wer_fused,
+                                                      wer_serial)),
+        "compute_bound": compute_bound,
+        "adaptive": adaptive,
+    }
+
+
 MODES = {
     "bp": mode_bp,
     "bposd": mode_bposd,
     "st_circuit": mode_st_circuit,
     "phenl_cell": mode_phenl_cell,
     "circuit_cell": mode_circuit_cell,
+    "sweep": mode_sweep,
 }
 
 
@@ -673,7 +822,7 @@ def main():
         # TPU chip, so they must run before this process's own JAX
         # initialization claims it for the other modes
         for name in ("phenl_cell", "circuit_cell", "bp", "bposd",
-                     "st_circuit"):
+                     "st_circuit", "sweep"):
             results[name] = MODES[name]()
             print(json.dumps(results[name]))
         here = os.path.dirname(os.path.abspath(__file__))

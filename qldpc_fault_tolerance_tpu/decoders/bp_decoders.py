@@ -176,6 +176,26 @@ def decode_device(static, state, syndromes):
 _decode_device_jit = jax.jit(decode_device, static_argnums=0)
 
 
+def _maybe_pallas_head(bp_method: str, graph_host):
+    """VMEM-resident Pallas head when the backend/method/size allow it —
+    the construction-time gate shared by ``BPDecoder.__init__`` and the
+    factory classes' ``GetDecoderState`` fast path (one definition, so the
+    two can never disagree about what program a decoder runs)."""
+    if bp_method != "minimum_sum" or os.environ.get("QLDPC_PALLAS",
+                                                    "1") == "0":
+        return None
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    if not on_tpu:
+        return None
+    from ..ops.bp_pallas import build_pallas_head
+
+    pg = build_pallas_head(graph_host)
+    return pg if pg.fits_vmem() else None
+
+
 class FusedBPPair:
     """Two independent plain-BP decodes fused into one kernel call.
 
@@ -253,21 +273,8 @@ class BPDecoder:
         # VMEM-resident Pallas head (ops/bp_pallas): ~10x head throughput on
         # TPU; stragglers still go through the exact f32 XLA tail.  Gated on
         # backend, method, and the incidence stack fitting VMEM.
-        self._pallas_head = None
-        if (
-            self.bp_method == "minimum_sum"
-            and os.environ.get("QLDPC_PALLAS", "1") != "0"
-        ):
-            try:
-                on_tpu = jax.default_backend() == "tpu"
-            except Exception:
-                on_tpu = False
-            if on_tpu:
-                from ..ops.bp_pallas import build_pallas_head
-
-                pg = build_pallas_head(self._graph_host)
-                if pg.fits_vmem():
-                    self._pallas_head = pg
+        self._pallas_head = _maybe_pallas_head(self.bp_method,
+                                               self._graph_host)
 
     needs_host_postprocess = False
 
@@ -622,6 +629,18 @@ class DecoderClass(ABC):
     def GetDecoder(self, code_and_noise_channel_params):
         ...
 
+    def GetDecoderState(self, code_and_noise_channel_params):
+        """``(device_static, device_state)`` of the decoder ``GetDecoder``
+        would build for these params — the per-cell payload the FUSED sweep
+        planner (sweep/fused.py) stacks along the cell axis.
+
+        The default constructs the decoder and reads both off it (always
+        correct, pays the full per-cell build); library classes whose
+        statics don't depend on the noise values override this to return
+        the p-dependent state (LLR priors) without the rebuild."""
+        dec = self.GetDecoder(code_and_noise_channel_params)
+        return dec.device_static, dec.device_state
+
 
 def _channel_from_params(params) -> tuple[np.ndarray, int]:
     """Shared channel-probs logic of the factories (src/Decoders.py:113-120):
@@ -689,6 +708,31 @@ class BP_Decoder_Class(DecoderClass):
             bp_method=d["bp_method"],
             ms_scaling_factor=d["ms_scaling_factor"],
         )
+
+    def GetDecoderState(self, code_and_noise_channel_params):
+        """Fast path for the fused sweep planner: the (static, state) pair
+        ``GetDecoder(params).device_static/device_state`` would expose,
+        without building the decoder — the Tanner graph and Pallas head
+        come from the per-H memo (ops/bp), so a sweep's non-representative
+        cells cost one ``llr_from_probs``.  Pinned equal to the full build
+        by tests/test_fused_sweep.py."""
+        p = code_and_noise_channel_params
+        assert "h" in p and "p_data" in p
+        probs, num_qubits = _channel_from_params(p)
+        d = self.decoder_default_params
+        h01 = gf2.to_gf2(p["h"])
+        graph_host = bp.build_tanner_graph_host(h01)
+        graph = bp.build_tanner_graph(h01)
+        method = _norm_method(d["bp_method"])
+        pallas = _maybe_pallas_head(method, graph_host)
+        static = ("bp", max(1, int(num_qubits / d["max_iter_ratio"])),
+                  method, float(d["ms_scaling_factor"]), True,
+                  pallas is not None)
+        channel = np.broadcast_to(
+            np.asarray(probs, np.float64), (h01.shape[1],)).copy()
+        state = {"graph": graph, "llr0": bp.llr_from_probs(channel),
+                 "pallas": pallas}
+        return static, state
 
 
 class FirstMinBP_Decoder_Class(DecoderClass):

@@ -170,6 +170,11 @@ def packed_residual_stats(res_x, res_z, hz_par, hx_par, lz_t, lx_t,
     ``z_weight_excludes_stab`` reproduces the phenom engine's convention of
     excluding stabilizer-failed shots from the z min-weight track.  Returns
     int32 device scalars (failure count, min logical residual weight).
+
+    ``eval_type="ALL"`` returns the (3,) vector of all three counts
+    (X, Z, Total) from the same flag words instead of one selected scalar —
+    the cell-fused sweep path picks per cell with a traced index, so one
+    compiled program serves cells of any logical type.
     """
     x_stab = packed_any(packed_parity_apply(hz_par[0], hz_par[1], res_x))
     x_log = packed_any(packed_gf2_matmul(res_x, lz_t))
@@ -178,18 +183,22 @@ def packed_residual_stats(res_x, res_z, hz_par, hx_par, lz_t, lx_t,
     x_fail = x_stab | x_log
     z_fail = z_stab | z_log
     if eval_type == "X":
-        fail = x_fail
+        cnt = packed_count(x_fail, batch_size)
     elif eval_type == "Z":
-        fail = z_fail
+        cnt = packed_count(z_fail, batch_size)
+    elif eval_type == "ALL":
+        cnt = jnp.stack([packed_count(x_fail, batch_size),
+                         packed_count(z_fail, batch_size),
+                         packed_count(x_fail | z_fail, batch_size)])
     else:
-        fail = x_fail | z_fail
+        cnt = packed_count(x_fail | z_fail, batch_size)
     wz_flags = z_log & ~z_stab if z_weight_excludes_stab else z_log
     wx = jnp.where(unpack_shots(x_log, batch_size).astype(bool),
                    packed_per_shot_weight(res_x, batch_size), n)
     wz = jnp.where(unpack_shots(wz_flags, batch_size).astype(bool),
                    packed_per_shot_weight(res_z, batch_size), n)
     min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
-    return packed_count(fail, batch_size), min_w
+    return cnt, min_w
 
 
 def packed_per_shot_weight(packed_bits, batch_size: int) -> jnp.ndarray:

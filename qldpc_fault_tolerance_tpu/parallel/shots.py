@@ -37,7 +37,9 @@ __all__ = [
     "sharded_batch_stats",
     "split_keys_for_mesh",
     "MegabatchDriver",
+    "CellFusedDriver",
     "count_min_driver",
+    "cell_fused_driver",
     "drain_double_buffered",
 ]
 
@@ -114,6 +116,15 @@ def sharded_batch_stats(stats_fn, mesh: Mesh, has_tele: bool = False):
 # ---------------------------------------------------------------------------
 # Dispatch-amortized megabatch driver
 # ---------------------------------------------------------------------------
+def _carry_donation() -> bool:
+    """Donate the accumulator carry into dispatches except on backends that
+    don't implement donation (CPU), where it only produces warning noise."""
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
 class MegabatchDriver:
     """Run ``stats_fn(key, *extra)`` for many batches, ``k_inner`` per
     dispatch.
@@ -151,10 +162,7 @@ class MegabatchDriver:
             carry, _ = jax.lax.scan(body, carry, jnp.arange(self.k_inner))
             return carry
 
-        try:
-            self._donated = jax.default_backend() not in ("cpu",)
-        except Exception:
-            self._donated = False
+        self._donated = _carry_donation()
         self._mega = jax.jit(
             mega, donate_argnums=(0,) if self._donated else ())
 
@@ -260,6 +268,187 @@ def count_min_driver(tag: str, cfg, k_inner: int, stats_fn,
         return MegabatchDriver(stats_fn, combine, init, k_inner=k_inner)
 
     return _engine_driver_cache.get((tag, cfg, k_inner, tele_len), make)
+
+
+# ---------------------------------------------------------------------------
+# Cell-fused megabatch driver (p-axis batching of a sweep grid)
+# ---------------------------------------------------------------------------
+class CellFusedDriver(MegabatchDriver):
+    """Megabatch driver for a FUSED sweep bucket: one dispatch advances
+    ``n_cells`` lanes, each running ``k_inner`` batches of one (code, p,
+    logical_type) cell's pipeline, folding a cell-masked carry of per-CELL
+    counters instead of the base class's scalar fold.
+
+    stats_fn: ``(keys (L,), lane_cell (L,), active (L,), *extra) ->
+    (count (L,) i32, min_w (L,) i32[, tele (tele_len,) i32])`` — the
+    per-lane batch statistics.  The stats_fn owns the cell-state gather
+    (lane ``l`` runs cell ``lane_cell[l]``'s p-dependent state under vmap)
+    and masks its own telemetry by ``active``; the driver masks counts.
+
+    Carry: ``(failures (C,), shots (C,), min_w (C,)[, tele (T,)])`` int32.
+
+    The lane plan rides through every dispatch as TRACED vectors, so
+    reallocating lanes between megabatches (adaptive shot reallocation)
+    reuses one compiled program:
+
+      lane_base (L,)    absolute batch index of lane l's first batch
+      lane_stride (L,)  index step between lane l's successive batches
+                        (= lanes co-serving that cell, so they interleave
+                        disjoint indices)
+      lane_cell (L,)    cell index served by lane l
+      active (L,)       inactive lanes compute but accumulate nothing
+
+    Batch ``j`` of lane ``l`` draws from
+    ``fold_in(key, lane_base[l] + j*lane_stride[l])`` — the same positional
+    stream the serial megabatch driver uses — so every cell's draws are
+    bit-exact with its unfused run no matter which lane (or how many lanes)
+    execute them.
+
+    ``mesh``: shard the fused batch on the SHOT axis — every mesh device
+    runs all lanes at the lane batch size with its own fold of the key
+    (``fold_in(key_lane, axis_index)``, matching the serial mesh path's
+    per-device streams) and the per-lane counts psum-reduce over ICI.
+    Shots per lane-batch then scale by the device count.
+    """
+
+    def __init__(self, stats_fn, n_cells: int, batch_size: int,
+                 k_inner: int, min_init: int, tele_len: int = 0, mesh=None):
+        self.k_inner = max(1, int(k_inner))
+        self.n_cells = int(n_cells)
+        self.batch_size = int(batch_size)
+        self.tele_len = int(tele_len)
+        self._mesh = mesh
+        self.dispatches = 0
+        n_dev = 1 if mesh is None else mesh.devices.size
+        shots_inc = jnp.int32(self.batch_size * n_dev)
+        big = jnp.int32(np.iinfo(np.int32).max)
+
+        def init_fn():
+            carry = (jnp.zeros((self.n_cells,), jnp.int32),
+                     jnp.zeros((self.n_cells,), jnp.int32),
+                     jnp.full((self.n_cells,), min_init, jnp.int32))
+            if tele_len:
+                carry += (jnp.zeros((tele_len,), jnp.int32),)
+            return carry
+
+        def step(keys, lane_cell, active, *extra):
+            if mesh is None:
+                return stats_fn(keys, lane_cell, active, *extra)
+
+            def local(keys, lane_cell, active, *extra):
+                d = jax.lax.axis_index(SHOT_AXIS)
+                dev_keys = jax.vmap(
+                    lambda k0: jax.random.fold_in(k0, d))(keys)
+                out = stats_fn(dev_keys, lane_cell, active, *extra)
+                res = (jax.lax.psum(out[0], SHOT_AXIS),
+                       jax.lax.pmin(out[1], SHOT_AXIS))
+                if tele_len:
+                    res += (jax.lax.psum(out[2], SHOT_AXIS),)
+                return res
+
+            # all inputs replicated, outputs reduced -> replicated; the
+            # only cross-device traffic is the per-cell count vectors
+            return _shard_map(
+                local, mesh=mesh,
+                in_specs=(P(),) * (3 + len(extra)),
+                out_specs=(P(), P()) + ((P(),) if tele_len else ()),
+                check_vma=False,
+            )(keys, lane_cell, active, *extra)
+
+        def mega(carry, key, lane_base, lane_stride, lane_cell, active,
+                 *extra):
+            def body(c, j):
+                b_idx = lane_base + j * lane_stride
+                keys = jax.vmap(
+                    lambda b: jax.random.fold_in(key, b))(b_idx)
+                out = step(keys, lane_cell, active, *extra)
+                cnt, mw = out[0], out[1]
+                fail = c[0].at[lane_cell].add(
+                    jnp.where(active, cnt, 0), mode="drop")
+                shots = c[1].at[lane_cell].add(
+                    jnp.where(active, shots_inc, 0), mode="drop")
+                mws = c[2].at[lane_cell].min(
+                    jnp.where(active, mw, big), mode="drop")
+                new = (fail, shots, mws)
+                if tele_len:
+                    new += (c[3] + out[2],)
+                return new, None
+
+            carry, _ = jax.lax.scan(body, carry, jnp.arange(self.k_inner))
+            return carry
+
+        self._init_fn = init_fn
+        self._donated = _carry_donation()
+        self._mega = jax.jit(
+            mega, donate_argnums=(0,) if self._donated else ())
+        # lane plan of the fixed-budget stream, hoisted (device constants):
+        # lane l <-> cell l, every cell advancing in lockstep —
+        # bit-identical boundaries to the serial per-cell megabatch stream
+        self._uniform = (jnp.ones((self.n_cells,), jnp.int32),
+                         jnp.arange(self.n_cells, dtype=jnp.int32),
+                         jnp.ones((self.n_cells,), bool))
+
+    def dispatch_plan(self, carry, key, plan, *extra):
+        """One guarded dispatch under an explicit host lane plan
+        ``(lane_base, lane_stride, lane_cell, active)`` (adaptive mode)."""
+        base, stride, cell, active = plan
+        telemetry.count("driver.batches",
+                        self.k_inner * int(np.asarray(active).sum()))
+        return self._dispatch(
+            carry, key, np.asarray(base, np.int32),
+            np.asarray(stride, np.int32), np.asarray(cell, np.int32),
+            np.asarray(active, bool), *extra)
+
+    def run_plan(self, key, n_batches: int, *extra, start: int = 0,
+                 carry0=None):
+        """Fixed-budget fold: every cell runs batches ``[start, n_run)``
+        (rounded up to a k_inner multiple), one lane per cell, no host
+        sync — the caller's materialization is the only round-trip.
+        Delegates to the base ``run`` with the hoisted uniform lane plan
+        threaded through ``extra`` (the scalar dispatch start broadcasts
+        against the stride vector inside the mega program);
+        ``start``/``carry0`` resume the fold mid-stream as there.  The
+        extra batch accounting covers the lanes beyond the base class's
+        one-batch-per-step count."""
+        stride, lane_cell, active = self._uniform
+        carry, n_run = self.run(key, n_batches, stride, lane_cell, active,
+                                *extra, start=start, carry0=carry0)
+        telemetry.count("driver.batches",
+                        max(0, n_run - int(start)) * (self.n_cells - 1))
+        return carry, n_run
+
+    def run_plan_keys(self, key, n_batches: int, *extra, start: int = 0,
+                      carry0=None):
+        """Like ``run_plan`` but yields ``(host_carry, batches_done)`` per
+        dispatch — the base ``run_keys`` double-buffered watchdog-guarded
+        drain under the uniform lane plan; the streaming path for per-cell
+        progress persistence."""
+        stride, lane_cell, active = self._uniform
+        for host, done in self.run_keys(key, n_batches, stride, lane_cell,
+                                        active, *extra, start=start,
+                                        carry0=carry0):
+            telemetry.count("driver.batches",
+                            self.k_inner * (self.n_cells - 1))
+            yield host, done
+
+
+def cell_fused_driver(tag: str, cfg, n_cells: int, k_inner: int, stats_fn,
+                      *, min_init: int, batch_size: int, tele_len: int = 0,
+                      mesh=None, state_key=()) -> CellFusedDriver:
+    """Memoized CellFusedDriver, keyed on the fused program identity:
+    engine tag + hashable cfg + cell count + chunk + telemetry length +
+    mesh + ``state_key`` (the bucket's state-stacking layout — which leaves
+    are per-cell vs shared changes the traced program).  Same-shape buckets
+    (another code of equal shape, the next p-grid over the same code) reuse
+    one compiled scan."""
+
+    def make():
+        return CellFusedDriver(stats_fn, n_cells, batch_size, k_inner,
+                               min_init, tele_len=tele_len, mesh=mesh)
+
+    return _engine_driver_cache.get(
+        ("cells", tag, cfg, n_cells, k_inner, tele_len, mesh, state_key,
+         batch_size), make)
 
 
 def drain_double_buffered(launch, finish, items, depth: int = 2):

@@ -25,12 +25,24 @@ __all__ = [
     "windowed_count",
     "mesh_batch_stats",
     "run_signature",
+    "key_bytes",
     "resumable_stream",
     "resilient_engine_run",
     "engine_ladder_step",
     "on_tunneled_worker",
     "apply_worker_batch_fence",
     "fence_batch_value",
+    "stack_cell_states",
+    "stack_from_overrides",
+    "states_share_but_llr",
+    "gather_lane_states",
+    "FusedCellProgram",
+    "plan_lanes",
+    "fused_cell_launch",
+    "fused_cell_finish",
+    "fused_cell_stream",
+    "fused_cell_adaptive",
+    "LTYPE_CODES",
 ]
 
 
@@ -115,21 +127,24 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     return count
 
 
-def run_signature(engine: str, key, **fields) -> dict:
-    """Identity of a megabatch shot stream, stored with mid-cell progress
-    records (utils.checkpoint.CellProgress): the PRNG key bytes plus the
-    batch layout.  A resume is honored only when the fingerprint matches —
-    resuming a cursor under a different stream would silently change the
-    estimate."""
+def key_bytes(key) -> np.ndarray:
+    """Raw uint32 words of a PRNG key (typed keys and legacy arrays)."""
     import jax
 
     try:
         data = jax.random.key_data(key)
     except Exception:  # old-style uint32 key arrays
         data = key
-    return {"engine": engine,
-            "key": np.asarray(data).astype(np.uint32).ravel().tolist(),
-            **fields}
+    return np.asarray(data).astype(np.uint32).ravel()
+
+
+def run_signature(engine: str, key, **fields) -> dict:
+    """Identity of a megabatch shot stream, stored with mid-cell progress
+    records (utils.checkpoint.CellProgress): the PRNG key bytes plus the
+    batch layout.  A resume is honored only when the fingerprint matches —
+    resuming a cursor under a different stream would silently change the
+    estimate."""
+    return {"engine": engine, "key": key_bytes(key).tolist(), **fields}
 
 
 def resilient_engine_run(sim, fn, *, site, degrade=None):
@@ -230,6 +245,373 @@ def resumable_stream(driver, key, n_batches, extra, *, signature, progress,
             yield carry, done
 
     return (initial, start), stream()
+
+
+# ---------------------------------------------------------------------------
+# Cell-fused sweep execution (p-axis batching)
+# ---------------------------------------------------------------------------
+# Per-cell logical-type selector codes: the fused stats unit computes all
+# three failure counts from the same flag words and each cell picks with a
+# TRACED index, so one compiled program serves X-, Z- and Total-type cells.
+LTYPE_CODES = {"X": 0, "Z": 1, "Total": 2}
+
+
+def stack_cell_states(states):
+    """Stack per-cell device-state pytrees along a leading cell axis,
+    SHARING the leaves that are identical across cells (Tanner graphs,
+    parity adjacencies — everything that doesn't depend on p).
+
+    Returns ``(stacked, treedef, axes_flat)``: the stacked pytree, its
+    treedef, and a flat tuple of per-leaf vmap axes (0 for stacked leaves,
+    None for shared ones).  ``axes_flat`` doubles as the bucket's program
+    identity — which leaves are per-cell changes the traced program, so it
+    belongs in the fused driver's memo key."""
+    import jax
+    import jax.numpy as jnp
+
+    flats = [jax.tree_util.tree_flatten(s) for s in states]
+    treedef = flats[0][1]
+    for _, td in flats[1:]:
+        if td != treedef:
+            raise ValueError(
+                "cell device states differ in structure; cells of one "
+                "fused bucket must come from identically-configured "
+                "decoders/engines")
+    groups = list(zip(*(leaves for leaves, _ in flats)))
+    # identity short-circuits cover the common case for free (the light
+    # bucket builders reuse the representative's leaves, and the per-H
+    # memos hand every cell the same graph objects); the remaining
+    # candidates value-compare through ONE batched host fetch instead of a
+    # device sync per leaf pair
+    need_check = [i for i, g in enumerate(groups)
+                  if not all(x is g[0] for x in g[1:])]
+    host = dict(zip(need_check,
+                    jax.device_get([groups[i] for i in need_check])))
+    stacked, axes = [], []
+    for i, group in enumerate(groups):
+        if i in host:
+            vals = host[i]
+            shared = all(np.shape(x) == np.shape(vals[0])
+                         and np.array_equal(x, vals[0]) for x in vals[1:])
+        else:
+            shared = True
+        if shared:
+            stacked.append(group[0])
+            axes.append(None)
+        else:
+            stacked.append(jnp.stack([jnp.asarray(x) for x in group]))
+            axes.append(0)
+    return treedef.unflatten(stacked), treedef, tuple(axes)
+
+
+def states_share_but_llr(rep_dec_state, dec_state) -> bool:
+    """True when a decoder device-state dict differs from the
+    representative's ONLY in its ``llr0`` leaf — leaves compare by
+    IDENTITY, which the per-H memos (ops/bp graph cache) make hold for the
+    library decoder classes.  Gate for the ``stack_from_overrides`` fast
+    path; a False just routes the bucket through the generic value-compare
+    stacking."""
+    if not (isinstance(dec_state, dict)
+            and dec_state.keys() == rep_dec_state.keys()):
+        return False
+    return all(dec_state[k] is rep_dec_state[k]
+               for k in dec_state if k != "llr0")
+
+
+def stack_from_overrides(rep_state, overrides):
+    """Fast-path twin of ``stack_cell_states`` for bucket builders that
+    KNOW which leaves vary per cell: the stacked state is the
+    representative's pytree with pre-stacked override arrays dropped in at
+    the named paths — no per-cell dict assembly, no host value-compares.
+
+    ``overrides``: ``{("dx", "llr0"): (C, ...) array, ("probs",): ...}`` —
+    keys are dict-key paths into ``rep_state``.  Returns the same
+    ``(stacked, treedef, axes_flat)`` triple as ``stack_cell_states``."""
+    import jax
+
+    paths, treedef = jax.tree_util.tree_flatten_with_path(rep_state)
+    stacked, axes = [], []
+    used = set()
+    for path, leaf in paths:
+        key = tuple(getattr(p, "key", getattr(p, "name", p)) for p in path)
+        if key in overrides:
+            stacked.append(overrides[key])
+            axes.append(0)
+            used.add(key)
+        else:
+            stacked.append(leaf)
+            axes.append(None)
+    missing = set(overrides) - used
+    if missing:
+        raise KeyError(f"override paths not found in state: {missing}")
+    return treedef.unflatten(stacked), treedef, tuple(axes)
+
+
+def gather_lane_states(stacked, treedef, axes_flat, lane_cell):
+    """Per-LANE view of a stacked bucket state: leaves with a cell axis are
+    gathered at ``lane_cell`` (so lane l sees cell lane_cell[l]'s values),
+    shared leaves pass through.  Returns ``(lane_states, in_axes)`` ready
+    for ``jax.vmap`` over the lane axis."""
+    import jax
+
+    flat = treedef.flatten_up_to(stacked)
+    gathered = [x[lane_cell] if a == 0 else x
+                for x, a in zip(flat, axes_flat)]
+    in_axes = treedef.unflatten(
+        [0 if a == 0 else None for a in axes_flat])
+    return treedef.unflatten(gathered), in_axes
+
+
+@dataclasses.dataclass
+class FusedCellProgram:
+    """One shape bucket's fused cell-axis run, ready to drive.
+
+    Built by the engines (sim/data_error.fused_cells_program,
+    sim/phenom.fused_cells_program) from a list of same-shape simulator
+    instances; consumed by sweep/fused.py.  ``key`` is the base PRNG key
+    every cell shares — the exact key the serial engine would split for the
+    same seed, so per-cell draws are bit-exact with the unfused path.
+    """
+
+    driver: object          # parallel.shots.CellFusedDriver
+    key: object             # shared base PRNG key
+    extras: tuple           # traced extras for the driver's stats_fn
+    n_batches: int          # per-cell batch budget (chunk-rounded)
+    chunk: int
+    batch_size: int
+    n_cells: int
+    engine: str             # "data" | "phenl"
+    wer_fn: object          # (failures, shots) -> (wer, eb) for one cell
+    # run fingerprint for per-cell progress cursors, built lazily (it syncs
+    # the key bytes to host — only resume paths pay that)
+    signature_fn: object = None
+    _signature: dict = dataclasses.field(default=None, repr=False)
+
+    @property
+    def signature(self) -> dict:
+        if self._signature is None:
+            self._signature = self.signature_fn()
+        return self._signature
+
+
+def plan_lanes(cursors, undecided, n_lanes: int, k_inner: int,
+               max_batches: int):
+    """Assign ``n_lanes`` lanes across the undecided cells of a fused
+    bucket for one megabatch (adaptive shot reallocation).
+
+    Each undecided cell gets a fair share of lanes, capped by its remaining
+    batch budget; leftover lanes spill to cells that can still absorb them.
+    Co-assigned lanes interleave disjoint batch indices (stride = share),
+    so a cell's stream stays the serial positional stream regardless of how
+    many lanes serve it.
+
+    Returns ``(lane_base, lane_stride, lane_cell, active, advance,
+    realloc_batches)``: the lane plan vectors, the per-cell batch advance
+    this megabatch, and how many lane-batches went to lanes BEYOND a cell's
+    first (the reallocated work the fused batch would otherwise idle)."""
+    cursors = np.asarray(cursors, np.int64)
+    undecided = list(undecided)
+    m = len(undecided)
+    base = np.zeros(n_lanes, np.int64)
+    stride = np.ones(n_lanes, np.int64)
+    cell = np.zeros(n_lanes, np.int64)
+    active = np.zeros(n_lanes, bool)
+    advance = np.zeros(len(cursors), np.int64)
+    if m == 0:
+        return base, stride, cell, active, advance, 0
+    cap = np.array(
+        [-(-(max_batches - cursors[c]) // k_inner) for c in undecided],
+        np.int64)
+    share = np.array([n_lanes // m + (i < n_lanes % m) for i in range(m)],
+                     np.int64)
+    share = np.minimum(share, cap)
+    # spill leftover lanes round-robin into cells with remaining budget
+    leftover = n_lanes - int(share.sum())
+    while leftover > 0:
+        room = np.nonzero(share < cap)[0]
+        if room.size == 0:
+            break
+        for i in room[:leftover]:
+            share[i] += 1
+        leftover = n_lanes - int(share.sum())
+    lane = 0
+    realloc = 0
+    for i, c in enumerate(undecided):
+        s = int(share[i])
+        for r in range(s):
+            cell[lane] = c
+            base[lane] = cursors[c] + r
+            stride[lane] = s
+            active[lane] = True
+            lane += 1
+        advance[c] = s * k_inner
+        realloc += max(0, s - 1) * k_inner
+    return base, stride, cell, active, advance, realloc
+
+
+def _fused_carry0(state, tele_on: bool):
+    """Rebuild a fused device carry from a persisted per-cell progress
+    record (utils.checkpoint.CellProgress.save_cells)."""
+    import jax.numpy as jnp
+
+    from ..utils import telemetry
+
+    carry = [jnp.asarray(state["failures"], jnp.int32),
+             jnp.asarray(state["shots"], jnp.int32),
+             jnp.asarray(state["min_w"], jnp.int32)]
+    if tele_on:
+        carry.append(jnp.asarray(
+            state.get("tele") or [0] * telemetry.TELE_LEN, jnp.int32))
+    return tuple(carry)
+
+
+def _fused_host(carry):
+    """(failures, shots, min_w[, tele]) host arrays from a fetched carry."""
+    host = [np.asarray(x) for x in carry]
+    return host[0], host[1], host[2], (host[3] if len(host) > 3 else None)
+
+
+def fused_cell_launch(prog: FusedCellProgram, *, start: int = 0,
+                      carry0=None):
+    """Enqueue a whole fixed-budget fused bucket asynchronously (no host
+    sync) — the launch half of the shape-bucket pipeline: while this
+    bucket's dispatches run on device, the caller builds/compiles the next
+    bucket and drains completed ones."""
+    from ..utils import faultinject, telemetry
+
+    faultinject.site("fused_cells_launch")
+    with telemetry.span("fused_cells_launch"):
+        carry, n_run = prog.driver.run_plan(
+            prog.key, prog.n_batches, *prog.extras, start=start,
+            carry0=carry0)
+    return carry, n_run
+
+
+def fused_cell_finish(carry):
+    """Drain half of the bucket pipeline: one watchdog-guarded fetch of the
+    whole bucket's per-cell counters, telemetry published at that single
+    sync."""
+    from ..utils import faultinject, resilience, telemetry
+
+    def fetch():
+        faultinject.site("megabatch_drain")
+        import jax
+
+        return jax.device_get(carry)
+
+    with telemetry.span("megabatch_drain"):
+        host = resilience.guarded_fetch(fetch, label="megabatch_drain")
+    failures, shots, min_w, tele = _fused_host(host)
+    if tele is not None:
+        telemetry.publish_device_tele(tele)
+    return failures, shots, min_w
+
+
+def fused_cell_stream(prog: FusedCellProgram, *, progress, tele_on: bool):
+    """Fixed-budget fused run with per-cell progress persistence: the
+    megabatch stream is drained double-buffered and every drained carry
+    saves the bucket's per-cell cursors, so a killed sweep resumes INSIDE
+    the bucket seed-for-seed (the uniform cursor plus the positional key
+    stream replay exactly the remaining draws)."""
+    from ..utils import telemetry
+
+    start, carry0 = 0, None
+    state = progress.load(prog.signature) if progress is not None else None
+    if state:
+        start = int(state["batches_done"])
+        carry0 = _fused_carry0(state, tele_on)
+    k = prog.chunk
+    n_run = -(-int(prog.n_batches) // k) * k
+    if start >= n_run and state:
+        # resumed past the end: the persisted counters ARE the result
+        return (np.asarray(state["failures"]), np.asarray(state["shots"]),
+                np.asarray(state["min_w"]))
+    last = None
+    for host, done in prog.driver.run_plan_keys(
+            prog.key, prog.n_batches, *prog.extras, start=start,
+            carry0=carry0):
+        failures, shots, min_w, tele = _fused_host(host)
+        if progress is not None:
+            progress.save_cells(prog.signature, batches_done=done,
+                                failures=failures, shots=shots,
+                                min_w=min_w, tele=tele)
+        last = (failures, shots, min_w, tele)
+    failures, shots, min_w, tele = last
+    if tele is not None:
+        telemetry.publish_device_tele(tele)
+    return failures, shots, min_w
+
+
+def fused_cell_adaptive(prog: FusedCellProgram, *, target_failures: int,
+                        progress=None, tele_on: bool = False):
+    """Adaptive shot reallocation over a fused bucket: run megabatches with
+    ONE host sync each for the entire grid, mask out cells that reached
+    ``target_failures`` (or their shot budget) and reassign their lanes to
+    the undecided cells, so the fused batch stays full until the whole
+    bucket converges.
+
+    Every batch a cell executes draws from its serial positional stream
+    (bit-exact counts); once lanes reallocate, a cell's convergence is
+    checked at coarser boundaries than the serial early-stop, so it may run
+    MORE shots than the serial run would have (never fewer draws per shot —
+    the estimate only tightens).  Cells keep at most their serial batch
+    budget.  Returns host ``(failures, shots, min_w)`` per cell."""
+    import jax
+
+    from ..utils import resilience, telemetry
+
+    driver, k = prog.driver, prog.chunk
+    C = prog.n_cells
+    n_run = -(-int(prog.n_batches) // k) * k
+    cursors = np.zeros(C, np.int64)
+    carry = driver._init_fn()
+    # the adaptive stream advances cells at per-cell cursors, so its
+    # progress records are NOT resumable by the uniform fixed-budget
+    # stream (and vice versa): the mode and target join the fingerprint,
+    # and a cross-mode rerun restarts the bucket instead of double-counting
+    signature = (dict(prog.signature, adaptive=int(target_failures))
+                 if progress is not None else None)
+    state = progress.load(signature) if progress is not None else None
+    if state:
+        cursors = np.asarray(
+            state.get("cursors") or [state["batches_done"]] * C, np.int64)
+        carry = _fused_carry0(state, tele_on)
+    total_lane_batches = 0
+    idle_lane_batches = 0
+    stopped_early = 0
+    while True:
+        host = resilience.guarded_fetch(
+            lambda: jax.device_get(carry), label="fused_adaptive_drain")
+        failures, shots, min_w, tele = _fused_host(host)
+        if progress is not None:
+            progress.save_cells(signature, batches_done=0,
+                                failures=failures, shots=shots,
+                                min_w=min_w, cursors=cursors, tele=tele)
+        undecided = [c for c in range(C)
+                     if failures[c] < target_failures
+                     and cursors[c] < n_run]
+        if not undecided:
+            break
+        base, stride, cell, active, advance, realloc = plan_lanes(
+            cursors, undecided, C, k, n_run)
+        if realloc:
+            telemetry.count("sweep.reallocated_shots",
+                            realloc * prog.batch_size)
+        total_lane_batches += C * k
+        idle_lane_batches += (C - int(active.sum())) * k
+        carry = driver.dispatch_plan(carry, prog.key,
+                                     (base, stride, cell, active),
+                                     *prog.extras)
+        cursors += advance
+    stopped_early = sum(1 for c in range(C) if cursors[c] < n_run)
+    if stopped_early:
+        telemetry.count("driver.early_stops", stopped_early)
+    if total_lane_batches:
+        telemetry.set_gauge("sweep.lane_idle_fraction",
+                            idle_lane_batches / total_lane_batches)
+    if tele is not None:
+        telemetry.publish_device_tele(tele)
+    return failures, shots, min_w
 
 
 def record_wer_run(engine: str, failures, shots, wer, dispatches=None):

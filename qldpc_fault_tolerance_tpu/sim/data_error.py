@@ -76,27 +76,36 @@ def _sample_and_bp(cfg, state, key):
     return error_x, error_z, synd_x, synd_z, cor_x, cor_z, aux_x, aux_z
 
 
-def _check(cfg, state, error_x, error_z, cor_x, cor_z):
-    """Residual stabilizer/logical checks (src/Simulators.py:135-168)."""
-    n, eval_type = cfg[1], cfg[2]
+def _check_flags(cfg, state, error_x, error_z, cor_x, cor_z):
+    """Residual stabilizer/logical checks -> per-shot (x_failure, z_failure)
+    flags + min logical weight (src/Simulators.py:135-168).  Shared by the
+    static-eval-type ``_check`` and the cell-fused all-types variant."""
+    n = cfg[1]
     residual_x = error_x ^ cor_x
     residual_z = error_z ^ cor_z
     x_stab = _parity(state["hz_par"], residual_x).any(axis=-1)
     x_log = gf2_matmul(residual_x, state["lz_t"]).any(axis=-1)
     z_stab = _parity(state["hx_par"], residual_z).any(axis=-1)
     z_log = gf2_matmul(residual_z, state["lx_t"]).any(axis=-1)
-    x_failure = x_stab | x_log
-    z_failure = z_stab | z_log
+    # min residual weight among logical failures (min_logical_weight track)
+    wx = jnp.where(x_log, residual_x.sum(axis=-1, dtype=jnp.int32), n)
+    wz = jnp.where(z_log, residual_z.sum(axis=-1, dtype=jnp.int32), n)
+    return (x_stab | x_log, z_stab | z_log,
+            jnp.minimum(wx.min(), wz.min()))
+
+
+def _check(cfg, state, error_x, error_z, cor_x, cor_z):
+    """Residual stabilizer/logical checks (src/Simulators.py:135-168)."""
+    eval_type = cfg[2]
+    x_failure, z_failure, min_w = _check_flags(cfg, state, error_x, error_z,
+                                               cor_x, cor_z)
     if eval_type == "X":
         fail = x_failure
     elif eval_type == "Z":
         fail = z_failure
     else:
         fail = x_failure | z_failure
-    # min residual weight among logical failures (min_logical_weight track)
-    wx = jnp.where(x_log, residual_x.sum(axis=-1, dtype=jnp.int32), n)
-    wz = jnp.where(z_log, residual_z.sum(axis=-1, dtype=jnp.int32), n)
-    return fail, jnp.minimum(wx.min(), wz.min())
+    return fail, min_w
 
 
 # ---------------------------------------------------------------------------
@@ -211,6 +220,263 @@ def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
         tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
 
 
+# ---------------------------------------------------------------------------
+# Cell-fused sweep execution: every p-point (and logical type) of a code in
+# ONE device program (sweep/fused.py drives these through the
+# parallel.shots.CellFusedDriver)
+# ---------------------------------------------------------------------------
+def _stats_all_one_batch(cfg, state, key):
+    """Per-cell unit of the fused sweep: one batch -> ((x, z, total) failure
+    counts, min weight).  Same draws, same GF(2) algebra, same decode as
+    ``_stats_one_batch`` — only the count SELECTION moves out (each cell
+    picks by a traced logical-type index), so per-cell results stay
+    bit-exact with the unfused run.  cfg slot 2 carries the "CELLS" marker
+    instead of a static eval type."""
+    if cfg[5]:
+        ex_p, ez_p, cx, cz, cx_aux, cz_aux = _sample_and_bp_packed(
+            cfg, state, key)
+        res_x = ex_p ^ pack_shots(cx)
+        res_z = ez_p ^ pack_shots(cz)
+        cnt3, mw = packed_residual_stats(
+            res_x, res_z, state["hz_par"], state["hx_par"],
+            state["lz_t"], state["lx_t"], "ALL", cfg[0], cfg[1])
+    else:
+        ex, ez, _, _, cx, cz, cx_aux, cz_aux = _sample_and_bp(cfg, state, key)
+        x_fail, z_fail, mw = _check_flags(cfg, state, ex, ez, cx, cz)
+        cnt3 = jnp.stack([x_fail.sum(dtype=jnp.int32),
+                          z_fail.sum(dtype=jnp.int32),
+                          (x_fail | z_fail).sum(dtype=jnp.int32)])
+    if _tele_on(cfg):
+        tele = telemetry.device_tele_vec(
+            [(cfg[3], cx_aux), (cfg[4], cz_aux)])
+        return cnt3, mw, tele
+    return cnt3, mw
+
+
+def _foldable_decoder(static, dec_axes) -> bool:
+    """True when a decoder's fused decode should run on the FOLDED
+    (lane*shot) batch: a TWO-PHASE BP whose only per-cell state leaf is the
+    LLR prior.  BP freezes every shot at its own convergence (ops/bp.py),
+    so a shot's result is independent of the batch it rides in — folding is
+    bit-exact — and it keeps the two-phase compaction's ``lax.cond`` tiers
+    SCALAR (under vmap both branches of a cond execute, measured ~2.6x
+    slower).  Plain streaming BP has no cond tiers and vmaps FASTER than it
+    folds (the lane axis vectorizes its message planes), so it stays on the
+    vmapped unit."""
+    from ..ops import bp as bp_mod
+
+    if static[0] != "bp":
+        return False
+    _, max_iter, _method, _msf, two_phase, _pallas = static
+    if not two_phase or max_iter < bp_mod.TWO_PHASE_MIN_ITER:
+        return False
+    shared = {k: v for k, v in dec_axes.items() if k != "llr0"}
+    return all(a is None for a in jax.tree_util.tree_flatten(
+        shared, is_leaf=lambda x: x is None)[0])
+
+
+def _folded_decode(static, lane_dec_state, synd_lanes):
+    """Decode (L, B, m) per-lane syndromes as ONE (L*B, m) batch, tiling
+    each lane's LLR prior over its shots (``bp_decode`` broadcasts llr0 to
+    (batch, n) internally, so a per-shot prior plane is native).  Returns
+    (L, B, n) corrections + per-lane-reshaped aux."""
+    L, B, m = synd_lanes.shape
+    llr0 = lane_dec_state["llr0"]
+    if llr0.ndim == 2:
+        n = llr0.shape[-1]
+        llr0 = jnp.broadcast_to(llr0[:, None, :], (L, B, n)).reshape(L * B, n)
+    state = dict(lane_dec_state, llr0=llr0)
+    cor, aux = decode_device(static, state, synd_lanes.reshape(L * B, m))
+    cor = cor.reshape(L, B, -1)
+    aux = jax.tree_util.tree_map(
+        lambda x: x.reshape((L, B) + x.shape[1:]), aux)
+    return cor, aux
+
+
+def _stats_all_folded(cfg, lane_states, in_axes, keys):
+    """Folded-decode twin of vmapped ``_stats_all_one_batch``: per-lane
+    sampler + syndrome SpMV (elementwise — vmap is free), ONE folded decode
+    per sector across all lanes, per-lane residual checks.  Bit-exact with
+    the vmapped unit (and hence with the serial per-cell run)."""
+    batch_size, n = cfg[0], cfg[1]
+
+    def front(st, key):
+        if cfg[5]:
+            ex_p, ez_p = depolarizing_xz_packed(
+                key, (batch_size, n), st["probs"])
+            synd_z = unpack_shots(packed_parity_apply(
+                st["hx_par"][0], st["hx_par"][1], ez_p), batch_size)
+            synd_x = unpack_shots(packed_parity_apply(
+                st["hz_par"][0], st["hz_par"][1], ex_p), batch_size)
+            return (ex_p, ez_p), synd_x, synd_z
+        ex, ez = depolarizing_xz(key, (batch_size, n), st["probs"])
+        return (ex, ez), _parity(st["hz_par"], ex), _parity(st["hx_par"], ez)
+
+    errs, synd_x, synd_z = jax.vmap(front, in_axes=(in_axes, 0))(
+        lane_states, keys)
+    cor_z, aux_z = _folded_decode(cfg[4], lane_states["dz"], synd_z)
+    cor_x, aux_x = _folded_decode(cfg[3], lane_states["dx"], synd_x)
+
+    def back(st, err, cx, cz):
+        if cfg[5]:
+            ex_p, ez_p = err
+            return packed_residual_stats(
+                ex_p ^ pack_shots(cx), ez_p ^ pack_shots(cz),
+                st["hz_par"], st["hx_par"], st["lz_t"], st["lx_t"],
+                "ALL", batch_size, n)
+        ex, ez = err
+        x_fail, z_fail, mw = _check_flags(cfg, st, ex, ez, cx, cz)
+        return jnp.stack([x_fail.sum(dtype=jnp.int32),
+                          z_fail.sum(dtype=jnp.int32),
+                          (x_fail | z_fail).sum(dtype=jnp.int32)]), mw
+
+    cnt3, mw = jax.vmap(back, in_axes=(in_axes, 0, 0, 0))(
+        lane_states, errs, cor_x, cor_z)
+    if _tele_on(cfg):
+        tele = jax.vmap(lambda ax, az: telemetry.device_tele_vec(
+            [(cfg[3], ax), (cfg[4], az)]))(aux_x, aux_z)
+        return cnt3, mw, tele
+    return cnt3, mw
+
+
+def _cells_stats_fn(cfg, treedef, axes_flat):
+    """Per-lane stats closure for the CellFusedDriver: gather each lane's
+    cell state, run the per-cell unit over the lane axis — folded-decode
+    when the decoders allow it, whole-pipeline vmap otherwise — and select
+    each lane's count by its cell's traced logical-type code."""
+    from .common import gather_lane_states
+
+    tele_on = _tele_on(cfg)
+
+    def stats(keys, lane_cell, active, stacked, ltypes):
+        lane_states, in_axes = gather_lane_states(
+            stacked, treedef, axes_flat, lane_cell)
+        if (_foldable_decoder(cfg[3], in_axes["dx"])
+                and _foldable_decoder(cfg[4], in_axes["dz"])):
+            out = _stats_all_folded(cfg, lane_states, in_axes, keys)
+        else:
+            out = jax.vmap(
+                lambda st, k: _stats_all_one_batch(cfg, st, k),
+                in_axes=(in_axes, 0))(lane_states, keys)
+        cnt3, mw = out[0], out[1]
+        lt = ltypes[lane_cell]
+        cnt = jnp.take_along_axis(cnt3, lt[:, None], axis=1)[:, 0]
+        res = (cnt, mw)
+        if tele_on:
+            res += (jnp.where(active[:, None], out[2], 0)
+                    .sum(axis=0, dtype=jnp.int32),)
+        return res
+
+    return stats
+
+
+def _check_rep_fusable(rep) -> None:
+    if rep._needs_host:
+        raise ValueError(
+            "cell fusion needs pure-device decoders (host-postprocess OSD "
+            "paths have no fused megabatch unit)")
+    if rep._fused_sampler:
+        raise ValueError(
+            "the opt-in fused sampler has its own PRNG stream; cell fusion "
+            "only covers the seed-comparable packed/dense paths")
+
+
+def fused_cells_program_states(rep, cell_states, ltype_codes, cell_tags,
+                               num_samples: int, mesh=None,
+                               prestacked=None):
+    """Core fused-program builder for one data-error bucket.
+
+    ``rep`` is the bucket's representative simulator (cell 0, fully
+    constructed); ``cell_states`` are per-cell ``_dev_state``-shaped dicts
+    — the light path derives non-representative cells' state straight from
+    the decoder factories (``DecoderClass.GetDecoderState``) instead of
+    rebuilding decoders + simulator per cell, which is most of a serial
+    sweep's per-cell host cost.  ``cell_tags`` (hashable per-cell
+    descriptors, e.g. the channel probs) identify the cells in the resume
+    fingerprint.  ``prestacked``: an already-stacked ``(stacked,
+    treedef, axes_flat)`` triple (sim/common.stack_from_overrides)
+    standing in for ``cell_states`` when the builder knows exactly
+    which leaves vary.  The key, batch layout and chunk rounding reproduce
+    exactly what each cell's own WordErrorRate would use, so per-cell
+    results are bit-exact seed-for-seed with the serial per-cell sweep."""
+    from ..parallel.shots import cell_fused_driver
+    from .common import FusedCellProgram, stack_cell_states
+
+    _check_rep_fusable(rep)
+    tele_on = telemetry.enabled()
+    cfg = (rep.batch_size, rep.N, "CELLS",
+           rep.decoder_x.device_static, rep.decoder_z.device_static,
+           rep._packed, False, tele_on)
+    stacked, treedef, axes_flat = (
+        prestacked if prestacked is not None
+        else stack_cell_states(cell_states))
+    ltypes = jnp.asarray(list(ltype_codes), jnp.int32)
+    # identical to each serial cell: split the (shared) base key once, run
+    # ShotBatcher-rounded megabatches of the instance scan chunk
+    _, key = jax.random.split(rep._base_key)
+    # every fused lane-batch runs on ALL mesh devices (the driver shards
+    # the shot axis), so the per-cell batch budget divides by the mesh size
+    # exactly as the serial mesh path's ShotBatcher does
+    n_dev = 1 if mesh is None else mesh.devices.size
+    batcher = ShotBatcher(num_samples, rep.batch_size * n_dev)
+    chunk = min(batcher.num_batches, rep._scan_chunk)
+    n_batches = -(-batcher.num_batches // chunk) * chunk
+    driver = cell_fused_driver(
+        "data", cfg, len(ltypes), chunk,
+        _cells_stats_fn(cfg, treedef, axes_flat),
+        min_init=rep.N, batch_size=rep.batch_size,
+        tele_len=telemetry.TELE_LEN if tele_on else 0,
+        mesh=mesh, state_key=axes_flat)
+    signature_fn = lambda: run_signature(  # noqa: E731
+        "data-cells", key, batch_size=rep.batch_size, chunk=chunk,
+        n_batches=n_batches, cells=list(cell_tags),
+        ltypes=[int(x) for x in np.asarray(ltypes)])
+    K = rep.K
+
+    return FusedCellProgram(
+        driver=driver, key=key, extras=(stacked, ltypes),
+        n_batches=n_batches, chunk=chunk, batch_size=rep.batch_size,
+        n_cells=len(ltypes), engine="data",
+        wer_fn=lambda failures, shots: wer_single_shot(
+            int(failures), int(shots), K),
+        signature_fn=signature_fn)
+
+
+def fused_cells_program(sims, num_samples: int, mesh=None):
+    """Build a sim/common.FusedCellProgram fusing same-shape data-error
+    simulators (one per (p, logical_type) cell of a sweep bucket) into one
+    cell-axis device program.
+
+    Every p-dependent array (channel probs, decoder LLR priors) stacks
+    along a leading cell axis; shape state (Tanner graphs, parity
+    adjacencies, logicals) is shared.  Raises ValueError when the bucket
+    cannot fuse (host-postprocess decoders, fused-sampler streams, mixed
+    configs)."""
+    from .common import LTYPE_CODES, key_bytes as _key_bytes
+
+    rep = sims[0]
+    cfg = (rep.batch_size, rep.N, "CELLS",
+           rep.decoder_x.device_static, rep.decoder_z.device_static,
+           rep._packed, False)
+    for s in sims[1:]:
+        other = (s.batch_size, s.N, "CELLS",
+                 s.decoder_x.device_static, s.decoder_z.device_static,
+                 s._packed, False)
+        if other != cfg or s._needs_host or s._fused_sampler:
+            raise ValueError(
+                "cells differ in program structure (batch size, code shape "
+                "or decoder statics); split them into separate buckets")
+        if s.K != rep.K or not np.array_equal(_key_bytes(s._base_key),
+                                              _key_bytes(rep._base_key)):
+            raise ValueError(
+                "cells of one fused bucket must share a seed and K")
+    return fused_cells_program_states(
+        rep, [s._dev_state for s in sims],
+        [LTYPE_CODES[s.eval_logical_type] for s in sims],
+        [[float(np.asarray(p)) for p in s.channel_probs] for s in sims],
+        num_samples, mesh=mesh)
+
+
 class CodeSimulator_DataError:
     """Same constructor/WordErrorRate surface as the reference class, batched.
 
@@ -218,6 +484,11 @@ class CodeSimulator_DataError:
     dispatch), ``scan_chunk`` (batches per megabatch dispatch) and ``packed``
     (bit-packed GF(2) planes, default on — bit-exact vs the dense path).
     """
+
+    # cell-fused sweep entries: stack same-shape instances (one per sweep
+    # cell) into one cell-axis device program (module fns above)
+    fused_cells_program = staticmethod(fused_cells_program)
+    fused_cells_program_states = staticmethod(fused_cells_program_states)
 
     def __init__(self, code=None, decoder_x=None, decoder_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), eval_logical_type="Total",

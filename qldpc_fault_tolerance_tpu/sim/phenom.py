@@ -157,23 +157,31 @@ def _final_round(cfg, state, key, data_x, data_z):
     return cur_x, cur_z, synd_x, synd_z, dx, dz, ax, az
 
 
-@functools.partial(jax.jit, static_argnames=("cfg",))
-def _check(cfg, state, cur_x, cur_z, dec_x, dec_z):
-    """Residual checks (src/Simulators.py:299-332).  X weight is tracked
-    whenever the logical check fires, Z only when the stabilizer check
-    passed — the reference's if/if vs if/elif asymmetry."""
-    n, eval_type = cfg[1], cfg[2]
+def _check_flags(cfg, state, cur_x, cur_z, dec_x, dec_z):
+    """Residual checks -> per-shot (x_fail, z_fail) flags + min weight
+    (src/Simulators.py:299-332).  X weight is tracked whenever the logical
+    check fires, Z only when the stabilizer check passed — the reference's
+    if/if vs if/elif asymmetry.  Shared by the static-eval-type ``_check``
+    and the cell-fused all-types variant."""
+    n = cfg[1]
     residual_x = cur_x ^ dec_x
     residual_z = cur_z ^ dec_z
     x_stab = gf2_matmul(residual_x, state["hz_t"]).any(axis=-1)
     x_log = gf2_matmul(residual_x, state["lz_t"]).any(axis=-1)
     z_stab = gf2_matmul(residual_z, state["hx_t"]).any(axis=-1)
     z_log = gf2_matmul(residual_z, state["lx_t"]).any(axis=-1)
-    x_fail = x_stab | x_log
-    z_fail = z_stab | z_log
     wx = jnp.where(x_log, residual_x.sum(axis=-1, dtype=jnp.int32), n)
     wz = jnp.where(z_log & ~z_stab, residual_z.sum(axis=-1, dtype=jnp.int32), n)
     min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
+    return x_stab | x_log, z_stab | z_log, min_w
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _check(cfg, state, cur_x, cur_z, dec_x, dec_z):
+    """Static-eval-type residual checks (src/Simulators.py:299-332)."""
+    eval_type = cfg[2]
+    x_fail, z_fail, min_w = _check_flags(cfg, state, cur_x, cur_z,
+                                         dec_x, dec_z)
     if eval_type == "X":
         return x_fail, min_w
     if eval_type == "Z":
@@ -239,8 +247,239 @@ def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
         tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
 
 
+# ---------------------------------------------------------------------------
+# Cell-fused sweep execution (see sim/data_error.py; the phenom cell state
+# additionally stacks the per-cell syndrome-flip probability q and the
+# decoder-1 extended-matrix priors)
+# ---------------------------------------------------------------------------
+def _stats_all_one_batch(cfg, state, key, num_rounds):
+    """Per-cell unit of the fused sweep: one batch -> ((x, z, total) counts,
+    min weight).  Same draws/rounds/decodes as ``_stats_one_batch`` with
+    only the count selection moved out (traced per-cell logical type)."""
+    k_rounds, k_final = jax.random.split(key)
+    data_x, data_z = _noisy_rounds(cfg, state, k_rounds, num_rounds)
+    cur_x, cur_z, _, _, dx, dz, ax, az = _final_round(
+        cfg, state, k_final, data_x, data_z)
+    if cfg[7]:
+        b, n = cur_x.shape[0], cfg[1]
+        res_x = pack_shots(cur_x ^ dx)
+        res_z = pack_shots(cur_z ^ dz)
+        cnt3, mw = packed_residual_stats(
+            res_x, res_z, state["hz_par"], state["hx_par"],
+            state["lz_t"], state["lx_t"], "ALL", b, n,
+            z_weight_excludes_stab=True)
+    else:
+        x_fail, z_fail, mw = _check_flags(cfg, state, cur_x, cur_z, dx, dz)
+        cnt3 = jnp.stack([x_fail.sum(dtype=jnp.int32),
+                          z_fail.sum(dtype=jnp.int32),
+                          (x_fail | z_fail).sum(dtype=jnp.int32)])
+    if _tele_on(cfg):
+        tele = telemetry.device_tele_vec([(cfg[5], ax), (cfg[6], az)])
+        return cnt3, mw, tele
+    return cnt3, mw
+
+
+def _stats_all_folded(cfg, lane_states, in_axes, keys, num_rounds):
+    """Folded-decode twin of the vmapped phenom cell unit: per-lane
+    sampling/syndromes vmapped (elementwise), every decode — the per-round
+    decoder-1 pair and the final decoder-2 pair — runs ONCE on the folded
+    (lane*shot) batch (sim/data_error._folded_decode: bit-exact, and the
+    two-phase compaction's cond tiers stay scalar instead of running both
+    branches under vmap)."""
+    from .data_error import _folded_decode
+
+    batch_size, n = cfg[0], cfg[1]
+    L = keys.shape[0]
+    ks = jax.vmap(jax.random.split)(keys)
+    k_rounds, k_final = ks[:, 0], ks[:, 1]
+    init = (jnp.zeros((L, batch_size, n), jnp.uint8),
+            jnp.zeros((L, batch_size, n), jnp.uint8))
+
+    def front_round(st, kr, i, dx_c, dz_c):
+        ex_ext, ez_ext = _sample_ext(cfg, st, jax.random.fold_in(kr, i),
+                                     batch_size)
+        cur_x = ex_ext.at[:, :n].set(ex_ext[:, :n] ^ dx_c)
+        cur_z = ez_ext.at[:, :n].set(ez_ext[:, :n] ^ dz_c)
+        synd_x, synd_z = _ext_syndromes(cfg, st, cur_x, cur_z)
+        return cur_x, cur_z, synd_x, synd_z
+
+    def body(i, carry):
+        data_x, data_z = carry
+        cur_x, cur_z, synd_x, synd_z = jax.vmap(
+            front_round, in_axes=(in_axes, 0, None, 0, 0))(
+            lane_states, k_rounds, i, data_x, data_z)
+        dz, _ = _folded_decode(cfg[4], lane_states["d1z"], synd_z)
+        dx, _ = _folded_decode(cfg[3], lane_states["d1x"], synd_x)
+        cur_x = cur_x ^ dx
+        cur_z = cur_z ^ dz
+        return cur_x[:, :, :n], cur_z[:, :, :n]
+
+    data_x, data_z = jax.lax.fori_loop(
+        0, jnp.maximum(num_rounds - 1, 0), body, init)
+
+    def front_final(st, kf, dx_c, dz_c):
+        ex_ext, ez_ext = _sample_ext(cfg, st, kf, batch_size)
+        cur_x = dx_c ^ ex_ext[:, :n]
+        cur_z = dz_c ^ ez_ext[:, :n]
+        synd_x, synd_z = _bare_syndromes(cfg, st, cur_x, cur_z)
+        return cur_x, cur_z, synd_x, synd_z
+
+    cur_x, cur_z, synd_x, synd_z = jax.vmap(
+        front_final, in_axes=(in_axes, 0, 0, 0))(
+        lane_states, k_final, data_x, data_z)
+    dz, az = _folded_decode(cfg[6], lane_states["d2z"], synd_z)
+    dx, ax = _folded_decode(cfg[5], lane_states["d2x"], synd_x)
+
+    def back(st, cx, cz, ddx, ddz):
+        if cfg[7]:
+            return packed_residual_stats(
+                pack_shots(cx ^ ddx), pack_shots(cz ^ ddz),
+                st["hz_par"], st["hx_par"], st["lz_t"], st["lx_t"],
+                "ALL", batch_size, n, z_weight_excludes_stab=True)
+        x_fail, z_fail, mw = _check_flags(cfg, st, cx, cz, ddx, ddz)
+        return jnp.stack([x_fail.sum(dtype=jnp.int32),
+                          z_fail.sum(dtype=jnp.int32),
+                          (x_fail | z_fail).sum(dtype=jnp.int32)]), mw
+
+    cnt3, mw = jax.vmap(back, in_axes=(in_axes, 0, 0, 0, 0))(
+        lane_states, cur_x, cur_z, dx, dz)
+    if _tele_on(cfg):
+        tele = jax.vmap(lambda a, b: telemetry.device_tele_vec(
+            [(cfg[5], a), (cfg[6], b)]))(ax, az)
+        return cnt3, mw, tele
+    return cnt3, mw
+
+
+def _cells_stats_fn(cfg, treedef, axes_flat):
+    """Per-lane stats closure for the CellFusedDriver (phenom variant —
+    ``num_rounds`` rides through as a shared traced extra)."""
+    from .common import gather_lane_states
+    from .data_error import _foldable_decoder
+
+    tele_on = _tele_on(cfg)
+
+    def stats(keys, lane_cell, active, stacked, ltypes, num_rounds):
+        lane_states, in_axes = gather_lane_states(
+            stacked, treedef, axes_flat, lane_cell)
+        if all(_foldable_decoder(cfg[i], in_axes[k])
+               for i, k in ((3, "d1x"), (4, "d1z"),
+                            (5, "d2x"), (6, "d2z"))):
+            out = _stats_all_folded(cfg, lane_states, in_axes, keys,
+                                    num_rounds)
+        else:
+            out = jax.vmap(
+                lambda st, k: _stats_all_one_batch(cfg, st, k, num_rounds),
+                in_axes=(in_axes, 0))(lane_states, keys)
+        cnt3, mw = out[0], out[1]
+        lt = ltypes[lane_cell]
+        cnt = jnp.take_along_axis(cnt3, lt[:, None], axis=1)[:, 0]
+        res = (cnt, mw)
+        if tele_on:
+            res += (jnp.where(active[:, None], out[2], 0)
+                    .sum(axis=0, dtype=jnp.int32),)
+        return res
+
+    return stats
+
+
+def _check_rep_fusable(rep) -> None:
+    if (not rep._dec1_on_device
+            or rep.decoder2_x.needs_host_postprocess
+            or rep.decoder2_z.needs_host_postprocess):
+        raise ValueError(
+            "cell fusion needs pure-device decoders (host-postprocess OSD "
+            "paths have no fused megabatch unit)")
+
+
+def _cells_cfg(s, tele_on: bool):
+    return (s.batch_size, s.N, "CELLS",
+            s.decoder1_x.device_static, s.decoder1_z.device_static,
+            s.decoder2_x.device_static, s.decoder2_z.device_static,
+            s._packed, tele_on)
+
+
+def fused_cells_program_states(rep, cell_states, ltype_codes, cell_tags,
+                               num_samples: int, num_rounds: int, mesh=None,
+                               prestacked=None):
+    """Core fused-program builder for one phenom bucket; see
+    sim/data_error.fused_cells_program_states for the contract.  The
+    per-cell WER inversion uses ``num_rounds`` exactly as the serial
+    WordErrorRate."""
+    from ..parallel.shots import cell_fused_driver
+    from .common import FusedCellProgram, stack_cell_states
+
+    _check_rep_fusable(rep)
+    tele_on = telemetry.enabled()
+    cfg = _cells_cfg(rep, tele_on)
+    stacked, treedef, axes_flat = (
+        prestacked if prestacked is not None
+        else stack_cell_states(cell_states))
+    ltypes = jnp.asarray(list(ltype_codes), jnp.int32)
+    _, key = jax.random.split(rep._base_key)
+    # every fused lane-batch runs on ALL mesh devices (the driver shards
+    # the shot axis), so the per-cell batch budget divides by the mesh size
+    # exactly as the serial mesh path's ShotBatcher does
+    n_dev = 1 if mesh is None else mesh.devices.size
+    batcher = ShotBatcher(num_samples, rep.batch_size * n_dev)
+    chunk = min(batcher.num_batches, rep._scan_chunk)
+    n_batches = -(-batcher.num_batches // chunk) * chunk
+    driver = cell_fused_driver(
+        "phenl", cfg, len(ltypes), chunk,
+        _cells_stats_fn(cfg, treedef, axes_flat),
+        min_init=rep.N, batch_size=rep.batch_size,
+        tele_len=telemetry.TELE_LEN if tele_on else 0,
+        mesh=mesh, state_key=axes_flat)
+    signature_fn = lambda: run_signature(  # noqa: E731
+        "phenl-cells", key, batch_size=rep.batch_size, chunk=chunk,
+        n_batches=n_batches, rounds=int(num_rounds),
+        cells=list(cell_tags),
+        ltypes=[int(x) for x in np.asarray(ltypes)])
+    K = rep.K
+
+    return FusedCellProgram(
+        driver=driver, key=key,
+        extras=(stacked, ltypes, jnp.asarray(num_rounds, jnp.int32)),
+        n_batches=n_batches, chunk=chunk, batch_size=rep.batch_size,
+        n_cells=len(ltypes), engine="phenl",
+        wer_fn=lambda failures, shots: wer_per_cycle(
+            int(failures), int(shots), K, num_rounds),
+        signature_fn=signature_fn)
+
+
+def fused_cells_program(sims, num_samples: int, num_rounds: int, mesh=None):
+    """Build a sim/common.FusedCellProgram fusing same-shape phenomenological
+    simulators (one per sweep cell) into one cell-axis device program; see
+    sim/data_error.fused_cells_program for the contract."""
+    from .common import LTYPE_CODES, key_bytes as _key_bytes
+
+    rep = sims[0]
+    cfg = _cells_cfg(rep, False)
+    for s in sims[1:]:
+        if _cells_cfg(s, False) != cfg or not s._dec1_on_device \
+                or s.decoder2_x.needs_host_postprocess \
+                or s.decoder2_z.needs_host_postprocess:
+            raise ValueError(
+                "cells differ in program structure (batch size, code shape "
+                "or decoder statics); split them into separate buckets")
+        if s.K != rep.K or not np.array_equal(_key_bytes(s._base_key),
+                                              _key_bytes(rep._base_key)):
+            raise ValueError(
+                "cells of one fused bucket must share a seed and K")
+    return fused_cells_program_states(
+        rep, [s._dev_state for s in sims],
+        [LTYPE_CODES[s.eval_logical_type] for s in sims],
+        [[float(np.asarray(p)) for p in s.channel_probs]
+         + [float(s.synd_prob)] for s in sims],
+        num_samples, num_rounds, mesh=mesh)
+
+
 class CodeSimulator_Phenon:
     """Reference-compatible constructor/WordErrorRate surface, batched on TPU."""
+
+    # cell-fused sweep entries: stack same-shape instances (one per sweep
+    # cell) into one cell-axis device program (module fns above)
+    fused_cells_program = staticmethod(fused_cells_program)
+    fused_cells_program_states = staticmethod(fused_cells_program_states)
 
     def __init__(self, code=None, decoder1_x=None, decoder1_z=None,
                  decoder2_x=None, decoder2_z=None,
@@ -401,26 +640,37 @@ class CodeSimulator_Phenon:
         return engine_ladder_step(self)
 
     def _count_failures(self, num_rounds, num_samples, key=None,
-                        progress=None):
+                        progress=None, target_failures=None):
         """(failure count, shots run) under the active resilience policy:
         transient worker faults retry with backoff (resuming from the
         ``progress`` cursor when one is attached), deterministic errors
         fail fast, repeated faults step the degradation ladder.
         ``progress`` is honored on the pure-device single-chip megabatch
         path and silently ignored elsewhere (mesh / host-postprocess paths
-        have no megabatch cursor)."""
+        have no megabatch cursor).  ``target_failures`` stops the run after
+        the first megabatch whose cumulative failure count reaches the
+        target (pure-device single-chip path only, exactly as the data
+        engine's early stop)."""
         apply_worker_batch_fence(self)
+        dec2_host = (self.decoder2_x.needs_host_postprocess
+                     or self.decoder2_z.needs_host_postprocess)
+        if target_failures is not None and (
+                not self._dec1_on_device or dec2_host
+                or self._mesh is not None):
+            raise ValueError(
+                "target_failures early stopping requires the pure-device "
+                "single-chip path (no host-postprocess decoders, no mesh)")
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
 
         return resilient_engine_run(
             self,
             lambda: self._count_failures_once(num_rounds, num_samples, key,
-                                              progress),
+                                              progress, target_failures),
             site="wer.phenl", degrade=self._degrade_once)
 
     def _count_failures_once(self, num_rounds, num_samples, key,
-                             progress=None):
+                             progress=None, target_failures=None):
         dec2_host = (self.decoder2_x.needs_host_postprocess
                      or self.decoder2_z.needs_host_postprocess)
         if self._dec1_on_device and not dec2_host:
@@ -448,22 +698,39 @@ class CodeSimulator_Phenon:
             driver = _stats_driver(
                 self._cfg(self.batch_size, tele=tele_on), chunk)
             before = driver.dispatches
-            if progress is not None:
-                # mid-cell resume path: stream per-megabatch carries
-                # (double-buffered) and persist the cursor; the positional
-                # fold-in key stream makes a resume seed-for-seed identical
-                # to an uninterrupted run (sim/common.resumable_stream owns
-                # the cursor/fingerprint rules for every engine)
+            if progress is not None or target_failures is not None:
+                # streamed path: per-megabatch carries (double-buffered),
+                # persisting the cursor and/or checking the early-stop
+                # target; the positional fold-in key stream makes a resume
+                # seed-for-seed identical to an uninterrupted run
+                # (sim/common.resumable_stream owns the cursor/fingerprint
+                # rules for every engine).  The early-stop semantics mirror
+                # sim/data_error._streaming_run: stop after the first
+                # megabatch whose cumulative count reaches the target, the
+                # denominator being the shots actually run.
                 fp = run_signature(
                     "phenl", key, batch_size=self.batch_size, chunk=chunk,
                     n_batches=n_batches, rounds=int(num_rounds))
-                (carry, _), stream = resumable_stream(
+                (carry, done), stream = resumable_stream(
                     driver, key, n_batches,
                     (self._dev_state, jnp.asarray(num_rounds, jnp.int32)),
                     signature=fp, progress=progress, tele_on=tele_on,
                     min_init=self.N)
-                for carry, _done in stream:
-                    pass
+
+                def _target_hit(c):
+                    return (target_failures is not None
+                            and int(c[0]) >= int(target_failures))
+
+                if _target_hit(carry):
+                    if done * self.batch_size < batcher.total:
+                        telemetry.count("driver.early_stops")
+                else:
+                    for carry, done in stream:
+                        if _target_hit(carry):
+                            if done * self.batch_size < batcher.total:
+                                telemetry.count("driver.early_stops")
+                            break
+                shots = done * self.batch_size
             else:
                 carry, _ = driver.run(
                     key, n_batches, self._dev_state,
@@ -471,12 +738,13 @@ class CodeSimulator_Phenon:
                 # one host round-trip — watchdog-guarded (utils.resilience)
                 carry = resilience.guarded_fetch(
                     lambda: jax.device_get(carry), label="phenl_drain")
+                shots = n_batches * self.batch_size
             self.last_dispatches = driver.dispatches - before
             cnt, mw = carry[0], carry[1]
             if len(carry) > 2:
                 telemetry.publish_device_tele(carry[2])
             self.min_logical_weight = min(self.min_logical_weight, int(mw))
-            return int(cnt), n_batches * self.batch_size
+            return int(cnt), shots
         batcher = ShotBatcher(num_samples, self.batch_size)
         keys = [jax.random.fold_in(key, i) for i in batcher]
         self.last_dispatches = len(keys)  # windowed path: one launch per key
@@ -491,13 +759,14 @@ class CodeSimulator_Phenon:
                        dispatches=self.last_dispatches)
 
     def WordErrorRate(self, num_rounds: int, num_samples: int, key=None,
-                      progress=None):
+                      progress=None, target_failures=None):
         """Per-qubit-per-cycle WER (src/Simulators.py:334-362).
         ``progress``: optional utils.checkpoint.CellProgress for mid-cell
-        resume (see ``_count_failures``)."""
+        resume; ``target_failures``: adaptive megabatch early stop (both
+        documented on ``_count_failures``)."""
         with telemetry.span("wer.phenl"):
             count, total = self._count_failures(num_rounds, num_samples, key,
-                                                progress)
+                                                progress, target_failures)
         wer = wer_per_cycle(count, total, self.K, num_rounds)
         self._record_run(count, total, wer[0])
         return wer

@@ -44,25 +44,30 @@ class CodeFamily:
         self.mesh = mesh  # chip mesh every simulator shards its shots over
 
     # ------------------------------------------------------------------
-    def _data_wer(self, code, eval_p, eval_logical_type, num_samples,
-                  progress=None):
-        """src/Simulators.py:759-777."""
+    def _data_sim(self, code, eval_p, eval_logical_type):
+        """One data-noise cell's engine (src/Simulators.py:759-770) — the
+        unit the serial loop runs directly and the fused planner stacks."""
         p = eval_p * 3 / 2
         decoder_x = self.decoder2_class.GetDecoder({"h": code.hz, "p_data": eval_p})
         decoder_z = self.decoder2_class.GetDecoder({"h": code.hx, "p_data": eval_p})
-        sim = CodeSimulator_DataError(
+        return CodeSimulator_DataError(
             code=code, decoder_x=decoder_x, decoder_z=decoder_z,
             pauli_error_probs=[p / 3, p / 3, p / 3],
             eval_logical_type=eval_logical_type,
             batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
+
+    def _data_wer(self, code, eval_p, eval_logical_type, num_samples,
+                  progress=None, target_failures=None):
+        """src/Simulators.py:759-777."""
+        sim = self._data_sim(code, eval_p, eval_logical_type)
         # the engine honors progress only on its pure-device single-chip
         # megabatch path and ignores it elsewhere (documented contract)
-        return sim.WordErrorRate(num_samples, progress=progress)[0]
+        return sim.WordErrorRate(num_samples, progress=progress,
+                                 target_failures=target_failures)[0]
 
-    def _phenl_wer(self, code, eval_p, eval_logical_type, num_samples,
-                   num_cycles, progress=None):
-        """src/Simulators.py:780-811."""
+    def _phenl_sim(self, code, eval_p, eval_logical_type):
+        """One phenomenological cell's engine (src/Simulators.py:780-802)."""
         p = 3 / 2 * eval_p
         q = eval_p
         p_data = p * 2 / 3
@@ -72,18 +77,102 @@ class CodeFamily:
             {"h": _ext(code.hx), "p_data": p_data, "p_syndrome": q})
         dec2_x = self.decoder2_class.GetDecoder({"h": code.hz, "p_data": p_data})
         dec2_z = self.decoder2_class.GetDecoder({"h": code.hx, "p_data": p_data})
-        sim = CodeSimulator_Phenon(
+        return CodeSimulator_Phenon(
             code=code, decoder1_x=dec1_x, decoder1_z=dec1_z,
             decoder2_x=dec2_x, decoder2_z=dec2_z,
             pauli_error_probs=[p / 3, p / 3, p / 3], q=q,
             eval_logical_type=eval_logical_type,
             batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
+
+    def _phenl_wer(self, code, eval_p, eval_logical_type, num_samples,
+                   num_cycles, progress=None, target_failures=None):
+        """src/Simulators.py:780-811."""
+        sim = self._phenl_sim(code, eval_p, eval_logical_type)
         # the engine honors progress only on its pure-device single-chip
         # megabatch path and ignores it elsewhere (documented contract)
         return sim.WordErrorRate(num_rounds=num_cycles,
                                  num_samples=num_samples,
-                                 progress=progress)[0]
+                                 progress=progress,
+                                 target_failures=target_failures)[0]
+
+    # ------------------------------------------------------------------
+    # fused bucket builders (sweep/fused.py): ONE representative simulator
+    # per bucket; the other cells contribute only their p-dependent device
+    # state via the decoder factories' GetDecoderState — most of the serial
+    # loop's per-cell host cost (decoder + simulator rebuilds) disappears
+    def _data_bucket_program(self, bucket, eval_logical_type, num_samples):
+        from .fused import build_data_bucket
+
+        _, _, code, p0 = bucket[0]
+        rep = self._data_sim(code, p0, eval_logical_type)
+        return build_data_bucket(
+            rep, bucket, self.decoder2_class,
+            lambda p, sector: {"h": code.hz if sector == "x" else code.hx,
+                               "p_data": p},
+            eval_logical_type, num_samples, mesh=self.mesh)
+
+    def _phenl_bucket_program(self, bucket, eval_logical_type, num_samples,
+                              num_cycles):
+        import jax.numpy as jnp
+
+        from ..sim.common import (
+            LTYPE_CODES,
+            stack_from_overrides,
+            states_share_but_llr,
+        )
+
+        _, _, code, p0 = bucket[0]
+        rep = self._phenl_sim(code, p0, eval_logical_type)
+        decs = ("d1x", "d1z", "d2x", "d2z")
+        cells = {k: [rep._dev_state[k]] for k in decs}
+        probs, qs = [list(rep.channel_probs)], [float(rep.synd_prob)]
+        rep_statics = (rep.decoder1_x.device_static,
+                       rep.decoder1_z.device_static,
+                       rep.decoder2_x.device_static,
+                       rep.decoder2_z.device_static)
+        for _, _, _, eval_p in bucket[1:]:
+            p = 3 / 2 * eval_p
+            q = eval_p
+            p_data = p * 2 / 3
+            built = (
+                self.decoder1_class.GetDecoderState(
+                    {"h": _ext(code.hz), "p_data": p_data, "p_syndrome": q}),
+                self.decoder1_class.GetDecoderState(
+                    {"h": _ext(code.hx), "p_data": p_data, "p_syndrome": q}),
+                self.decoder2_class.GetDecoderState(
+                    {"h": code.hz, "p_data": p_data}),
+                self.decoder2_class.GetDecoderState(
+                    {"h": code.hx, "p_data": p_data}),
+            )
+            if tuple(s for s, _ in built) != rep_statics:
+                raise ValueError(
+                    "decoder statics differ across the bucket's p-points")
+            for k, (_, st) in zip(decs, built):
+                cells[k].append(st)
+            probs.append([p / 3, p / 3, p / 3])
+            qs.append(float(q))
+        tags = [float(eval_p) for _, _, _, eval_p in bucket]
+        lt = [LTYPE_CODES[eval_logical_type]] * len(bucket)
+        if all(states_share_but_llr(cells[k][0], d)
+               for k in decs for d in cells[k]):
+            over = {(k, "llr0"): jnp.stack([d["llr0"] for d in cells[k]])
+                    for k in decs}
+            over[("probs",)] = jnp.asarray(probs, jnp.float32)
+            over[("q",)] = jnp.asarray(qs, jnp.float32)
+            prestacked = stack_from_overrides(rep._dev_state, over)
+            return CodeSimulator_Phenon.fused_cells_program_states(
+                rep, None, lt, tags, num_samples, num_cycles,
+                mesh=self.mesh, prestacked=prestacked)
+        states = [rep._dev_state] + [
+            dict(rep._dev_state,
+                 d1x=cells["d1x"][i], d1z=cells["d1z"][i],
+                 d2x=cells["d2x"][i], d2z=cells["d2z"][i],
+                 probs=jnp.asarray(probs[i], jnp.float32),
+                 q=jnp.float32(qs[i]))
+            for i in range(1, len(bucket))]
+        return CodeSimulator_Phenon.fused_cells_program_states(
+            rep, states, lt, tags, num_samples, num_cycles, mesh=self.mesh)
 
     def _circuit_wer(self, code, eval_p, eval_logical_type, num_samples,
                      num_cycles, data_synd_noise_ratio, circuit_type,
@@ -128,15 +217,32 @@ class CodeFamily:
                 data_synd_noise_ratio=1, circuit_type="coloration",
                 circuit_error_params=None, if_plot=True, checkpoint=None,
                 shard_across_processes: bool = False,
-                progress_every: int = 1):
+                progress_every: int = 1, fused: bool | str = "auto",
+                target_failures=None):
         """(len(code_list), len(eval_p_list)) WER array
         (src/Simulators.py:752-908).
 
+        ``fused`` (default "auto"): run the data/phenl grids on the FUSED
+        cell path (sweep/fused.py) — every p-point of a code in one device
+        program, buckets pipelined against host build/record work.  WER is
+        bit-exact seed-for-seed with ``fused=False`` on the megabatch
+        engines; buckets the fused engines cannot take apart
+        (host-postprocess OSD decoders, opt-in fused sampler) fall back to
+        the serial per-cell loop automatically.  The circuit model always
+        runs serially.
+        ``target_failures``: per-cell adaptive early stop — a cell stops
+        once its failure count reaches the target (the denominator is the
+        shots actually run).  On the fused path, converged cells hand their
+        lanes to the undecided ones (adaptive shot reallocation) so the
+        fused batch stays full until the grid converges; serial cells map
+        to the engines' megabatch early stop (pure-device paths — a
+        host-postprocess decoder raises from the engine).
         ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
         (code, p) cells are persisted as they complete and skipped on rerun,
         and the megabatch engines additionally persist MID-cell progress so
         a killed run resumes inside the running cell (seed-for-seed
-        identical; utils.checkpoint.CellProgress).
+        identical; utils.checkpoint.CellProgress).  Fused buckets persist
+        per-CELL cursors in one bucket-level progress record.
         ``progress_every``: persist the in-cell cursor every that-many
         drained megabatches.  Mid-cell progress routes the cell through the
         double-buffered streamed drain (one overlapped host fetch per
@@ -145,7 +251,9 @@ class CodeFamily:
         disable mid-cell resume and keep the single-sync fold.
         ``shard_across_processes``: in a multi-host JAX program, each process
         computes a round-robin subset of the grid; the scalar results merge
-        over DCN at the end (parallel/grid.py).
+        over DCN at the end (parallel/grid.py).  Sharded grids keep the
+        serial per-cell loop (cell-granular ownership doesn't line up with
+        per-code fused buckets).
         """
         assert noise_model in ["data", "phenl", "circuit"], (
             "noise_model should be one of [data, phenl, circuit]"
@@ -174,27 +282,58 @@ class CodeFamily:
 
         logger = get_logger()
         cells = [
-            (ci, code, eval_p)
-            for ci, code in enumerate(self.code_list)
-            for eval_p in eval_p_list
+            (i, ci, code, eval_p)
+            for i, (ci, code, eval_p) in enumerate(
+                (ci, code, eval_p)
+                for ci, code in enumerate(self.code_list)
+                for eval_p in eval_p_list
+            )
         ]
         owned = (
             process_cell_owner(len(cells)) if shard_across_processes
             else np.ones(len(cells), dtype=bool)
         )
-        eval_wer_list = []
-        for (ci, code, eval_p), mine in zip(cells, owned):
-            if not mine:
-                eval_wer_list.append(np.nan)
-                continue
-            cell_key = {
+
+        def cell_key_fn(i, ci, code, eval_p):
+            return {
                 "code": code.name or f"code{ci}_N{code.N}K{code.K}",
                 "noise": noise_model, "type": eval_logical_type,
                 "p": float(eval_p), "cycles": int(num_cycles),
                 "samples": int(num_samples),
             }
+
+        results: dict[int, float] = {}
+        serial_cells = [c for c, mine in zip(cells, owned) if mine]
+        # multi-host grids split ownership at CELL granularity and end in a
+        # DCN allgather; the fused bucket programs are per-process device
+        # programs that don't line up with that collective, so sharded
+        # grids keep the serial per-cell loop
+        if (fused is not False and noise_model in ("data", "phenl")
+                and not shard_across_processes):
+            from .fused import eval_cells_fused
+
+            if noise_model == "data":
+                bucket_builder = lambda bucket: (  # noqa: E731
+                    self._data_bucket_program(bucket, eval_logical_type,
+                                              num_samples))
+            else:
+                bucket_builder = lambda bucket: (  # noqa: E731
+                    self._phenl_bucket_program(bucket, eval_logical_type,
+                                               num_samples, num_cycles))
+            results, serial_cells = eval_cells_fused(
+                serial_cells, bucket_builder, cell_key_fn,
+                checkpoint=checkpoint, progress_every=progress_every,
+                target_failures=target_failures)
+        if target_failures is not None and serial_cells \
+                and noise_model == "circuit":
+            raise ValueError(
+                "target_failures is not supported for the circuit noise "
+                "model (its engine has no megabatch early stop)")
+
+        for i, ci, code, eval_p in serial_cells:
+            cell_key = cell_key_fn(i, ci, code, eval_p)
             if checkpoint is not None and (rec := checkpoint.get(cell_key)):
-                eval_wer_list.append(rec["wer"])
+                results[i] = rec["wer"]
                 continue
             # mid-cell resume (utils.checkpoint.CellProgress): megabatch
             # engines persist their in-cell cursor against the same
@@ -213,11 +352,12 @@ class CodeFamily:
             if noise_model == "data":
                 cell = lambda: self._data_wer(  # noqa: E731
                     code, eval_p, eval_logical_type, num_samples,
-                    progress=progress)
+                    progress=progress, target_failures=target_failures)
             elif noise_model == "phenl":
                 cell = lambda: self._phenl_wer(  # noqa: E731
                     code, eval_p, eval_logical_type, num_samples,
-                    num_cycles, progress=progress)
+                    num_cycles, progress=progress,
+                    target_failures=target_failures)
             else:
                 cell = lambda: self._circuit_wer(  # noqa: E731
                     code, eval_p, eval_logical_type, num_samples,
@@ -233,9 +373,10 @@ class CodeFamily:
             telemetry.count("sweep.cells")
             if checkpoint is not None:
                 checkpoint.put(cell_key, {"wer": float(wer)})
-            eval_wer_list.append(wer)
+            results[i] = float(wer)
 
-        values = np.asarray(eval_wer_list, dtype=float)
+        values = np.asarray(
+            [results.get(i, np.nan) for i in range(len(cells))], dtype=float)
         if shard_across_processes:
             values = merge_cell_results(values)
         eval_wer_array = values.reshape(len(self.code_list), len(eval_p_list))

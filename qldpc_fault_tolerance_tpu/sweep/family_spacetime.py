@@ -44,10 +44,14 @@ class CodeFamily_SpaceTime:
                 circuit_type="coloration", circuit_error_params=None,
                 if_plot=True, if_adaptive=False, adaptive_params=None,
                 checkpoint=None, shard_across_processes: bool = False,
-                progress_every: int = 1):
+                progress_every: int = 1, fused: bool | str = "auto"):
         """(ragged) per-code WER/p lists
         (src/Simulators_SpaceTime.py:1158-1307).
 
+        ``fused``: the data branch (the only ST branch on the megabatch
+        engine) runs on the fused cell path by default — every p-point of a
+        code in one device program, bit-exact with ``fused=False``
+        (sweep/fused.py); unfusable buckets fall back per bucket.
         ``checkpoint``: optional utils.checkpoint.SweepCheckpoint — finished
         cells are persisted as they complete and skipped on rerun; the data
         branch additionally persists mid-cell progress every
@@ -90,17 +94,34 @@ class CodeFamily_SpaceTime:
             else np.ones(len(cells), dtype=bool)
         )
 
-        flat_wer = np.full(len(cells), np.nan)
-        for idx, (ci, eval_p) in enumerate(cells):
-            if not owned[idx]:
-                continue
-            code = self.code_list[ci]
-            cell_key = {
+        def cell_key_fn(idx, ci, code, eval_p):
+            return {
                 "code": code.name or f"code{ci}_N{code.N}K{code.K}",
                 "noise": f"st-{noise_model}", "type": eval_logical_type,
                 "p": float(eval_p), "cycles": int(num_cycles),
                 "rep": int(num_rep), "samples": int(num_samples),
             }
+
+        flat_wer = np.full(len(cells), np.nan)
+        serial = [(idx, ci, self.code_list[ci], eval_p)
+                  for idx, (ci, eval_p) in enumerate(cells) if owned[idx]]
+        # sharded grids keep the serial loop (see sweep/family.py)
+        if (fused is not False and noise_model == "data"
+                and not shard_across_processes):
+            # the data branch rides the same fused planner as
+            # sweep/family.py; phenl/circuit ST engines have no fused unit
+            from .fused import eval_cells_fused
+
+            results, serial = eval_cells_fused(
+                serial,
+                lambda bucket: self._data_bucket_program(
+                    bucket, eval_logical_type, num_samples),
+                cell_key_fn, checkpoint=checkpoint,
+                progress_every=progress_every)
+            for idx, wer in results.items():
+                flat_wer[idx] = wer
+        for idx, ci, code, eval_p in serial:
+            cell_key = cell_key_fn(idx, ci, code, eval_p)
             if checkpoint is not None and (rec := checkpoint.get(cell_key)):
                 flat_wer[idx] = rec["wer"]
                 continue
@@ -145,11 +166,10 @@ class CodeFamily_SpaceTime:
         return eval_wer_list, eval_p_adapt_list
 
     # ------------------------------------------------------------------
-    def _data_wer(self, code, eval_p, eval_logical_type, num_samples,
-                  progress=None):
-        """src/Simulators_SpaceTime.py:1165-1186 — note the decoder params
-        carry 'code_h'/'channel_probs' so circuit-style factory classes work
-        on the data branch too."""
+    def _data_sim(self, code, eval_p, eval_logical_type):
+        """One data cell's engine (src/Simulators_SpaceTime.py:1165-1181) —
+        note the decoder params carry 'code_h'/'channel_probs' so
+        circuit-style factory classes work on the data branch too."""
         p = eval_p * 3 / 2
         decoder_x = self.decoder2_class.GetDecoder({
             "code_h": code.hz, "h": code.hz, "p_data": eval_p,
@@ -159,15 +179,38 @@ class CodeFamily_SpaceTime:
             "code_h": code.hx, "h": code.hx, "p_data": eval_p,
             "channel_probs": eval_p * np.ones(code.N),
         })
-        sim = CodeSimulator_DataError(
+        return CodeSimulator_DataError(
             code=code, decoder_x=decoder_x, decoder_z=decoder_z,
             pauli_error_probs=[p / 3, p / 3, p / 3],
             eval_logical_type=eval_logical_type,
             batch_size=self.batch_size, seed=self.seed, mesh=self.mesh,
         )
+
+    def _data_wer(self, code, eval_p, eval_logical_type, num_samples,
+                  progress=None):
+        """src/Simulators_SpaceTime.py:1165-1186."""
+        sim = self._data_sim(code, eval_p, eval_logical_type)
         # the engine honors progress only on its pure-device single-chip
         # megabatch path and ignores it elsewhere (documented contract)
         return sim.WordErrorRate(num_samples, progress=progress)[0]
+
+    def _data_bucket_program(self, bucket, eval_logical_type, num_samples):
+        """Fused bucket builder: the shared sweep/fused.build_data_bucket
+        with this family's decoder params (code_h/channel_probs carried so
+        circuit-style factory classes work on the data branch too)."""
+        from .fused import build_data_bucket
+
+        _, _, code, p0 = bucket[0]
+        rep = self._data_sim(code, p0, eval_logical_type)
+
+        def params(p, sector):
+            h = code.hz if sector == "x" else code.hx
+            return {"code_h": h, "h": h, "p_data": p,
+                    "channel_probs": p * np.ones(code.N)}
+
+        return build_data_bucket(rep, bucket, self.decoder2_class, params,
+                                 eval_logical_type, num_samples,
+                                 mesh=self.mesh)
 
     def _phenl_wer(self, code, eval_p, eval_logical_type, num_samples,
                    num_cycles, num_rep):

@@ -1,0 +1,260 @@
+"""Fused sweep execution: p-axis batching with shape-bucket pipelining.
+
+The serial grid loop (sweep/family.py) runs one (code, p, logical_type) cell
+at a time: every cell pays its own dispatch chain, warmup and host sync, so
+whole-sweep wall clock is dominated by serialization, not decoding
+(BENCH_r05: hbm_util 0.012 on a chip that is 98% idle between cells).  This
+module fuses every cell of a CODE — all its p-points, any logical types —
+into one device program (sim/data_error.fused_cells_program,
+sim/phenom.fused_cells_program) driven by the cell-masked megabatch driver
+(parallel.shots.CellFusedDriver):
+
+  * one dispatch advances every cell by ``chunk`` batches; one host sync
+    drains the whole bucket's per-cell counters;
+  * with ``target_failures``, converged cells are masked out and their lanes
+    reassigned to the undecided cells (adaptive shot reallocation,
+    sim/common.fused_cell_adaptive) so the fused batch stays full until the
+    bucket converges;
+  * buckets pipeline: while bucket ``b``'s fused cells run on device, the
+    host builds (and compiles) bucket ``b+1``'s program and records bucket
+    ``b-1``'s completed cells — the PR-3 double-buffered drain machinery
+    (parallel.shots.drain_double_buffered) applied at bucket granularity.
+
+Per-cell WER is bit-exact seed-for-seed with the serial path wherever the
+serial path defines a seeded stream (the dense/packed megabatch engines):
+every cell draws from the same positional fold-in key stream it would use
+unfused.  Buckets that cannot fuse (host-postprocess OSD decoders, the
+opt-in fused sampler, mixed program structure) fall back to the serial
+per-cell loop, per bucket.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["FusedUnsupported", "eval_cells_fused", "build_data_bucket"]
+
+
+class FusedUnsupported(Exception):
+    """A bucket (or grid) cannot run on the fused path; run it serially."""
+
+
+def build_data_bucket(rep, bucket, decoder_class, params_fn,
+                      eval_logical_type, num_samples, mesh=None):
+    """Shared light bucket builder for the data engine of BOTH families:
+    one representative simulator (cell 0, already constructed by the
+    caller), the other cells' p-dependent state via the decoder factory's
+    ``GetDecoderState``.
+
+    ``params_fn(eval_p, sector)`` returns the ``GetDecoderState`` params
+    dict for sector ``"x"``/``"z"`` — the only thing the two families'
+    decoder wiring differs in.  When the factory's per-cell states share
+    everything but the LLR prior with the representative (leaves compare by
+    identity, which the per-H memos make hold for the library decoder
+    classes), the stacked overrides drop straight into the rep state
+    (sim/common.stack_from_overrides) — no per-cell dict assembly, no host
+    value-compares; otherwise the generic stacking handles it."""
+    import jax.numpy as jnp
+
+    from ..sim.common import (
+        LTYPE_CODES,
+        stack_from_overrides,
+        states_share_but_llr,
+    )
+    from ..sim.data_error import fused_cells_program_states
+
+    rep_dx, rep_dz = rep._dev_state["dx"], rep._dev_state["dz"]
+    cells_dx, cells_dz = [rep_dx], [rep_dz]
+    probs = [list(rep.channel_probs)]
+    for _, _, _, eval_p in bucket[1:]:
+        sx, dx = decoder_class.GetDecoderState(params_fn(eval_p, "x"))
+        sz, dz = decoder_class.GetDecoderState(params_fn(eval_p, "z"))
+        if (sx != rep.decoder_x.device_static
+                or sz != rep.decoder_z.device_static):
+            raise ValueError(
+                "decoder statics differ across the bucket's p-points")
+        cells_dx.append(dx)
+        cells_dz.append(dz)
+        p = eval_p * 3 / 2
+        probs.append([p / 3, p / 3, p / 3])
+    tags = [float(eval_p) for _, _, _, eval_p in bucket]
+    lt = [LTYPE_CODES[eval_logical_type]] * len(bucket)
+    if (all(states_share_but_llr(rep_dx, d) for d in cells_dx)
+            and all(states_share_but_llr(rep_dz, d) for d in cells_dz)):
+        prestacked = stack_from_overrides(rep._dev_state, {
+            ("dx", "llr0"): jnp.stack([d["llr0"] for d in cells_dx]),
+            ("dz", "llr0"): jnp.stack([d["llr0"] for d in cells_dz]),
+            ("probs",): jnp.asarray(probs, jnp.float32),
+        })
+        return fused_cells_program_states(
+            rep, None, lt, tags, num_samples, mesh=mesh,
+            prestacked=prestacked)
+    states = [rep._dev_state] + [
+        dict(rep._dev_state, dx=dx, dz=dz,
+             probs=jnp.asarray(pr, jnp.float32))
+        for dx, dz, pr in zip(cells_dx[1:], cells_dz[1:], probs[1:])]
+    return fused_cells_program_states(
+        rep, states, lt, tags, num_samples, mesh=mesh)
+
+
+def _bucket_progress_key(cell_keys: list[dict]) -> dict:
+    """Checkpoint key of a fused bucket's mid-run progress records: the
+    first cell's identity plus the full p-list, so a changed remainder
+    (some cells already finished) keys a fresh cursor while finished-cell
+    records stay shared with the serial path."""
+    head = dict(cell_keys[0])
+    head["fused_cells"] = [ck["p"] for ck in cell_keys]
+    return head
+
+
+def _record_cell(cell_key: dict, wer: float, engine: str,
+                 failures: int, shots: int) -> None:
+    """Per-cell bookkeeping identical to the serial loop's (one structured
+    log line + telemetry events/counters), plus the fused-path counters."""
+    from ..sim.common import record_wer_run
+    from ..utils import telemetry
+    from ..utils.observability import get_logger, log_record
+
+    record_wer_run(engine, failures, shots, wer)
+    log_record(get_logger(), "cell_done", **cell_key, wer=float(wer))
+    telemetry.event("cell_done", **cell_key, wer=float(wer))
+    telemetry.count("sweep.cells")
+    telemetry.count("sweep.fused_cells")
+
+
+def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
+                     checkpoint=None, progress_every: int = 1,
+                     target_failures=None):
+    """Run a sweep grid on the fused path.
+
+    ``cells``: list of ``(index, ci, code, eval_p)`` in grid order —
+    consecutive cells of one ``ci`` form a shape bucket.
+    ``bucket_builder(bucket)``: one bucket's sim/common.FusedCellProgram
+    (the engines' fused_cells_program[_states]); raises ValueError when the
+    bucket cannot fuse.
+    ``cell_key_fn(index, ci, code, eval_p)``: the cell's checkpoint key —
+    the SAME dict the serial loop uses, so finished cells interchange
+    between fused and serial runs.
+
+    Returns ``(results, leftovers)``: ``{index: wer}`` for every cell that
+    ran (or was checkpointed), and the cells of unfusable buckets for the
+    caller's serial loop.
+    """
+    from ..parallel.shots import drain_double_buffered
+    from ..sim import common as simc
+    from ..utils import resilience, telemetry
+    from ..utils.checkpoint import CellProgress
+
+    results: dict[int, float] = {}
+    leftovers: list[tuple] = []
+
+    # group into per-code buckets, dropping already-checkpointed cells
+    buckets: list[list[tuple]] = []
+    for item in cells:
+        index, ci, code, eval_p = item
+        if checkpoint is not None and (
+                rec := checkpoint.get(cell_key_fn(*item))):
+            results[index] = rec["wer"]
+            continue
+        if buckets and buckets[-1][0][1] == ci:
+            buckets[-1].append(item)
+        else:
+            buckets.append([item])
+
+    streaming = (checkpoint is not None and progress_every) \
+        or target_failures is not None
+
+    def build(bucket):
+        """(bucket, program) or None when the bucket must run serially
+        (plugin decoders the fused engines cannot take apart)."""
+        try:
+            prog = bucket_builder(bucket)
+        except ValueError as e:
+            telemetry.count("sweep.fused_fallback_cells", len(bucket))
+            telemetry.event("fused_fallback", reason=str(e),
+                            cells=len(bucket))
+            leftovers.extend(bucket)
+            return None
+        telemetry.count("sweep.fused_buckets")
+        return bucket, prog
+
+    def record_bucket(bucket, prog, failures, shots, min_w):
+        del min_w  # per-cell diagnostic; the grid API returns WER only
+        for lane, item in enumerate(bucket):
+            index = item[0]
+            cell_key = cell_key_fn(*item)
+            wer = prog.wer_fn(failures[lane], shots[lane])[0]
+            _record_cell(cell_key, float(wer), prog.engine,
+                         int(failures[lane]), int(shots[lane]))
+            if checkpoint is not None:
+                checkpoint.put(cell_key, {"wer": float(wer)})
+            results[index] = float(wer)
+
+    if not streaming:
+        # shape-bucket pipeline: launch enqueues bucket b's whole fused run
+        # asynchronously, so building/compiling b+1 and draining b-1 overlap
+        # b's device time.  Both halves run under the cell-level retry the
+        # serial loop has (utils.resilience): a transiently-failed launch
+        # re-dispatches from the fresh init carry, and a failed drain
+        # relaunches the bucket before fetching again (the program's host
+        # state survives; only a real worker restart defeats this, exactly
+        # as for the serial engines' device buffers)
+        def launch(bucket):
+            built = build(bucket)
+            if built is None:
+                return None
+            bucket, prog = built
+            carry = resilience.run_cell(
+                lambda: simc.fused_cell_launch(prog)[0],
+                label="cell:fused")
+            return bucket, prog, carry
+
+        def finish(launched):
+            if launched is None:
+                return
+            bucket, prog, carry = launched
+            box = [carry]
+
+            def attempt():
+                if box[0] is None:
+                    box[0] = simc.fused_cell_launch(prog)[0]
+                try:
+                    return simc.fused_cell_finish(box[0])
+                except Exception:
+                    box[0] = None  # retry re-dispatches the whole bucket
+                    raise
+
+            record_bucket(bucket, prog,
+                          *resilience.run_cell(attempt, label="cell:fused"))
+
+        for _ in drain_double_buffered(launch, finish, buckets):
+            pass
+        return results, leftovers
+
+    # streaming (mid-bucket progress and/or adaptive reallocation): the
+    # per-megabatch host loop serializes buckets, but each bucket still pays
+    # ONE sync per megabatch for its entire grid slice
+    tele_on = telemetry.enabled()
+    for bucket in buckets:
+        built = build(bucket)
+        if built is None:
+            continue
+        bucket, prog = built
+        progress = None
+        if checkpoint is not None and progress_every:
+            progress = CellProgress(
+                checkpoint,
+                _bucket_progress_key([cell_key_fn(*it) for it in bucket]),
+                every=progress_every)
+        # transient faults retry under the active policy; with ``progress``
+        # attached the retry resumes from the persisted per-cell cursors
+        def run_bucket(prog=prog, progress=progress):
+            if target_failures is not None:
+                return simc.fused_cell_adaptive(
+                    prog, target_failures=int(target_failures),
+                    progress=progress, tele_on=tele_on)
+            return simc.fused_cell_stream(prog, progress=progress,
+                                          tele_on=tele_on)
+
+        stats = resilience.run_cell(run_bucket, label="cell:fused")
+        record_bucket(bucket, prog, *stats)
+    return results, leftovers

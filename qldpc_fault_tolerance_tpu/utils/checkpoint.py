@@ -206,3 +206,27 @@ class CellProgress:
         if tele is not None:
             state["tele"] = [int(x) for x in tele]
         self.checkpoint.put_progress(self.key, state)
+
+    def save_cells(self, fingerprint, batches_done, failures, shots, min_w,
+                   cursors=None, tele=None) -> None:
+        """Vector twin of ``save`` for cell-FUSED runs: one progress record
+        carries the whole bucket's per-cell counters.  ``batches_done`` is
+        the uniform cursor of the fixed-budget fused stream; adaptive runs
+        additionally persist per-cell ``cursors`` (cells advance at
+        different rates once lanes reallocate).  Same ``every`` throttling
+        and fingerprint rules as the scalar record."""
+        self._saves += 1
+        if (self._saves - 1) % self.every:
+            return
+        state = {
+            "v": 2, "fused": True, "fingerprint": fingerprint,
+            "batches_done": int(batches_done),
+            "failures": [int(x) for x in failures],
+            "shots": [int(x) for x in shots],
+            "min_w": [int(x) for x in min_w],
+        }
+        if cursors is not None:
+            state["cursors"] = [int(x) for x in cursors]
+        if tele is not None:
+            state["tele"] = [int(x) for x in tele]
+        self.checkpoint.put_progress(self.key, state)

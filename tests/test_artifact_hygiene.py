@@ -1,0 +1,66 @@
+"""Source-tree hygiene guards: no bytecode / native build artifacts can
+leak into the package or the git index.
+
+Motivation: a stray ``decoders/__pycache__`` (or a tracked ``.pyc``/``.so``)
+next to the modules is silently importable and shadows source edits — the
+classic "my fix does nothing" failure.  ``.gitignore`` must cover the
+artifact patterns everywhere, and nothing of the kind may be tracked.
+"""
+import os
+import subprocess
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG_ROOT = os.path.join(REPO_ROOT, "qldpc_fault_tolerance_tpu")
+
+
+def test_gitignore_covers_bytecode_everywhere():
+    with open(os.path.join(REPO_ROOT, ".gitignore")) as f:
+        patterns = {line.strip() for line in f if line.strip()}
+    # unanchored patterns apply at every depth — exactly what keeps a
+    # decoders/__pycache__ out of the index
+    assert "__pycache__/" in patterns
+    assert "*.pyc" in patterns
+    assert "*.so" in patterns
+
+
+def _tracked_files():
+    try:
+        out = subprocess.run(
+            ["git", "ls-files"], cwd=REPO_ROOT, check=True,
+            capture_output=True, text=True, timeout=60)
+    except (OSError, subprocess.SubprocessError):
+        pytest.skip("git not available")
+    return out.stdout.splitlines()
+
+
+def test_no_tracked_bytecode_or_native_artifacts():
+    """Nothing importable-but-not-source may be tracked — except the
+    intentionally shipped prebuilt native library under ``_native/`` (the
+    one directory whose .so IS the artifact of record)."""
+    native_prefix = "qldpc_fault_tolerance_tpu/_native/"
+    bad = [
+        p for p in _tracked_files()
+        if (p.endswith((".pyc", ".pyo"))
+            or "__pycache__" in p.split("/")
+            or (p.endswith(".so") and not p.startswith(native_prefix)))
+    ]
+    assert not bad, f"build artifacts tracked by git: {bad}"
+
+
+def test_no_importable_artifacts_in_source_tree():
+    """No ``.so`` outside ``_native/`` and no loose ``.pyc`` next to the
+    modules (bytecode inside ``__pycache__`` is how CPython caches and is
+    gitignored; a SIBLING .pyc would be importable and shadow the .py)."""
+    bad = []
+    for root, dirs, files in os.walk(PKG_ROOT):
+        in_pycache = os.path.basename(root) == "__pycache__"
+        in_native = os.path.relpath(root, PKG_ROOT).split(os.sep)[0] == \
+            "_native"
+        for name in files:
+            if name.endswith(".so") and not in_native:
+                bad.append(os.path.join(root, name))
+            if name.endswith((".pyc", ".pyo")) and not in_pycache:
+                bad.append(os.path.join(root, name))
+    assert not bad, f"importable build artifacts in the source tree: {bad}"

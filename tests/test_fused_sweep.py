@@ -1,0 +1,477 @@
+"""Cell-fused sweep execution (sweep/fused.py + the cell-axis engines):
+bit-exactness vs the serial per-cell path, adaptive shot reallocation,
+per-cell resume, fit-path equivalence, and the retrace-budget guard.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BPDecoder, BP_Decoder_Class
+from qldpc_fault_tolerance_tpu.sim import common as simc
+from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+from qldpc_fault_tolerance_tpu.sweep import CodeFamily, CodeFamily_SpaceTime
+from qldpc_fault_tolerance_tpu.utils import faultinject, resilience, telemetry
+from qldpc_fault_tolerance_tpu.utils.checkpoint import SweepCheckpoint
+
+
+def family(codes, batch_size=64, seed=1, ratio2=6):
+    """Plain-BP family: pure-device decoders keep every cell on the fused
+    megabatch unit."""
+    return CodeFamily(
+        codes,
+        decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        decoder2_class=BP_Decoder_Class(ratio2, "minimum_sum", 0.625),
+        batch_size=batch_size, seed=seed)
+
+
+def data_sim(code, p, lt="Total", batch_size=64, seed=0, scan_chunk=2):
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=6)  # noqa: E731
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+        pauli_error_probs=[p / 2] * 3, eval_logical_type=lt,
+        batch_size=batch_size, seed=seed, scan_chunk=scan_chunk)
+
+
+TINY = [hgp(rep_code(3), rep_code(3)), hgp(rep_code(4), rep_code(4))]
+
+
+# ------------------------------------------------------- tier-1 fast smoke
+def test_fused_data_grid_bitexact_smoke():
+    """2 codes x 3 p tiny-HGP data grid: the fused default must reproduce
+    the serial packed path bit for bit, seed for seed."""
+    p_list = [0.02, 0.05, 0.08]
+    serial = family(TINY).EvalWER("data", "Total", p_list, num_samples=256,
+                                  if_plot=False, fused=False)
+    fused = family(TINY).EvalWER("data", "Total", p_list, num_samples=256,
+                                 if_plot=False)
+    np.testing.assert_array_equal(fused, serial)
+
+
+def test_fused_phenl_grid_bitexact():
+    serial = family([TINY[0]]).EvalWER(
+        "phenl", "Total", [0.01, 0.03], num_samples=128, num_cycles=3,
+        if_plot=False, fused=False)
+    fused = family([TINY[0]]).EvalWER(
+        "phenl", "Total", [0.01, 0.03], num_samples=128, num_cycles=3,
+        if_plot=False)
+    np.testing.assert_array_equal(fused, serial)
+
+
+def test_fused_dense_path_bitexact():
+    """fused=True with packed=False engines: the dense pipeline fuses too
+    (the planner inherits whatever substrate the rep sim runs)."""
+    sims = [data_sim(TINY[0], p) for p in (0.03, 0.06)]
+    for s in sims:
+        s._packed = False
+    prog = CodeSimulator_DataError.fused_cells_program(sims, 256)
+    f, sh, _ = simc.fused_cell_finish(simc.fused_cell_launch(prog)[0])
+    for i, p in enumerate((0.03, 0.06)):
+        ref = data_sim(TINY[0], p)
+        ref._packed = False
+        _, key = jax.random.split(ref._base_key)
+        wer = ref.WordErrorRate(int(sh[i]), key=key)
+        assert prog.wer_fn(f[i], sh[i])[0] == wer[0]
+
+
+def test_fused_mixed_logical_types_one_program():
+    """Cells of different logical types fuse into ONE bucket: each lane
+    selects its count with a traced index, results equal the serial runs."""
+    sims = [data_sim(TINY[0], 0.05, lt) for lt in ("X", "Z", "Total")]
+    prog = CodeSimulator_DataError.fused_cells_program(sims, 512)
+    f, sh, _ = simc.fused_cell_finish(simc.fused_cell_launch(prog)[0])
+    for i, lt in enumerate(("X", "Z", "Total")):
+        ref = data_sim(TINY[0], 0.05, lt)
+        _, key = jax.random.split(ref._base_key)
+        assert prog.wer_fn(f[i], sh[i])[0] == ref.WordErrorRate(
+            512, key=key)[0]
+
+
+def test_fused_data_folded_decode_bitexact():
+    """Exercise the DATA folded-decode branch in tier-1 (two-phase
+    decoders, max_iter >= TWO_PHASE_MIN_ITER — the tiny-code smoke tests
+    stay below it and only hit the vmapped unit)."""
+    from qldpc_fault_tolerance_tpu.ops import bp
+
+    codes = [hgp(rep_code(5), rep_code(5))]
+    fam = family(codes, ratio2=4)
+    rep = fam._data_sim(codes[0], 0.02, "Total")
+    for dec in (rep.decoder_x, rep.decoder_z):
+        assert dec.device_static[0] == "bp"
+        assert dec.device_static[1] >= bp.TWO_PHASE_MIN_ITER, (
+            "config regression: this test must hit the folded branch")
+    serial = family(codes, ratio2=4).EvalWER(
+        "data", "Total", [0.02, 0.06], num_samples=256, if_plot=False,
+        fused=False)
+    fused = family(codes, ratio2=4).EvalWER(
+        "data", "Total", [0.02, 0.06], num_samples=256, if_plot=False)
+    np.testing.assert_array_equal(fused, serial)
+
+
+def test_serial_phenl_target_failures_early_stops():
+    """The phenom engine's serial megabatch early stop (fused=False +
+    target_failures): stops at megabatch granularity with the shots
+    actually run as denominator, and matches a fixed run over that count."""
+    from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+
+    code = TINY[0]
+    p = 0.06
+    ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+    extz = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+
+    def sim():
+        d1 = lambda h: BPDecoder(  # noqa: E731
+            h, np.full(h.shape[1], p), max_iter=4)
+        d2 = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=4)  # noqa: E731
+        return CodeSimulator_Phenon(
+            code=code, decoder1_x=d1(extz), decoder1_z=d1(ext),
+            decoder2_x=d2(code.hz), decoder2_z=d2(code.hx),
+            pauli_error_probs=[p / 2] * 3, q=p, batch_size=32, seed=5,
+            scan_chunk=2)
+
+    s = sim()
+    _, key = jax.random.split(s._base_key)
+    wer_t = s.WordErrorRate(3, 32 * 64, key=key, target_failures=10)
+    cnt, total = sim()._count_failures(3, 32 * 64, key=key)
+    assert total == 32 * 64  # full run really is bigger
+    # replay a fixed run over the early-stopped shot count: identical WER
+    stopped_shots = None
+    for n_batches in range(2, 65, 2):
+        ref = sim()
+        wer_ref = ref.WordErrorRate(3, 32 * n_batches, key=key)
+        if wer_ref[0] == wer_t[0] and wer_ref[1] == wer_t[1]:
+            stopped_shots = 32 * n_batches
+            break
+    assert stopped_shots is not None and stopped_shots < 32 * 64
+
+
+def test_adaptive_progress_not_resumed_by_fixed_stream(tmp_path):
+    """A killed adaptive (target_failures) sweep must NOT seed a later
+    fixed-budget rerun: the modes advance cells differently, so the
+    fingerprints differ and the fixed rerun restarts the bucket clean."""
+    p_list = [0.01, 0.08]  # the low-p cell needs many megabatches
+    shots = 64 * 64
+    clean = family(TINY[:1]).EvalWER("data", "Total", p_list,
+                                     num_samples=shots, if_plot=False)
+    path = str(tmp_path / "sweep.jsonl")
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=2,
+                          count=99)])
+    pol = resilience.RetryPolicy(max_attempts=1, base_delay=0.0, jitter=0.0,
+                                 reset_caches=False)
+    with resilience.policy_override(pol), plan.active():
+        with pytest.raises(faultinject.InjectedFault):
+            family(TINY[:1]).EvalWER(
+                "data", "Total", p_list, num_samples=shots, if_plot=False,
+                target_failures=100, checkpoint=SweepCheckpoint(path))
+    with pytest.warns(UserWarning, match="fingerprint"):
+        resumed = family(TINY[:1]).EvalWER(
+            "data", "Total", p_list, num_samples=shots, if_plot=False,
+            checkpoint=SweepCheckpoint(path))
+    np.testing.assert_array_equal(resumed, clean)
+
+
+def test_fused_phenl_folded_decode_bitexact():
+    """Exercise the phenom FOLDED-decode branch (two-phase decoders: every
+    per-round and final decode runs on the folded lane*shot batch): needs
+    max_iter >= TWO_PHASE_MIN_ITER, which the tiny rep3 configs of the
+    other phenl tests never reach."""
+    from qldpc_fault_tolerance_tpu.ops import bp
+
+    codes = [hgp(rep_code(5), rep_code(5))]
+    fam = family(codes, ratio2=4)
+    rep = fam._phenl_sim(codes[0], 0.01, "Total")
+    for dec in (rep.decoder1_x, rep.decoder1_z, rep.decoder2_x,
+                rep.decoder2_z):
+        assert dec.device_static[0] == "bp"
+        assert dec.device_static[1] >= bp.TWO_PHASE_MIN_ITER, (
+            "config regression: this test must hit the folded branch")
+    serial = family(codes, ratio2=4).EvalWER(
+        "phenl", "Total", [0.01, 0.03], num_samples=128, num_cycles=3,
+        if_plot=False, fused=False)
+    fused = family(codes, ratio2=4).EvalWER(
+        "phenl", "Total", [0.01, 0.03], num_samples=128, num_cycles=3,
+        if_plot=False)
+    np.testing.assert_array_equal(fused, serial)
+
+
+def test_fused_spacetime_data_branch_bitexact():
+    fam_args = dict(
+        decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        decoder2_class=BP_Decoder_Class(6, "minimum_sum", 0.625),
+        batch_size=64, seed=1)
+    serial = CodeFamily_SpaceTime([TINY[0]], **fam_args).EvalWER(
+        "data", "Total", [0.03, 0.06], num_samples=128, if_plot=False,
+        fused=False)
+    fused = CodeFamily_SpaceTime([TINY[0]], **fam_args).EvalWER(
+        "data", "Total", [0.03, 0.06], num_samples=128, if_plot=False)
+    np.testing.assert_array_equal(fused[0][0], serial[0][0])
+
+
+# ----------------------------------------------- adaptive shot reallocation
+def test_adaptive_reallocation_counts_bitexact_and_counted():
+    """Adaptive early stop: every batch a cell executes draws from its
+    serial positional stream — its failure count over the shots it ran
+    equals a serial fixed run over the same shots — and converged cells'
+    lanes are reallocated (telemetry counters prove it)."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        sims = [data_sim(TINY[0], 0.02), data_sim(TINY[0], 0.08)]
+        prog = CodeSimulator_DataError.fused_cells_program(sims, 64 * 40)
+        f, sh, _ = simc.fused_cell_adaptive(prog, target_failures=15,
+                                            tele_on=True)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    # the high-p cell converges first; its lane budget moved to the low-p
+    # cell, so the grid ran reallocated shots and both cells early-stopped
+    assert snap["sweep.reallocated_shots"]["value"] > 0
+    assert snap["driver.early_stops"]["value"] >= 1
+    for i, p in enumerate((0.02, 0.08)):
+        assert f[i] >= 15
+        ref = data_sim(TINY[0], p)
+        _, key = jax.random.split(ref._base_key)
+        cnt, _, _ = ref._device_run_stats(key, 64, int(sh[i]) // 64)
+        assert int(cnt) == f[i]
+
+
+def test_eval_wer_target_failures_fused():
+    wer = family(TINY).EvalWER("data", "Total", [0.02, 0.08],
+                               num_samples=64 * 32, if_plot=False,
+                               target_failures=10)
+    assert wer.shape == (2, 2)
+    assert (wer > 0).all()
+
+
+def test_plan_lanes_covers_disjoint_batches():
+    cursors = np.array([8, 4, 0, 12])
+    base, stride, cell, active, advance, realloc = simc.plan_lanes(
+        cursors, [0, 2], n_lanes=4, k_inner=2, max_batches=40)
+    assert active.all()
+    # every (lane, scan-step) batch index is unique and contiguous per cell
+    for c in (0, 2):
+        lanes = [l for l in range(4) if cell[l] == c]
+        covered = sorted(
+            int(base[l]) + j * int(stride[l]) for l in lanes
+            for j in range(2))
+        assert covered == list(range(int(cursors[c]),
+                                     int(cursors[c]) + len(lanes) * 2))
+        assert advance[c] == len(lanes) * 2
+    assert realloc == 2 * 2  # one extra lane per cell, k_inner batches each
+
+
+def test_plan_lanes_caps_at_budget_and_idles_leftovers():
+    cursors = np.array([38, 0])
+    base, stride, cell, active, advance, realloc = simc.plan_lanes(
+        cursors, [0], n_lanes=4, k_inner=2, max_batches=40)
+    # one megabatch of budget left -> one lane, three idle
+    assert active.sum() == 1 and advance[0] == 2 and realloc == 0
+
+
+# ------------------------------------------------------- resume / progress
+def test_fused_sweep_kill_resume_bitexact(tmp_path):
+    """A fused sweep killed mid-bucket resumes through the v2 per-cell
+    cursors and reproduces the uninterrupted grid bit for bit."""
+    pytest.importorskip("qldpc_fault_tolerance_tpu.utils.faultinject")
+    p_list = [0.05, 0.08]
+    shots = 64 * 32
+    clean = family(TINY[:1]).EvalWER("data", "Total", p_list,
+                                     num_samples=shots, if_plot=False)
+    path = str(tmp_path / "sweep.jsonl")
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=2,
+                          count=99)])
+    pol = resilience.RetryPolicy(max_attempts=1, base_delay=0.0, jitter=0.0,
+                                 reset_caches=False)
+    with resilience.policy_override(pol), plan.active():
+        with pytest.raises(faultinject.InjectedFault):
+            family(TINY[:1]).EvalWER(
+                "data", "Total", p_list, num_samples=shots, if_plot=False,
+                checkpoint=SweepCheckpoint(path))
+    ckpt = SweepCheckpoint(path)
+    assert len(ckpt) < len(p_list)  # the kill landed mid-bucket
+    resumed = family(TINY[:1]).EvalWER(
+        "data", "Total", p_list, num_samples=shots, if_plot=False,
+        checkpoint=SweepCheckpoint(path))
+    np.testing.assert_array_equal(resumed, clean)
+
+
+def test_fused_checkpoint_cells_interchange_with_serial(tmp_path):
+    """Finished-cell records written by the fused path are keyed exactly
+    like the serial path's, so either can resume the other's sweep."""
+    path = str(tmp_path / "sweep.jsonl")
+    p_list = [0.04, 0.07]
+    fused = family(TINY[:1]).EvalWER(
+        "data", "Total", p_list, num_samples=256, if_plot=False,
+        checkpoint=SweepCheckpoint(path))
+    # serial rerun against the same file: every cell must come from records
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        serial = family(TINY[:1]).EvalWER(
+            "data", "Total", p_list, num_samples=256, if_plot=False,
+            fused=False, checkpoint=SweepCheckpoint(path))
+        ran = telemetry.snapshot().get("sim.runs", {}).get("value", 0)
+    finally:
+        telemetry.disable()
+    assert ran == 0
+    np.testing.assert_array_equal(fused, serial)
+
+
+# ------------------------------------------------------------- fit paths
+def test_fits_consume_fused_results_identically():
+    """ThresholdEst_extrapolation / DistanceEst see bit-identical WER
+    arrays from the fused grid, so the fitted p_c / d_eff match the serial
+    path to float tolerance."""
+    est = 0.08
+    kw = dict(noise_model="data", eval_logical_type="Total",
+              eval_method="extrapolation", est_threshold=est,
+              num_samples=256)
+
+    def serial_family():
+        fam = family(TINY, seed=3)
+        orig = fam.EvalWER
+
+        def eval_serial(*a, **k):
+            k["fused"] = False
+            return orig(*a, **k)
+
+        fam.EvalWER = eval_serial
+        return fam
+
+    pc_serial = serial_family().EvalThreshold(**kw)
+    pc_fused = family(TINY, seed=3).EvalThreshold(**kw)
+    assert pc_fused == pytest.approx(pc_serial, rel=1e-12, abs=1e-15)
+
+    d_serial = serial_family().EvalEffectiveDistances(**kw)
+    d_fused = family(TINY, seed=3).EvalEffectiveDistances(**kw)
+    np.testing.assert_allclose(d_fused, d_serial, rtol=1e-12)
+
+
+# ------------------------------------------------- factory light state path
+def test_get_decoder_state_matches_full_build():
+    """The BP factory's GetDecoderState fast path must expose exactly the
+    (static, state) the full GetDecoder build would — statics equal, LLR
+    priors bit-identical, graphs the same memoized object."""
+    code = TINY[1]
+    cls = BP_Decoder_Class(4, "minimum_sum", 0.625)
+    for params in (
+            {"h": code.hz, "p_data": 0.03},
+            {"h": np.hstack([code.hx, np.eye(code.hx.shape[0],
+                                             dtype=np.uint8)]),
+             "p_data": 0.02, "p_syndrome": 0.01},
+    ):
+        dec = cls.GetDecoder(dict(params))
+        static, state = cls.GetDecoderState(dict(params))
+        assert static == dec.device_static
+        np.testing.assert_array_equal(np.asarray(state["llr0"]),
+                                      np.asarray(dec.llr0))
+        assert state["graph"] is dec.graph  # per-H memo object
+        assert state["pallas"] is dec._pallas_head
+
+
+def test_stack_from_overrides_matches_generic_stacking():
+    sims = [data_sim(TINY[0], p) for p in (0.02, 0.05, 0.08)]
+    states = [s._dev_state for s in sims]
+    g_stacked, g_treedef, g_axes = simc.stack_cell_states(states)
+    rep = states[0]
+    # sims share no leaves by identity except the memoized graphs, so build
+    # the overrides from the generically-stacked result itself
+    o_stacked, o_treedef, o_axes = simc.stack_from_overrides(rep, {
+        ("probs",): jnp.stack([s["probs"] for s in states]),
+        ("dx", "llr0"): jnp.stack([s["dx"]["llr0"] for s in states]),
+        ("dz", "llr0"): jnp.stack([s["dz"]["llr0"] for s in states]),
+    })
+    assert o_treedef == g_treedef
+    assert o_axes == g_axes
+    for a, b in zip(jax.tree_util.tree_leaves(o_stacked),
+                    jax.tree_util.tree_leaves(g_stacked)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    with pytest.raises(KeyError):
+        simc.stack_from_overrides(rep, {("nope",): jnp.zeros(3)})
+
+
+def test_unfusable_bucket_falls_back_serially():
+    """Host-postprocess decoder2 (BPOSD on CPU) cannot fuse: the planner
+    must fall back per bucket and still return the serial result."""
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
+
+    fam_args = dict(
+        decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        decoder2_class=BPOSD_Decoder_Class(6, "minimum_sum", 0.625,
+                                           "osd_e", 4),
+        batch_size=64, seed=1)
+    p_list = [0.03, 0.06]
+    serial = CodeFamily([TINY[0]], **fam_args).EvalWER(
+        "data", "Total", p_list, num_samples=128, if_plot=False,
+        fused=False)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        fused = CodeFamily([TINY[0]], **fam_args).EvalWER(
+            "data", "Total", p_list, num_samples=128, if_plot=False)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    np.testing.assert_array_equal(fused, serial)
+    assert snap["sweep.fused_fallback_cells"]["value"] == len(p_list)
+
+
+# ------------------------------------------------------------ mesh sharding
+def test_fused_mesh_shards_shot_axis():
+    from qldpc_fault_tolerance_tpu.parallel import shot_mesh
+
+    mesh = shot_mesh()
+    n_dev = mesh.devices.size
+    assert n_dev == 8  # conftest forces the 8-device virtual CPU mesh
+    sims = [data_sim(TINY[0], p) for p in (0.03, 0.08)]
+    prog = CodeSimulator_DataError.fused_cells_program(sims, 128, mesh=mesh)
+    f, sh, _ = simc.fused_cell_finish(simc.fused_cell_launch(prog)[0])
+    # every lane-batch runs on all devices: shots scale by the mesh size
+    assert (sh == prog.n_batches * 64 * n_dev).all()
+    assert (f >= 0).all() and (f <= sh).all()
+
+
+# ------------------------------------------------------ retrace-budget guard
+def test_retrace_budget_one_compile_per_shape_bucket():
+    """PR-2 compile tracker: a warm fused sweep over NEW p-values (same
+    shapes) must add ZERO retraces — the p-dependent state is traced, so
+    baking a p into a program (the regression this guards) would recompile
+    per p-point."""
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        family(TINY, seed=7).EvalWER(
+            "data", "Total", [0.021, 0.043, 0.065], num_samples=128,
+            if_plot=False)
+        before = telemetry.compile_stats().get("jax.retraces", 0)
+        family(TINY, seed=7).EvalWER(
+            "data", "Total", [0.03, 0.055, 0.077], num_samples=128,
+            if_plot=False)
+        after = telemetry.compile_stats().get("jax.retraces", 0)
+    finally:
+        telemetry.disable()
+    assert after - before == 0, (
+        f"{after - before} retraces on a same-shape p-sweep: some program "
+        "is baking p (or another cell value) into its compile key")
+
+
+# --------------------------------------------------------------- slow e2e
+@pytest.mark.slow
+def test_fused_end_to_end_family_sweep_slow():
+    """Full-size fused family sweep (threshold-fit shaped): bigger codes,
+    6 p-points, early stop + checkpoint, fused vs serial bit-exact."""
+    codes = [hgp(rep_code(5), rep_code(5)), hgp(rep_code(7), rep_code(7))]
+    p_list = list(10 ** np.linspace(np.log10(0.02), np.log10(0.08), 6))
+    fam_args = dict(batch_size=128, seed=11, ratio2=4)
+    serial = family(codes, **fam_args).EvalWER(
+        "data", "Total", p_list, num_samples=1024, if_plot=False,
+        fused=False)
+    fused = family(codes, **fam_args).EvalWER(
+        "data", "Total", p_list, num_samples=1024, if_plot=False)
+    np.testing.assert_array_equal(fused, serial)
