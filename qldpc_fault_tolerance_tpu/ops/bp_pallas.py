@@ -85,17 +85,48 @@ class PallasHeadGraph(NamedTuple):
         return int(np.prod(self.scat.shape)) * 2
 
     def fits_vmem(self) -> bool:
-        return self.scat_bytes <= _SCAT_VMEM_LIMIT
+        """Incidence-stack residency gate.  The conservative 8MB default
+        stands until a TPU-probed calibration table raises it (a
+        ``gates.bp_head_scat_limit_bytes`` entry — the n1225/n1600 unlock
+        path, which needs try-compile evidence, not a bigger constant)."""
+        from ..utils import profiling
+
+        limit = profiling.vmem_table().get("gates", {}).get(
+            "bp_head_scat_limit_bytes")
+        if not isinstance(limit, (int, float)) or limit <= 0:
+            limit = _SCAT_VMEM_LIMIT
+        return self.scat_bytes <= limit
+
+    @property
+    def analytic_per_shot_bytes(self) -> int:
+        """Naive-plane-sum per-shot VMEM estimate with the 1.7x-mosaic +
+        2x-slack fudge — the UNcalibrated prior (see ``per_shot_bytes``)."""
+        return 2 * (4 * self.rw * self.m + 20 * self.n + 16 * self.m)
+
+    def per_shot_bytes(self) -> float:
+        """Per-shot VMEM bytes the tile sizing uses: the calibration
+        table's measured value for this (rw, m, n) when one exists
+        (calibration/vmem_table.json via utils.profiling — the try-compile
+        probes of scripts/vmem_calibrate.py turn the known ~1.8x mosaic
+        temporary undercount into per-shape data), else the analytic
+        prior."""
+        from ..utils import profiling
+
+        return profiling.calibrated_per_shot_bytes(
+            "bp_head", {"rw": self.rw, "m": self.m, "n": self.n},
+            self.analytic_per_shot_bytes)
 
     def max_block_b(self, b: int, want: int = 512) -> int:
         """Largest batch tile <= ``want`` that divides ``b`` and keeps the
         kernel's scoped-VMEM stack under the 32MB compiler limit; 0 when no
         feasible tile exists (callers fall back to the XLA path).
 
-        Per-shot bytes are an empirical fit (~1.7x the naive array-plane
-        sum — mosaic stacks temporaries) with 2x slack; too-small estimates
-        fail at COMPILE time with a scoped-vmem OOM, so err conservative."""
-        per_shot = 2 * (4 * self.rw * self.m + 20 * self.n + 16 * self.m)
+        Per-shot bytes come from the VMEM calibration table when this
+        shape has a probed entry (``per_shot_bytes``); the fallback is the
+        empirical fit (~1.7x the naive array-plane sum — mosaic stacks
+        temporaries) with 2x slack.  Too-small estimates fail at COMPILE
+        time with a scoped-vmem OOM, so err conservative."""
+        per_shot = self.per_shot_bytes()
         budget = 30 * 1024 * 1024 - self.scat_bytes
         top = min(want, b)
         for bt in [top] + [1 << k for k in range(9, 2, -1)]:

@@ -57,6 +57,8 @@ __all__ = [
     "sample_syndrome",
     "residual_check_stats",
     "pallas_feasible",
+    "estimate_vmem_bytes",
+    "vmem_feasible",
 ]
 
 
@@ -428,7 +430,58 @@ def pallas_feasible(batch_size: int, block_w: int = _DEFAULT_BLOCK_W) -> bool:
     return batch_size % (block_w * LANE) == 0
 
 
-def _use_pallas(batch_size: int, backend) -> bool:
+# scoped-VMEM cap the kernels compile against (compiler_params above)
+_KERNEL_VMEM_LIMIT = 64 * 1024 * 1024
+
+
+def estimate_vmem_bytes(n: int, mx: int, mz: int,
+                        block_w: int = _DEFAULT_BLOCK_W, *,
+                        kernel: str = "gf2_sample_synd",
+                        emit_errors: bool = True) -> float:
+    """Per-block VMEM working-set estimate for the fused kernels.
+
+    Naive plane sum of everything resident in one grid step — the draw
+    block, both error planes, the f32 MXU operand/outputs, the packed
+    writes, and the dense transposes — scaled by the kernel's calibrated
+    measured/estimated ratio from calibration/vmem_table.json
+    (utils.profiling; the conservative 2x default stands in until a TPU
+    probe records the real factor — the same class of mosaic-temporary
+    undercount measured at ~1.8x on the BP head)."""
+    from ..utils import profiling
+
+    bt = block_w * LANE
+    draws = bt * n * 4                    # (block_w, LANE, n) uint32
+    errs = 2 * bt * n * 4                 # ex, ez int32 planes
+    mxu_in = bt * n * 4                   # f32 reshape feeding the MXU
+    mxu_out = bt * (mx + mz) * 4          # both syndrome products
+    mats = n * (mx + mz) * 4              # resident hx_t, hz_t
+    packed = block_w * (mx + mz) * 4      # packed syndrome writes
+    if kernel == "gf2_residual":
+        mats += 2 * n * 8 * 4             # lx_t, lz_t (k <= ~8 logicals)
+        packed += 2 * block_w * n * 4     # correction planes in
+    elif emit_errors:
+        packed += 2 * block_w * n * 4     # packed error writes
+    analytic = draws + errs + mxu_in + mxu_out + mats + packed
+    return analytic * profiling.calibration_ratio(kernel, 2.0)
+
+
+def vmem_feasible(spec: FusedSpec, block_w: int = _DEFAULT_BLOCK_W, *,
+                  kernel: str = "gf2_sample_synd",
+                  emit_errors: bool = True) -> bool:
+    """True when the estimated (calibrated) per-block working set fits the
+    kernel's scoped-VMEM cap — the gate half the round-5 README frontier
+    asked for: infeasible shapes route to the bit-exact XLA twin instead
+    of failing at compile time."""
+    n, mx = spec.hx_t.shape
+    mz = spec.hz_t.shape[1]
+    return estimate_vmem_bytes(n, mx, mz, block_w, kernel=kernel,
+                               emit_errors=emit_errors) <= _KERNEL_VMEM_LIMIT
+
+
+def _use_pallas(batch_size: int, backend, spec: FusedSpec = None,
+                block_w: int = _DEFAULT_BLOCK_W, *,
+                kernel: str = "gf2_sample_synd",
+                emit_errors: bool = True) -> bool:
     if FORCE_XLA_TWIN and backend != "pallas":
         return False
     if backend in ("xla", "cpu"):
@@ -436,8 +489,15 @@ def _use_pallas(batch_size: int, backend) -> bool:
     if backend == "pallas":
         return True
     try:
-        return (jax.default_backend() == "tpu"
-                and pallas_feasible(batch_size))
+        if not (jax.default_backend() == "tpu"
+                and pallas_feasible(batch_size, block_w)):
+            return False
+        # calibrated VMEM gate: shapes whose working set busts the scoped
+        # cap fall back to the XLA twin (bit-exact) instead of OOMing the
+        # mosaic compiler; backend="pallas" above stays an explicit
+        # override for probe harnesses
+        return spec is None or vmem_feasible(spec, block_w, kernel=kernel,
+                                             emit_errors=emit_errors)
     except Exception:
         return False
 
@@ -451,7 +511,8 @@ def sample_syndrome(spec: FusedSpec, key, batch_size: int, *,
     with ``emit_errors=False`` (the fully-fused stats pipeline — kernel 2
     regenerates the errors, so they never reach HBM).  The Pallas path and
     the XLA twin produce identical words."""
-    if _use_pallas(batch_size, backend):
+    if _use_pallas(batch_size, backend, spec, block_w,
+                   emit_errors=emit_errors):
         return _sample_syndrome_pallas(spec, key, batch_size, block_w,
                                        interpret, emit_errors)
     return _sample_syndrome_xla(spec, key, batch_size, emit_errors)
@@ -467,7 +528,8 @@ def residual_check_stats(spec: FusedSpec, key, batch_size: int,
     ``key`` must be the SAME key passed to ``sample_syndrome`` for this
     batch (the counters regenerate that exact error).  Returns int32 device
     scalars (failure count, min logical residual weight)."""
-    if _use_pallas(batch_size, backend):
+    if _use_pallas(batch_size, backend, spec, block_w,
+                   kernel="gf2_residual"):
         return _residual_check_pallas(spec, key, batch_size, corx_p, corz_p,
                                       eval_type, block_w, interpret)
     return _residual_check_xla(spec, key, batch_size, corx_p, corz_p,

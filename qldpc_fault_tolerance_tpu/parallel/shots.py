@@ -22,6 +22,7 @@ batches instead of once per batch.
 from __future__ import annotations
 
 import functools
+import time
 from collections import deque
 
 import jax
@@ -30,7 +31,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.bp import _LruCache  # shared bounded memo (see ops/bp.py)
-from ..utils import faultinject, resilience, telemetry
+from ..utils import faultinject, profiling, resilience, telemetry
 
 __all__ = [
     "shot_mesh",
@@ -153,6 +154,9 @@ class MegabatchDriver:
         self.k_inner = max(1, int(k_inner))
         self._init_fn = init_fn
         self.dispatches = 0  # cumulative, observable by bench
+        # cost-model accounting label (utils.profiling.capture_jit_cost):
+        # the factory helpers overwrite it with the engine tag
+        self.cost_label = "megabatch"
 
         def mega(carry, key, offset, *extra):
             def body(c, j):
@@ -176,9 +180,24 @@ class MegabatchDriver:
 
         def attempt():
             faultinject.site("megabatch_dispatch")
+            args = (carry, key, jnp.asarray(start, jnp.int32)) + extra
+            if profiling.enabled():
+                # one extra lower+compile per (label, shape), memoized —
+                # the cost table entry every profiled run derives
+                # mfu/hbm_util from (lower() reads avals only; it cannot
+                # consume the donated carry)
+                profiling.capture_jit_cost(self.cost_label, self._mega,
+                                           *args)
             with telemetry.span("megabatch_dispatch"):
-                out = self._mega(carry, key, jnp.asarray(start, jnp.int32),
-                                 *extra)
+                t0 = time.perf_counter()
+                out = self._mega(*args)
+                launch_s = time.perf_counter() - t0
+                if profiling.deep_timing_enabled():
+                    jax.block_until_ready(out)
+                    profiling.record_dispatch(launch_s,
+                                              time.perf_counter() - t0)
+                else:
+                    profiling.record_dispatch(launch_s)
             self.dispatches += 1
             telemetry.count("driver.dispatches")
             return out
@@ -233,8 +252,11 @@ class MegabatchDriver:
                 return jax.device_get(snap)
 
             with telemetry.span("megabatch_drain"):
-                return resilience.guarded_fetch(
-                    fetch, label="megabatch_drain"), done
+                t0 = time.perf_counter()
+                host = resilience.guarded_fetch(fetch,
+                                                label="megabatch_drain")
+                profiling.record_host_sync(time.perf_counter() - t0)
+                return host, done
 
         yield from drain_double_buffered(launch, finish,
                                          range(int(start), n_run, k))
@@ -265,7 +287,9 @@ def count_min_driver(tag: str, cfg, k_inner: int, stats_fn,
             combine = lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1]))
             init = lambda: (jnp.zeros((), jnp.int32),
                             jnp.asarray(min_init, jnp.int32))
-        return MegabatchDriver(stats_fn, combine, init, k_inner=k_inner)
+        driver = MegabatchDriver(stats_fn, combine, init, k_inner=k_inner)
+        driver.cost_label = f"megabatch.{tag}"
+        return driver
 
     return _engine_driver_cache.get((tag, cfg, k_inner, tele_len), make)
 
@@ -319,6 +343,7 @@ class CellFusedDriver(MegabatchDriver):
         self.tele_len = int(tele_len)
         self._mesh = mesh
         self.dispatches = 0
+        self.cost_label = "fused_cells"
         n_dev = 1 if mesh is None else mesh.devices.size
         shots_inc = jnp.int32(self.batch_size * n_dev)
         big = jnp.int32(np.iinfo(np.int32).max)
@@ -443,8 +468,10 @@ def cell_fused_driver(tag: str, cfg, n_cells: int, k_inner: int, stats_fn,
     one compiled scan."""
 
     def make():
-        return CellFusedDriver(stats_fn, n_cells, batch_size, k_inner,
-                               min_init, tele_len=tele_len, mesh=mesh)
+        driver = CellFusedDriver(stats_fn, n_cells, batch_size, k_inner,
+                                 min_init, tele_len=tele_len, mesh=mesh)
+        driver.cost_label = f"fused_cells.{tag}"
+        return driver
 
     return _engine_driver_cache.get(
         ("cells", tag, cfg, n_cells, k_inner, tele_len, mesh, state_key,

@@ -457,10 +457,13 @@ class CodeSimulator_Circuit:
 
     def WordErrorRate(self, num_samples: int, key=None):
         """Per-qubit-per-cycle WER (src/Simulators.py:653-671)."""
-        from ..utils import telemetry
+        from ..utils import profiling, telemetry
 
-        with telemetry.span("wer.circuit"):
-            count, total = self._count_failures(num_samples, key)
-        wer = wer_per_cycle(count, total, self.K, self.num_cycles)
-        record_wer_run("circuit", count, total, wer[0])
+        # scope opens here (not only in resilient_engine_run) so the
+        # heartbeat record below still sees the run's waterfall accounting
+        with profiling.engine_scope("wer.circuit"):
+            with telemetry.span("wer.circuit"):
+                count, total = self._count_failures(num_samples, key)
+            wer = wer_per_cycle(count, total, self.K, self.num_cycles)
+            record_wer_run("circuit", count, total, wer[0])
         return wer

@@ -335,12 +335,15 @@ class CodeSimulator_Circuit_SpaceTime:
 
     def WordErrorRate(self, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:1031-1049."""
-        from ..utils import telemetry
+        from ..utils import profiling, telemetry
 
-        with telemetry.span("wer.circuit_st"):
-            count, total = self._count_failures(num_samples, key)
-        wer = wer_per_cycle(count, total, self.K, self.num_cycles)
-        record_wer_run("circuit_st", count, total, wer[0])
+        # scope opens here (not only in resilient_engine_run) so the
+        # heartbeat record below still sees the run's waterfall accounting
+        with profiling.engine_scope("wer.circuit_st"):
+            with telemetry.span("wer.circuit_st"):
+                count, total = self._count_failures(num_samples, key)
+            wer = wer_per_cycle(count, total, self.K, self.num_cycles)
+            record_wer_run("circuit_st", count, total, wer[0])
         return wer
 
     def WordErrorRate_TargetFailure(self, target_failures: int, batch_size: int,
@@ -353,20 +356,22 @@ class CodeSimulator_Circuit_SpaceTime:
         # fence here, not just in run_batch: total_samples accounting below
         # must use the batch size that actually ran
         batch_size = fence_batch_value(self, batch_size)
-        from ..utils import telemetry
+        from ..utils import profiling, telemetry
 
-        total_samples, total_failures, i = 0, 0, -1
-        for i in range(int(max_batches)):
-            fails = self.run_batch(jax.random.fold_in(key, i), int(batch_size))
-            total_failures += int(fails.sum())
-            total_samples += int(batch_size)
-            if total_failures >= target_failures:
-                if i + 1 < int(max_batches):
-                    telemetry.count("driver.early_stops")
-                break
-        wer, _ = wer_per_cycle(
-            total_failures, total_samples, self.K, self.num_cycles
-        )
-        record_wer_run("circuit_st", total_failures, total_samples, wer,
-                       dispatches=i + 1)
+        with profiling.engine_scope("wer.circuit_st"):
+            total_samples, total_failures, i = 0, 0, -1
+            for i in range(int(max_batches)):
+                fails = self.run_batch(jax.random.fold_in(key, i),
+                                       int(batch_size))
+                total_failures += int(fails.sum())
+                total_samples += int(batch_size)
+                if total_failures >= target_failures:
+                    if i + 1 < int(max_batches):
+                        telemetry.count("driver.early_stops")
+                    break
+            wer, _ = wer_per_cycle(
+                total_failures, total_samples, self.K, self.num_cycles
+            )
+            record_wer_run("circuit_st", total_failures, total_samples, wer,
+                           dispatches=i + 1)
         return wer, total_samples

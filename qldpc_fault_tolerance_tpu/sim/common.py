@@ -23,6 +23,7 @@ __all__ = [
     "accumulate_device",
     "accumulate_counts",
     "windowed_count",
+    "timed_host_sync",
     "mesh_batch_stats",
     "run_signature",
     "key_bytes",
@@ -66,22 +67,29 @@ def accumulate_counts(count_fn, keys) -> int:
     The ``device_dispatch`` / ``device_sync`` stage timers double as
     telemetry spans when utils.telemetry is enabled (xprof-annotated, with
     duration histograms), and every batch counts as a dispatch."""
-    from ..utils import telemetry
+    import time
+
+    from ..utils import profiling, telemetry
     from ..utils.observability import stage_timer
 
     from ..utils import resilience
 
     keys = list(keys)
     with stage_timer("device_dispatch"):
+        t0 = time.perf_counter()
         total = accumulate_device(count_fn, keys, lambda a, b: a + b)
+        profiling.record_dispatch(time.perf_counter() - t0)
     telemetry.count("driver.dispatches", len(keys))
     if total is None:
         return 0
     with stage_timer("device_sync"):
         # the int() is the blocking device->host sync — watchdog-guarded so
         # a dead worker can't hang the sweep (utils.resilience)
-        return resilience.guarded_fetch(lambda: int(total),
-                                        label="device_sync")
+        t0 = time.perf_counter()
+        out = resilience.guarded_fetch(lambda: int(total),
+                                       label="device_sync")
+        profiling.record_host_sync(time.perf_counter() - t0)
+        return out
 
 
 def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
@@ -95,7 +103,9 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
     tracked as "osd_host" by decoders/osd.py).  With utils.telemetry
     enabled the same stages are trace spans, each launch counts as a
     dispatch, and the in-flight window depth is a gauge."""
-    from ..utils import faultinject, resilience, telemetry
+    import time
+
+    from ..utils import faultinject, profiling, resilience, telemetry
     from ..utils.observability import stage_timer
 
     def _launch_one(k):
@@ -109,13 +119,18 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
             faultinject.site("windowed_drain")
             return int(np.asarray(finish(item)).sum())
 
-        return resilience.guarded_fetch(fetch, label="windowed_drain")
+        t0 = time.perf_counter()
+        out = resilience.guarded_fetch(fetch, label="windowed_drain")
+        profiling.record_host_sync(time.perf_counter() - t0)
+        return out
 
     window, count = [], 0
     for k in keys:
         with stage_timer("launch"):
+            t0 = time.perf_counter()
             window.append(resilience.run_cell(
                 lambda: _launch_one(k), label="windowed_launch"))
+            profiling.record_dispatch(time.perf_counter() - t0)
         telemetry.count("driver.dispatches")
         telemetry.set_gauge("driver.drain_depth", len(window))
         if len(window) >= in_flight:
@@ -125,6 +140,21 @@ def windowed_count(launch, finish, keys, in_flight: int = 4) -> int:
         with stage_timer("finish"):
             count += _finish_one(window.pop(0))
     return count
+
+
+def timed_host_sync(fn):
+    """Run a blocking device->host materialization (``int(x)``,
+    ``device_get``) under the waterfall accounting: the elapsed wall clock
+    records as ``host_sync`` time in the active profiling scope (where the
+    passive-mode run decomposition attributes device wait)."""
+    import time
+
+    from ..utils import profiling
+
+    t0 = time.perf_counter()
+    out = fn()
+    profiling.record_host_sync(time.perf_counter() - t0)
+    return out
 
 
 def key_bytes(key) -> np.ndarray:
@@ -164,7 +194,7 @@ def resilient_engine_run(sim, fn, *, site, degrade=None):
 
     import jax
 
-    from ..utils import faultinject, resilience
+    from ..utils import faultinject, profiling, resilience
 
     def attempt():
         ctx = (jax.default_device(jax.devices("cpu")[0])
@@ -174,7 +204,12 @@ def resilient_engine_run(sim, fn, *, site, degrade=None):
             faultinject.site(site)
             return fn()
 
-    return resilience.run_cell(attempt, label=site, degrade=degrade)
+    # the waterfall accounting scope (utils.profiling): every dispatch
+    # launch and blocking host sync inside the run records into it, and
+    # record_wer_run embeds the resulting stage decomposition in the run's
+    # heartbeat event
+    with profiling.engine_scope(site):
+        return resilience.run_cell(attempt, label=site, degrade=degrade)
 
 
 def engine_ladder_step(sim, extra_rungs=()):
@@ -491,7 +526,9 @@ def fused_cell_finish(carry):
     """Drain half of the bucket pipeline: one watchdog-guarded fetch of the
     whole bucket's per-cell counters, telemetry published at that single
     sync."""
-    from ..utils import faultinject, resilience, telemetry
+    import time
+
+    from ..utils import faultinject, profiling, resilience, telemetry
 
     def fetch():
         faultinject.site("megabatch_drain")
@@ -500,7 +537,9 @@ def fused_cell_finish(carry):
         return jax.device_get(carry)
 
     with telemetry.span("megabatch_drain"):
+        t0 = time.perf_counter()
         host = resilience.guarded_fetch(fetch, label="megabatch_drain")
+        profiling.record_host_sync(time.perf_counter() - t0)
     failures, shots, min_w, tele = _fused_host(host)
     if tele is not None:
         telemetry.publish_device_tele(tele)
@@ -579,9 +618,15 @@ def fused_cell_adaptive(prog: FusedCellProgram, *, target_failures: int,
     total_lane_batches = 0
     idle_lane_batches = 0
     stopped_early = 0
+    import time
+
+    from ..utils import profiling
+
     while True:
+        t0 = time.perf_counter()
         host = resilience.guarded_fetch(
             lambda: jax.device_get(carry), label="fused_adaptive_drain")
+        profiling.record_host_sync(time.perf_counter() - t0)
         failures, shots, min_w, tele = _fused_host(host)
         if progress is not None:
             progress.save_cells(signature, batches_done=0,
@@ -618,8 +663,12 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
     """Shared per-run telemetry bookkeeping for every engine's
     WordErrorRate path: the sim.* counters plus one ``wer_run`` event with
     a uniform schema (``dispatches`` is included only when the path tracks
-    it — megabatch/windowed runs do, plain accumulate paths don't)."""
-    from ..utils import telemetry
+    it — megabatch/windowed runs do, plain accumulate paths don't), plus
+    one ``heartbeat`` event carrying the run's device-time waterfall
+    (utils.profiling.engine_scope stage decomposition — every engine run
+    under resilient_engine_run has one; paths without an active scope emit
+    the heartbeat without stages)."""
+    from ..utils import profiling, telemetry
 
     fields = {"engine": engine, "shots": int(shots),
               "failures": int(failures), "wer": float(wer)}
@@ -629,6 +678,14 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
     telemetry.count("sim.failures", int(failures))
     telemetry.count("sim.runs")
     telemetry.event("wer_run", **fields)
+    hb = {"engine": engine, "shots": int(shots)}
+    wf = profiling.run_heartbeat()
+    if wf is not None:
+        hb["waterfall"] = wf
+        gap = wf.get("dispatch_gap_fraction")
+        if gap is not None:
+            telemetry.set_gauge("profile.dispatch_gap_fraction", gap)
+    telemetry.event("heartbeat", **hb)
 
 
 def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
@@ -664,18 +721,26 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
     n_dev = mesh.devices.size
     batcher = ShotBatcher(num_samples, sim.batch_size * n_dev)
     count, min_w, tele = None, None, None
+    import time
+
+    from ..utils import profiling
+
     for i in batcher:
         faultinject.site("mesh_dispatch")
         keys = split_keys_for_mesh(jax.random.fold_in(key, i), mesh)
+        t0 = time.perf_counter()
         out = run(keys)
+        profiling.record_dispatch(time.perf_counter() - t0)
         telemetry.count("driver.dispatches")
         count = out[0] if count is None else count + out[0]
         min_w = out[1] if min_w is None else jnp.minimum(min_w, out[1])
         if has_tele:
             tele = out[2] if tele is None else tele + out[2]
     # one host round-trip — watchdog-guarded (utils.resilience)
+    t0 = time.perf_counter()
     count, min_w, tele = resilience.guarded_fetch(
         lambda: jax.device_get((count, min_w, tele)), label="mesh_drain")
+    profiling.record_host_sync(time.perf_counter() - t0)
     if tele is not None:
         telemetry.publish_device_tele(tele)
     return int(count), batcher.total, int(min_w)

@@ -49,6 +49,7 @@ from .common import (
     resilient_engine_run,
     resumable_stream,
     run_signature,
+    timed_host_sync,
     wer_single_shot,
     windowed_count,
 )
@@ -745,11 +746,15 @@ class CodeSimulator_DataError:
             total, min_w, tele_vec = self._device_run_stats(
                 key, self.batch_size, n_batches
             )
-            self.min_logical_weight = min(self.min_logical_weight, int(min_w))
+            # the int() pair is the run's one blocking host sync — timed
+            # into the waterfall accounting (utils.profiling)
+            total, min_w = timed_host_sync(
+                lambda: (int(total), int(min_w)))
+            self.min_logical_weight = min(self.min_logical_weight, min_w)
             if tele_vec is not None:
                 telemetry.publish_device_tele(tele_vec)
             return self._wer_result(
-                int(total), n_batches * self.batch_size
+                total, n_batches * self.batch_size
             )
         keys = [jax.random.fold_in(key, i) for i in batcher]
         self.last_dispatches = len(keys)  # windowed path: one launch per key

@@ -48,6 +48,7 @@ from .common import (
     resilient_engine_run,
     resumable_stream,
     run_signature,
+    timed_host_sync,
     wer_per_cycle,
     wer_single_shot,
     windowed_count,
@@ -736,8 +737,8 @@ class CodeSimulator_Phenon:
                     key, n_batches, self._dev_state,
                     jnp.asarray(num_rounds, jnp.int32))
                 # one host round-trip — watchdog-guarded (utils.resilience)
-                carry = resilience.guarded_fetch(
-                    lambda: jax.device_get(carry), label="phenl_drain")
+                carry = timed_host_sync(lambda: resilience.guarded_fetch(
+                    lambda: jax.device_get(carry), label="phenl_drain"))
                 shots = n_batches * self.batch_size
             self.last_dispatches = driver.dispatches - before
             cnt, mw = carry[0], carry[1]
@@ -764,19 +765,29 @@ class CodeSimulator_Phenon:
         ``progress``: optional utils.checkpoint.CellProgress for mid-cell
         resume; ``target_failures``: adaptive megabatch early stop (both
         documented on ``_count_failures``)."""
-        with telemetry.span("wer.phenl"):
-            count, total = self._count_failures(num_rounds, num_samples, key,
-                                                progress, target_failures)
-        wer = wer_per_cycle(count, total, self.K, num_rounds)
-        self._record_run(count, total, wer[0])
+        # the waterfall scope opens HERE (not only inside
+        # resilient_engine_run) so the heartbeat _record_run emits still
+        # sees the run's dispatch/sync accounting — phenom records after
+        # the WER inversion, outside the resilience wrapper
+        from ..utils import profiling
+
+        with profiling.engine_scope("wer.phenl"):
+            with telemetry.span("wer.phenl"):
+                count, total = self._count_failures(
+                    num_rounds, num_samples, key, progress, target_failures)
+            wer = wer_per_cycle(count, total, self.K, num_rounds)
+            self._record_run(count, total, wer[0])
         return wer
 
     def WordErrorProbability(self, num_rounds: int, num_samples: int,
                              key=None, progress=None):
         """End-of-run word error probability (src/Simulators.py:365-383)."""
-        with telemetry.span("wer.phenl"):
-            count, total = self._count_failures(num_rounds, num_samples, key,
-                                                progress)
-        wer = wer_single_shot(count, total, self.K)
-        self._record_run(count, total, wer[0])
+        from ..utils import profiling
+
+        with profiling.engine_scope("wer.phenl"):
+            with telemetry.span("wer.phenl"):
+                count, total = self._count_failures(num_rounds, num_samples,
+                                                    key, progress)
+            wer = wer_single_shot(count, total, self.K)
+            self._record_run(count, total, wer[0])
         return wer

@@ -277,12 +277,15 @@ class CodeSimulator_Phenon_SpaceTime:
     def WordErrorRate(self, num_cycles: int, num_samples: int, key=None):
         """src/Simulators_SpaceTime.py:531-548: cycles are grouped into
         windows of num_rep; total cycle count must come out odd."""
-        from ..utils import telemetry
+        from ..utils import profiling, telemetry
 
-        with telemetry.span("wer.phenl_st"):
-            wer, count, total = self._word_error_rate(
-                num_cycles, num_samples, key)
-        record_wer_run("phenl_st", count, total, wer[0])
+        # scope opens here (not only in resilient_engine_run) so the
+        # heartbeat record below still sees the run's waterfall accounting
+        with profiling.engine_scope("wer.phenl_st"):
+            with telemetry.span("wer.phenl_st"):
+                wer, count, total = self._word_error_rate(
+                    num_cycles, num_samples, key)
+            record_wer_run("phenl_st", count, total, wer[0])
         return wer
 
     def _word_error_rate(self, num_cycles: int, num_samples: int, key=None):

@@ -46,18 +46,37 @@ def stage_timer(name: str):
             _TIMINGS[name].append(dt)
 
 
+def _quantile(sorted_vals: list[float], q: float) -> float:
+    """Linear-interpolated quantile of a pre-sorted sample."""
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = q * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] + (sorted_vals[hi] - sorted_vals[lo]) * (pos - lo)
+
+
 def timings() -> dict[str, dict]:
-    """Summary of accumulated stage timings: count / total / mean seconds."""
+    """Summary of accumulated stage timings per stage: count / total /
+    mean plus the distribution — p50 / p95 / max.  A mean alone hides the
+    exact long-tail behavior (one 10s stalled drain among a thousand 10ms
+    ones) that stage timers exist to expose."""
     with _TIMINGS_LOCK:
         items = {name: list(vals) for name, vals in _TIMINGS.items()}
-    return {
-        name: {
-            "count": len(vals),
-            "total_s": round(sum(vals), 6),
-            "mean_s": round(sum(vals) / len(vals), 6),
+    out = {}
+    for name, vals in items.items():
+        if not vals:
+            continue
+        s = sorted(vals)
+        out[name] = {
+            "count": len(s),
+            "total_s": round(sum(s), 6),
+            "mean_s": round(sum(s) / len(s), 6),
+            "p50_s": round(_quantile(s, 0.50), 6),
+            "p95_s": round(_quantile(s, 0.95), 6),
+            "max_s": round(s[-1], 6),
         }
-        for name, vals in items.items() if vals
-    }
+    return out
 
 
 def reset_timings() -> None:

@@ -1,0 +1,238 @@
+"""Bench regression ledger: normalize the BENCH_r*.json history, render the
+round-to-round trajectory with tolerance bands, and gate regressions.
+
+    python scripts/bench_compare.py                    # trajectory table
+    python scripts/bench_compare.py --json             # machine-readable
+    python scripts/bench_compare.py --gate             # exit 1 on regression
+    python scripts/bench_compare.py --gate --tolerance 5 BENCH_r0*.json
+
+Three artifact schemas are accepted per round (the ledger spans them):
+
+  * the driver wrapper the r01–r05 history uses:
+    ``{"n": <round>, "cmd": ..., "rc": ..., "tail": ..., "parsed": {...}}``;
+  * schema-2 ledger rounds: ``{"schema": 2, "round": <n>, "result": {...}}``
+    (what a future bench harness should write);
+  * a bare bench.py result line: ``{"metric": ..., "value": ...}`` (round
+    inferred from the filename's ``r<NN>``).
+
+The gate compares CONSECUTIVE rounds on the headline ``value`` plus any
+stage-rate fields present in both rounds (``GATED_FIELDS`` — the
+CPU-measurable sample→syndrome substrate rates and the whole-grid sweep
+speedup), and fails when any drops more than ``--tolerance`` percent.
+Higher-is-better is assumed for shots/s metrics; wall-clock metrics
+(``unit == "s"``) gate on INCREASES instead.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LEDGER_SCHEMA = 2
+
+# dotted paths into the normalized fields dict, gated when present in BOTH
+# rounds of a consecutive pair (the headline "value" is always gated)
+GATED_FIELDS = (
+    "sample_synd_shots_per_s.dense",
+    "sample_synd_shots_per_s.packed",
+    "sample_synd_shots_per_s.fused",
+    "fused_speedup_vs_serial",
+)
+
+
+def _dig(d: dict, dotted: str):
+    cur = d
+    for part in dotted.split("."):
+        if not isinstance(cur, dict) or part not in cur:
+            return None
+        cur = cur[part]
+    return cur if isinstance(cur, (int, float)) else None
+
+
+def normalize_round(obj: dict, fallback_round=None) -> dict | None:
+    """One artifact -> ``{"round", "schema", "metric", "value", "unit",
+    "fields"}`` or None when the object isn't a bench round."""
+    if not isinstance(obj, dict):
+        return None
+    if obj.get("schema") == LEDGER_SCHEMA and isinstance(
+            obj.get("result"), dict):
+        result = obj["result"]
+        rnd = obj.get("round", fallback_round)
+        schema = LEDGER_SCHEMA
+    elif isinstance(obj.get("parsed"), dict):  # legacy driver wrapper
+        result = obj["parsed"]
+        rnd = obj.get("n", fallback_round)
+        schema = 1
+    elif "value" in obj and "metric" in obj:   # bare bench.py line
+        result = obj
+        rnd = fallback_round
+        schema = 0
+    else:
+        return None
+    if not isinstance(result.get("value"), (int, float)):
+        return None
+    return {
+        "round": rnd,
+        "schema": schema,
+        "metric": result.get("metric", "?"),
+        "value": float(result["value"]),
+        "unit": result.get("unit", ""),
+        "fields": result,
+    }
+
+
+def _round_from_name(path: str):
+    m = re.search(r"r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else None
+
+
+def load_history(paths) -> list[dict]:
+    """Load + normalize rounds, sorted by round number; unreadable or
+    non-bench files are skipped with a warning."""
+    rounds = []
+    for path in paths:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                obj = json.load(fh)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"warning: skipping {path}: {e}", file=sys.stderr)
+            continue
+        rec = normalize_round(obj, fallback_round=_round_from_name(path))
+        if rec is None:
+            print(f"warning: {path} is not a bench round artifact",
+                  file=sys.stderr)
+            continue
+        rec["path"] = os.path.basename(path)
+        rounds.append(rec)
+    rounds.sort(key=lambda r: (r["round"] is None, r["round"]))
+    return rounds
+
+
+def compare(rounds: list[dict], tolerance_pct: float) -> dict:
+    """Consecutive-pair deltas + tolerance violations over the gated
+    fields.  Wall-clock metrics (unit 's') regress UP; rate metrics
+    regress DOWN."""
+    deltas, violations = [], []
+    for prev, cur in zip(rounds, rounds[1:]):
+        pair = {"from": prev["round"], "to": cur["round"], "fields": {}}
+        lower_is_better = cur.get("unit") == "s"
+        for name in ("value",) + GATED_FIELDS:
+            a = _dig(prev["fields"], name) if name != "value" \
+                else prev["value"]
+            b = _dig(cur["fields"], name) if name != "value" \
+                else cur["value"]
+            if a is None or b is None or a == 0:
+                continue
+            delta_pct = (b - a) / abs(a) * 100.0
+            regressed = (delta_pct > tolerance_pct if lower_is_better
+                         and name == "value"
+                         else delta_pct < -tolerance_pct)
+            pair["fields"][name] = {
+                "from": a, "to": b, "delta_pct": round(delta_pct, 2),
+                "regressed": regressed,
+            }
+            if regressed:
+                violations.append({
+                    "from_round": prev["round"], "to_round": cur["round"],
+                    "field": name, "delta_pct": round(delta_pct, 2),
+                })
+        deltas.append(pair)
+    return {
+        "tolerance_pct": tolerance_pct,
+        "rounds": [{k: r[k] for k in
+                    ("round", "schema", "metric", "value", "unit", "path")}
+                   for r in rounds],
+        "deltas": deltas,
+        "violations": violations,
+    }
+
+
+def _band(delta_pct: float | None, tol: float,
+          lower_is_better: bool = False) -> str:
+    if delta_pct is None:
+        return ""
+    good = -delta_pct if lower_is_better else delta_pct
+    if good < -tol:
+        return "REGRESSED"
+    if good > tol:
+        return "improved"
+    return "within band"
+
+
+def render(cmp: dict) -> str:
+    tol = cmp["tolerance_pct"]
+    L = [f"== bench trajectory (tolerance ±{tol}%) =="]
+    prev_val = None
+    for r in cmp["rounds"]:
+        delta = (None if prev_val in (None, 0)
+                 else (r["value"] - prev_val) / abs(prev_val) * 100.0)
+        d_txt = f"{delta:+8.2f}%" if delta is not None else " " * 9
+        # wall-clock rounds (unit 's') improve DOWN — labels must agree
+        # with the gate logic in compare()
+        band = _band(delta, tol, lower_is_better=r["unit"] == "s")
+        L.append(f"  r{r['round']:>02}  {r['value']:>14,.1f} {r['unit']:<8}"
+                 f"{d_txt}  {band:<12} ({r['path']})")
+        prev_val = r["value"]
+    if cmp["rounds"]:
+        L.append(f"  metric: {cmp['rounds'][-1]['metric']}")
+    stage_rows = [
+        (p, name, f)
+        for p in cmp["deltas"] for name, f in p["fields"].items()
+        if name != "value"
+    ]
+    if stage_rows:
+        L.append("-- gated stage fields --")
+        for p, name, f in stage_rows:
+            L.append(f"  r{p['from']:>02}->r{p['to']:>02}  {name:<36}"
+                     f"{f['delta_pct']:+8.2f}%  "
+                     f"{_band(f['delta_pct'], tol)}")
+    if cmp["violations"]:
+        L.append("-- VIOLATIONS --")
+        for v in cmp["violations"]:
+            L.append(f"  r{v['from_round']}->r{v['to_round']} "
+                     f"{v['field']}: {v['delta_pct']:+.2f}% "
+                     f"(tolerance ±{tol}%)")
+    else:
+        L.append(f"gate: PASS ({len(cmp['rounds'])} rounds, "
+                 f"{len(cmp['deltas'])} comparisons)")
+    return "\n".join(L)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="round artifacts (default: BENCH_r*.json in the "
+                         "repo root, sorted)")
+    ap.add_argument("--gate", action="store_true",
+                    help="exit 1 when any gated field regressed beyond "
+                         "the tolerance")
+    ap.add_argument("--tolerance", type=float, default=10.0,
+                    help="regression tolerance in percent (default 10; "
+                         "the shared-chip history varies ~2%% round to "
+                         "round)")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or sorted(glob.glob(
+        os.path.join(REPO, "BENCH_r*.json")))
+    rounds = load_history(paths)
+    if len(rounds) < 2:
+        print(f"need >= 2 rounds to compare, got {len(rounds)}",
+              file=sys.stderr)
+        return 2
+    cmp = compare(rounds, args.tolerance)
+    if args.json:
+        print(json.dumps(cmp, indent=1))
+    else:
+        print(render(cmp))
+    if args.gate and cmp["violations"]:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

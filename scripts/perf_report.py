@@ -1,0 +1,332 @@
+"""Unified performance-attribution CLI (utils.profiling front end).
+
+Consolidates the ad-hoc round-2..4 profilers — scripts/profile_bp.py,
+scripts/profile_bposd.py, scripts/tpu_timing.py — onto the ISSUE-6
+profiling subsystem.  Subcommands:
+
+    python scripts/perf_report.py bp [--batch 8192]
+        Component-level timing of the bench BP pipeline (sample+syndrome,
+        bp_decode variants, two-phase) — the old profile_bp report.
+    python scripts/perf_report.py bposd [--batch 2048]
+        Stage-split BP+OSD timing at the bench bposd operating point
+        (BP alone, device OSD-0/OSD-E, full decode) — old profile_bposd.
+    python scripts/perf_report.py costs [--batch 2048 --batches 8]
+        XLA cost-model capture of the megabatch program: measured
+        flops/bytes/peak per program + derived mfu/hbm_util at the
+        measured rate.
+    python scripts/perf_report.py waterfall [--batch 2048 --shots 16384]
+        Device-time waterfall of one WordErrorRate run: per-stage device
+        times (sample→syndrome / BP / residual), dispatch-launch /
+        device / host-sync / gap decomposition, dispatch_gap_fraction.
+    python scripts/perf_report.py calibration
+        Summary of the VMEM calibration table the Pallas gates consume
+        (regenerate with scripts/vmem_calibrate.py).
+
+The slope-based tunnel-safe timer lives at
+``qldpc_fault_tolerance_tpu.utils.profiling.per_call_seconds`` (moved from
+scripts/tpu_timing.py, which is now a shim).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench_code(small: bool = False):
+    if small:
+        from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+
+        return hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+    sys.path.insert(0, REPO)
+    import bench
+
+    return bench._bench_code()
+
+
+def _make_bp_sim(code, batch: int, batches: int):
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError)
+
+    p = 0.01
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=50)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=50)
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=batch, seed=0,
+        scan_chunk=batches)
+
+
+# ---------------------------------------------------------------------------
+# bp: component-level timing (the old scripts/profile_bp.py report)
+# ---------------------------------------------------------------------------
+def cmd_bp(batch: int = 8192) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.noise import depolarizing_xz
+    from qldpc_fault_tolerance_tpu.ops import bp
+    from qldpc_fault_tolerance_tpu.ops.linalg import gf2_matmul
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    code = _bench_code()
+    p = 0.01
+    graph = bp.build_tanner_graph(code.hx)
+    llr0 = bp.llr_from_probs(np.full(code.N, p))
+    hx_t = jnp.asarray(code.hx.T)
+    key = jax.random.PRNGKey(0)
+
+    @jax.jit
+    def sample(key):
+        ex, ez = depolarizing_xz(key, (batch, code.N), (p / 3,) * 3)
+        return ez, gf2_matmul(ez, hx_t)
+
+    # timeit_async = the old profile_bp protocol: reps async dispatches,
+    # ONE sync — per-rep blocking would time the tunnel, not the compute
+    t_sample, (ez, synd) = profiling.timeit_async(sample, key)
+    print(f"sample+syndrome: {t_sample*1e3:.2f} ms  "
+          f"({batch/t_sample:,.0f}/s)")
+
+    frac = []
+    for hi in (2, 3, 5):
+        r = bp.bp_decode(graph, synd, llr0, max_iter=hi)
+        frac.append((hi, 1 - float(r.converged.mean())))
+    print("unconverged frac after iters:", frac)
+    r50 = bp.bp_decode(graph, synd, llr0, max_iter=50)
+    print("unconverged frac after 50:", 1 - float(r50.converged.mean()))
+
+    for name, fn in [
+        ("bp_decode(50, early_stop)",
+         lambda s: bp.bp_decode(graph, s, llr0, max_iter=50)),
+        ("bp_decode(50, no early)",
+         lambda s: bp.bp_decode(graph, s, llr0, max_iter=50,
+                                early_stop=False)),
+        ("bp_decode(3)", lambda s: bp.bp_decode(graph, s, llr0, max_iter=3)),
+        ("two_phase(3,B/16)",
+         lambda s: bp.bp_decode_two_phase(graph, s, llr0, max_iter=50)),
+        ("two_phase(5,B/32)",
+         lambda s: bp.bp_decode_two_phase(graph, s, llr0, max_iter=50,
+                                          head_iters=5,
+                                          tail_capacity=batch // 32)),
+    ]:
+        t, _ = profiling.timeit_async(fn, synd)
+        print(f"{name}: {t*1e3:.2f} ms  ({batch/t:,.0f} dec/s)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# bposd: stage-split BP+OSD (the old scripts/profile_bposd.py report)
+# ---------------------------------------------------------------------------
+def cmd_bposd(batch: int = 2048) -> int:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.decoders.bp_decoders import decode_device
+    from qldpc_fault_tolerance_tpu.ops import bp
+    from qldpc_fault_tolerance_tpu.ops.osd_device import (
+        build_osd_plan,
+        osd_decode_values,
+    )
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    code = _bench_code()
+    p = 0.05
+    two_thirds = 2 * p / 3
+    mi = int(code.N / 10)
+    dec = BPOSD_Decoder(code.hx, np.full(code.N, two_thirds), max_iter=mi,
+                        osd_method="osd_e", osd_order=10)
+    key = jax.random.PRNGKey(0)
+    err = jax.random.bernoulli(key, two_thirds, (batch, code.N))
+    synd = ((err.astype(jnp.uint8) @ jnp.asarray(code.hx.T)) % 2).astype(
+        jnp.uint8)
+
+    graph = bp.build_tanner_graph(code.hx)
+    llr0 = bp.llr_from_probs(np.full(code.N, two_thirds))
+
+    @jax.jit
+    def bp_only(synd):
+        return bp.bp_decode(graph, synd, llr0, max_iter=mi)
+
+    t_bp, res = profiling.timeit_async(bp_only, synd, reps=10)
+    conv = np.asarray(res.converged)
+    print(f"batch={batch}  BP({mi} iters): {t_bp * 1e3:.1f} ms  "
+          f"converged={conv.mean():.3f}  n_bad={int((~conv).sum())}")
+
+    plan = build_osd_plan(code.hx, np.full(code.N, two_thirds))
+    llrs = jnp.asarray(res.posterior_llr)
+    for sub in sorted({256, 512, batch}):
+        if sub > batch:
+            continue
+        s_sub, l_sub = synd[:sub], llrs[:sub]
+        for order, label in ((0, "OSD-0 (elim+solve)"),
+                             (10, "OSD-E order 10")):
+            fn = jax.jit(lambda s, l, o=order: osd_decode_values(
+                (plan.n, plan.rank, o, 256,
+                 os.environ.get("QLDPC_OSD_ELIM", "pallas")),
+                plan.packed, plan.cost, s, l))
+            t, _ = profiling.timeit_async(fn, s_sub, l_sub, reps=10)
+            print(f"  osd batch={sub:5d} {label:18s}: {t * 1e3:7.1f} ms  "
+                  f"({sub / t:8.0f} shots/s)")
+
+    @jax.jit
+    def full(synd):
+        return decode_device(dec.device_static, dec.device_state, synd)
+
+    t_full, _ = profiling.timeit_async(full, synd, reps=10)
+    print(f"full BPOSD decode_device: {t_full * 1e3:.1f} ms  "
+          f"({batch / t_full:.0f} shots/s)")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# costs: cost-model capture + derived utilization
+# ---------------------------------------------------------------------------
+def cmd_costs(batch: int = 2048, batches: int = 8,
+              small: bool = False) -> int:
+    import jax
+
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    code = _bench_code(small)
+    sim = _make_bp_sim(code, batch, batches)
+    shots = batch * batches
+    key = jax.random.PRNGKey(123)
+    with profiling.profile_session():
+        sim.WordErrorRate(shots, key=key)  # warm + capture
+        t0 = time.perf_counter()
+        sim.WordErrorRate(shots, key=key)
+        rate = shots / (time.perf_counter() - t0)
+        costs = profiling.program_costs()
+    print(f"rate: {rate:,.1f} shots/s  ({code.name}, batch {batch} x "
+          f"{batches})")
+    for label, c in costs.items():
+        util = profiling.derive_utilization(c, batch, rate)
+        print(f"-- {label} (backend {c['backend']}) --")
+        for k in ("flops", "bytes_accessed", "argument_bytes",
+                  "output_bytes", "temp_bytes", "peak_bytes"):
+            print(f"  {k:<18}{c[k]:,.0f}")
+        for k, v in util.items():
+            print(f"  {k:<18}{v}")
+    print("note: XLA cost model counts loop bodies once -> per-shot "
+          "figures normalize by ONE scan-body batch")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# waterfall: run decomposition + per-stage device times
+# ---------------------------------------------------------------------------
+def cmd_waterfall(batch: int = 2048, shots: int = 16384,
+                  small: bool = False) -> int:
+    import jax
+
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    sys.path.insert(0, REPO)
+    import bench
+
+    code = _bench_code(small)
+    batches = max(1, shots // batch)
+    sim = _make_bp_sim(code, batch, batches)
+    shots = batch * batches
+    key = jax.random.PRNGKey(123)
+    with profiling.profile_session():
+        # warm INSIDE the session: compiles + the one-time cost capture
+        # happen here, not in the timed waterfall run
+        sim.WordErrorRate(shots, key=key)
+        stages = bench._device_stage_times(sim, jax.random.fold_in(key, 97))
+        with profiling.deep_timing(), \
+                profiling.engine_scope("perf_report") as acct:
+            t0 = time.perf_counter()
+            sim.WordErrorRate(shots, key=key)
+            wf = acct.waterfall(time.perf_counter() - t0)
+    total = sum(stages.values()) or 1.0
+    print(f"run: {shots} shots, wall {wf['wall_s']}s, "
+          f"{wf['n_dispatches']} dispatches, {wf['n_syncs']} syncs")
+    print("-- per-batch device stages --")
+    for name, secs in stages.items():
+        print(f"  {name:<18}{secs*1e3:9.2f} ms  ({secs/total:6.1%})")
+    print("-- run decomposition --")
+    for name, secs in wf["stages"].items():
+        print(f"  {name:<18}{secs:9.4f} s")
+    print(f"dispatch_gap_fraction: {wf['dispatch_gap_fraction']}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# calibration: VMEM table summary
+# ---------------------------------------------------------------------------
+def cmd_calibration() -> int:
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    path = profiling.vmem_table_path()
+    table = profiling.vmem_table(refresh=True)
+    entries = table.get("entries", [])
+    print(f"table: {path}")
+    if not entries:
+        print("  (missing or empty — run scripts/vmem_calibrate.py)")
+        return 1
+    print(f"  schema {table.get('schema')}  backend "
+          f"{table.get('backend')}  measured={table.get('measured')}  "
+          f"generated {table.get('generated_at')}")
+    print(f"  ratios: {json.dumps(table.get('ratios', {}))}")
+    print(f"  gates:  {json.dumps(table.get('gates', {}))}")
+    for e in entries:
+        shape = ", ".join(f"{k}={e[k]}" for k in ("rw", "m", "n", "mx", "mz")
+                          if k in e)
+        block = e.get("max_block_b", e.get("max_block_w"))
+        extra = ""
+        if e.get("per_shot_bytes"):
+            extra = (f"  per_shot={e['per_shot_bytes']:,.0f}B "
+                     f"(x{e.get('ratio_vs_analytic', '?')} analytic)")
+        print(f"  {e['kernel']:<16} {e.get('code', '?'):<14} {shape:<28} "
+              f"max_block={block}{extra}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_bp = sub.add_parser("bp")
+    p_bp.add_argument("--batch", type=int, default=8192)
+    p_bo = sub.add_parser("bposd")
+    p_bo.add_argument("--batch", type=int, default=2048)
+    p_c = sub.add_parser("costs")
+    p_c.add_argument("--batch", type=int, default=2048)
+    p_c.add_argument("--batches", type=int, default=8)
+    p_c.add_argument("--small", action="store_true",
+                     help="tiny hgp_rep3 code (CI smoke)")
+    p_w = sub.add_parser("waterfall")
+    p_w.add_argument("--batch", type=int, default=2048)
+    p_w.add_argument("--shots", type=int, default=16384)
+    p_w.add_argument("--small", action="store_true")
+    sub.add_parser("calibration")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "bp":
+        return cmd_bp(args.batch)
+    if args.cmd == "bposd":
+        return cmd_bposd(args.batch)
+    if args.cmd == "costs":
+        return cmd_costs(args.batch, args.batches, args.small)
+    if args.cmd == "waterfall":
+        return cmd_waterfall(args.batch, args.shots, args.small)
+    return cmd_calibration()
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:  # e.g. `... | head` — not an error
+        raise SystemExit(0)

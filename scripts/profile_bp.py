@@ -1,73 +1,20 @@
-"""Component-level timing of the bench pipeline on the live chip.
+"""Thin wrapper: component-level BP pipeline timing moved to
+``scripts/perf_report.py bp`` (the ISSUE-6 performance-attribution CLI).
 
 Usage: python scripts/profile_bp.py [batch]
 """
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from qldpc_fault_tolerance_tpu.codes import load_code
-from qldpc_fault_tolerance_tpu.noise import depolarizing_xz
-from qldpc_fault_tolerance_tpu.ops import bp
-from qldpc_fault_tolerance_tpu.ops.linalg import gf2_matmul
-
-
-def timeit(fn, *args, reps=20, **kw):
-    """Steady-state: launch ``reps`` async dispatches, sync once (the tunneled
-    chip has ~100ms host<->device latency, so per-dispatch blocking times the
-    tunnel, not the compute)."""
-    out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps, out
+from perf_report import cmd_bp  # noqa: E402
 
 
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 8192
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    code = load_code(os.path.join(here, "codes_lib_tpu", "hgp_34_n625.npz"))
-    p = 0.01
-    graph = bp.build_tanner_graph(code.hx)
-    llr0 = bp.llr_from_probs(np.full(code.N, p))
-    hx_t = jnp.asarray(code.hx.T)
-
-    key = jax.random.PRNGKey(0)
-
-    @jax.jit
-    def sample(key):
-        ex, ez = depolarizing_xz(key, (batch, code.N), (p / 3, p / 3, p / 3))
-        return ez, gf2_matmul(ez, hx_t)
-
-    t_sample, (ez, synd) = timeit(sample, key)
-    print(f"sample+syndrome: {t_sample*1e3:.2f} ms  ({batch/t_sample:,.0f}/s)")
-
-    frac = []
-    for hi in (2, 3, 5):
-        r = bp.bp_decode(graph, synd, llr0, max_iter=hi)
-        frac.append((hi, 1 - float(r.converged.mean())))
-    print("unconverged frac after iters:", frac)
-    r50 = bp.bp_decode(graph, synd, llr0, max_iter=50)
-    print("unconverged frac after 50:", 1 - float(r50.converged.mean()))
-
-    for name, fn in [
-        ("bp_decode(50, early_stop)", lambda s: bp.bp_decode(graph, s, llr0, max_iter=50)),
-        ("bp_decode(50, no early)", lambda s: bp.bp_decode(graph, s, llr0, max_iter=50, early_stop=False)),
-        ("bp_decode(3)", lambda s: bp.bp_decode(graph, s, llr0, max_iter=3)),
-        ("two_phase(3,B/16)", lambda s: bp.bp_decode_two_phase(graph, s, llr0, max_iter=50)),
-        ("two_phase(5,B/32)", lambda s: bp.bp_decode_two_phase(graph, s, llr0, max_iter=50, head_iters=5, tail_capacity=batch // 32)),
-    ]:
-        t, _ = timeit(fn, synd)
-        print(f"{name}: {t*1e3:.2f} ms  ({batch/t:,.0f} dec/s)")
+    return cmd_bp(batch)
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

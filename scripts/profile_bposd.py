@@ -1,98 +1,20 @@
-"""Stage-split profiling of the BP+OSD bench mode on the live chip.
-
-Times, at the BENCH_MODES `bposd` operating point (hgp_34_n625, p=0.05,
-BPOSD(osd_e, 10, N/10 iters)):
-
-  * BP alone (converged + posteriors)
-  * device OSD at osd_order=0 (elimination + OSD-0 solve)
-  * device OSD at osd_order=10 (adds the OSD-E scoring scan)
-  * the full BPOSD decode_device path (compaction tiers included)
-
-for OSD batch sizes matching the compaction tiers, so VERDICT r3 #5's
-"profile the split between elimination and OSD-E scoring" has real numbers.
+"""Thin wrapper: stage-split BP+OSD timing moved to
+``scripts/perf_report.py bposd`` (the ISSUE-6 performance-attribution CLI).
 
 Usage: python scripts/profile_bposd.py [batch]
 """
 import os
 import sys
-import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
-from qldpc_fault_tolerance_tpu.codes import load_code
-from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder
-from qldpc_fault_tolerance_tpu.decoders.bp_decoders import decode_device
-from qldpc_fault_tolerance_tpu.ops import bp
-from qldpc_fault_tolerance_tpu.ops.osd_device import (
-    build_osd_plan,
-    osd_decode_values,
-)
-
-
-def timeit(fn, *args, reps=10, **kw):
-    out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    t0 = time.perf_counter()
-    for _ in range(reps):
-        out = fn(*args, **kw)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / reps, out
+from perf_report import cmd_bposd  # noqa: E402
 
 
 def main():
     batch = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    code = load_code(os.path.join(here, "codes_lib_tpu", "hgp_34_n625.npz"))
-    p = 0.05
-    two_thirds = 2 * p / 3
-    mi = int(code.N / 10)
-    dec = BPOSD_Decoder(code.hx, np.full(code.N, two_thirds), max_iter=mi,
-                        osd_method="osd_e", osd_order=10)
-    key = jax.random.PRNGKey(0)
-    err = jax.random.bernoulli(key, two_thirds, (batch, code.N))
-    synd = ((err.astype(jnp.uint8) @ jnp.asarray(code.hx.T)) % 2).astype(
-        jnp.uint8)
-
-    graph = bp.build_tanner_graph(code.hx)
-    llr0 = bp.llr_from_probs(np.full(code.N, two_thirds))
-
-    @jax.jit
-    def bp_only(synd):
-        return bp.bp_decode(graph, synd, llr0, max_iter=mi)
-
-    t_bp, res = timeit(bp_only, synd)
-    conv = np.asarray(res.converged)
-    print(f"batch={batch}  BP({mi} iters): {t_bp * 1e3:.1f} ms  "
-          f"converged={conv.mean():.3f}  n_bad={int((~conv).sum())}")
-
-    plan = build_osd_plan(code.hx, np.full(code.N, two_thirds))
-    llrs = jnp.asarray(res.posterior_llr)
-    for sub in sorted({256, 512, batch}):
-        if sub > batch:
-            continue
-        s_sub, l_sub = synd[:sub], llrs[:sub]
-        for order, label in ((0, "OSD-0 (elim+solve)"),
-                             (10, "OSD-E order 10")):
-            fn = jax.jit(lambda s, l, o=order: osd_decode_values(
-                (plan.n, plan.rank, o, 256,
-                 os.environ.get("QLDPC_OSD_ELIM", "pallas")),
-                plan.packed, plan.cost, s, l))
-            t, _ = timeit(fn, s_sub, l_sub)
-            print(f"  osd batch={sub:5d} {label:18s}: {t * 1e3:7.1f} ms  "
-                  f"({sub / t:8.0f} shots/s)")
-
-    @jax.jit
-    def full(synd):
-        return decode_device(dec.device_static, dec.device_state, synd)
-
-    t_full, _ = timeit(full, synd)
-    print(f"full BPOSD decode_device: {t_full * 1e3:.1f} ms  "
-          f"({batch / t_full:.0f} shots/s)")
+    return cmd_bposd(batch)
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

@@ -43,6 +43,27 @@ def _metric(snap: dict, name: str, field: str = "value", default=0):
     return snap.get(name, {}).get(field, default)
 
 
+def _hist_quantile(m: dict, q: float):
+    """Estimated quantile of a fixed-bucket histogram (linear interpolation
+    within the bucket; the overflow bucket reports its lower edge).  The
+    registry histograms don't keep raw samples, so this is the honest
+    bucket-resolution estimate — exact per-sample percentiles live in
+    utils.observability.timings() for stage timers."""
+    buckets, counts, total = m.get("buckets"), m.get("counts"), m.get("count")
+    if not buckets or not counts or not total:
+        return None
+    target = q * total
+    acc = 0.0
+    lo = 0.0
+    for edge, c in zip(buckets, counts):
+        if acc + c >= target and c:
+            frac = (target - acc) / c
+            return lo + (edge - lo) * frac
+        acc += c
+        lo = edge
+    return buckets[-1]  # overflow: lower edge of the open bucket
+
+
 def summarize(events: list[dict]) -> dict:
     """Aggregate an event stream into one summary dict (the --json output;
     the text table renders from this)."""
@@ -105,7 +126,13 @@ def summarize(events: list[dict]) -> dict:
         "spans": {
             name: {"count": m["count"], "total_s": round(m["sum"], 4),
                    "mean_s": (round(m["sum"] / m["count"], 5)
-                              if m["count"] else None)}
+                              if m["count"] else None),
+                   "p50_s": (round(_hist_quantile(m, 0.50), 5)
+                             if _hist_quantile(m, 0.50) is not None
+                             else None),
+                   "p95_s": (round(_hist_quantile(m, 0.95), 5)
+                             if _hist_quantile(m, 0.95) is not None
+                             else None)}
             for name, m in sorted(spans.items())
         },
         "snapshot": snap,
@@ -169,10 +196,13 @@ def render(summary: dict, title: str = "") -> str:
     if s["spans"]:
         L.append("-- spans --")
         w = max(len(n) for n in s["spans"]) + 2
-        L.append(f"  {'name':<{w}}{'count':>7}{'total_s':>12}{'mean_s':>12}")
+        L.append(f"  {'name':<{w}}{'count':>7}{'total_s':>12}{'mean_s':>12}"
+                 f"{'p50_s':>12}{'p95_s':>12}")
         for name, m in s["spans"].items():
             L.append(f"  {name:<{w}}{m['count']:>7}{m['total_s']:>12}"
-                     f"{m['mean_s']:>12}")
+                     f"{m['mean_s']:>12}"
+                     f"{m.get('p50_s') if m.get('p50_s') is not None else '-':>12}"
+                     f"{m.get('p95_s') if m.get('p95_s') is not None else '-':>12}")
     return "\n".join(L)
 
 

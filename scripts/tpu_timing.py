@@ -1,39 +1,15 @@
-"""Reliable kernel timing through the tunneled TPU.
-
-``jax.block_until_ready`` does not reliably wait for execution through the
-axon tunnel, and a host fetch pays ~110ms round-trip latency.  So: launch
-``r`` chained async dispatches, force completion with a scalar fetch, and take
-the slope between two rep counts — the fixed tunnel latency cancels.
+"""Thin shim: the slope-based tunnel-safe timer moved to
+``qldpc_fault_tolerance_tpu.utils.profiling.per_call_seconds`` (the ISSUE-6
+performance-attribution subsystem).  Import from there; this module stays
+so existing notebooks/scripts keep working.
 """
-import time
+import os
+import sys
 
-import jax
-import jax.numpy as jnp
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from qldpc_fault_tolerance_tpu.utils.profiling import (  # noqa: E402,F401
+    per_call_seconds,
+)
 
 __all__ = ["per_call_seconds"]
-
-
-def _fetch(out):
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    return float(jnp.asarray(leaf).reshape(-1)[0])
-
-
-def _run(fn, args, reps):
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(reps):
-        out = fn(*args)
-    _fetch(out)
-    return time.perf_counter() - t0
-
-
-def per_call_seconds(fn, *args, lo=3, hi=23, trials=3):
-    """Median slope-based per-call wall time of ``fn(*args)``."""
-    _run(fn, args, 1)  # warm / compile
-    slopes = []
-    for _ in range(trials):
-        t_lo = _run(fn, args, lo)
-        t_hi = _run(fn, args, hi)
-        slopes.append((t_hi - t_lo) / (hi - lo))
-    slopes.sort()
-    return slopes[len(slopes) // 2]

@@ -1,0 +1,627 @@
+"""Performance-attribution layer tests (ISSUE 6): cost-model capture
+round-trip, waterfall accounting sums to wall clock, probe-harness
+fallback on compile failure, VMEM calibration table consumption, the
+bench_compare regression gate (synthetic regression + the checked-in
+BENCH history), per-engine heartbeat events, and bit-exact WER with
+profiling on vs off."""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.utils import profiling, telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+
+@pytest.fixture(autouse=True)
+def _clean_profiling():
+    """Every test starts with profiling+telemetry off, empty cost table,
+    and the default calibration table; leaves nothing enabled behind."""
+    profiling.disable()
+    profiling.reset_costs()
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    profiling.disable()
+    profiling.reset_costs()
+    profiling.reset_vmem_table_cache()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _small_code():
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+
+    return hgp(rep_code(3), rep_code(3))
+
+
+def _data_sim(**kw):
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError)
+
+    code = _small_code()
+    p = 0.05
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=10)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=10)
+    return CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=32, seed=0, **kw)
+
+
+# ---------------------------------------------------------------------------
+# cost-model capture
+# ---------------------------------------------------------------------------
+def test_capture_jit_cost_roundtrip():
+    import jax
+    import jax.numpy as jnp
+
+    profiling.enable()
+    telemetry.enable()
+    f = jax.jit(lambda x: (x @ x).sum())
+    x = jnp.ones((64, 64))
+    cost = profiling.capture_jit_cost("unit.matmul", f, x)
+    assert cost is not None
+    assert cost.flops > 0 and cost.bytes_accessed > 0
+    assert cost.peak_bytes >= cost.argument_bytes
+    table = profiling.program_costs()
+    assert "unit.matmul" in table
+    assert table["unit.matmul"]["flops"] == cost.flops
+    # published as telemetry gauges
+    snap = telemetry.snapshot()
+    assert snap["cost.unit.matmul.flops"]["value"] == cost.flops
+    assert snap["cost.unit.matmul.peak_bytes"]["value"] == cost.peak_bytes
+
+
+def test_capture_jit_cost_memoized_and_disabled():
+    import jax
+    import jax.numpy as jnp
+
+    calls = []
+    f = jax.jit(lambda x: x * 2)
+
+    class Probe:
+        def lower(self, *a, **k):
+            calls.append(1)
+            return f.lower(*a, **k)
+
+    x = jnp.ones((8,))
+    # disabled: no capture at all
+    assert profiling.capture_jit_cost("unit.memo", Probe(), x) is None
+    assert not calls
+    profiling.enable()
+    c1 = profiling.capture_jit_cost("unit.memo", Probe(), x)
+    c2 = profiling.capture_jit_cost("unit.memo", Probe(), x)
+    assert c1 is not None and c2 is not None
+    assert len(calls) == 1  # second call hit the (label, avals) memo
+
+
+def test_derive_utilization_consistency():
+    cost = {"flops": 1e6, "bytes_accessed": 2e6, "peak_bytes": 123}
+    peaks = {"flops_per_s": 1e12, "hbm_bytes_per_s": 1e11}
+    util = profiling.derive_utilization(cost, 100, 1000.0, peaks=peaks)
+    assert util["flops_per_shot"] == pytest.approx(1e4)
+    assert util["bytes_per_shot"] == pytest.approx(2e4)
+    # rate * per-shot / peak
+    assert util["mfu"] == pytest.approx(1000 * 1e4 / 1e12)
+    assert util["hbm_util"] == pytest.approx(1000 * 2e4 / 1e11)
+    assert profiling.derive_utilization({}, 100, 1000.0) == {}
+
+
+def test_cost_capture_in_real_run():
+    """The megabatch driver auto-captures its program cost when profiling
+    is enabled."""
+    import jax
+
+    sim = _data_sim()
+    profiling.enable()
+    sim.WordErrorRate(64, key=jax.random.PRNGKey(0))
+    costs = profiling.program_costs()
+    assert any(k.startswith("megabatch.") for k in costs), costs
+    c = next(v for k, v in costs.items() if k.startswith("megabatch."))
+    assert c["flops"] > 0
+
+
+def test_cost_capture_fused_sweep():
+    """The fused-cell driver (sweep/fused.py buckets) captures its program
+    cost under its own label."""
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.sweep import CodeFamily
+
+    profiling.enable()
+    CodeFamily(
+        [hgp(rep_code(3), rep_code(3), name="r3")],
+        decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        decoder2_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
+        batch_size=32, seed=1,
+    ).EvalWER("data", "Total", [0.02, 0.05], num_samples=32,
+              if_plot=False, fused=True)
+    costs = profiling.program_costs()
+    assert any(k.startswith("fused_cells.") for k in costs), costs
+
+
+# ---------------------------------------------------------------------------
+# waterfall accounting
+# ---------------------------------------------------------------------------
+def test_engine_scope_accounting_sums():
+    profiling.enable()
+    with profiling.engine_scope("unit") as acct:
+        assert acct is not None
+        profiling.record_dispatch(0.25)
+        profiling.record_dispatch(0.05)
+        profiling.record_host_sync(0.2)
+        wf = acct.waterfall(wall_s=1.0)
+    stages = wf["stages"]
+    assert stages["dispatch_launch_s"] == pytest.approx(0.30)
+    assert stages["host_sync_s"] == pytest.approx(0.2)
+    assert stages["host_gap_s"] == pytest.approx(0.5)
+    assert wf["dispatch_gap_fraction"] == pytest.approx(0.5)
+    assert wf["n_dispatches"] == 2 and wf["n_syncs"] == 1
+    # stages decompose the wall exactly (passive mode: launch+sync+gap)
+    assert sum(stages.values()) == pytest.approx(1.0)
+    # no active scope -> records are dropped, heartbeat is None
+    profiling.record_dispatch(99.0)
+    assert profiling.run_heartbeat() is None
+
+
+def test_engine_scope_inactive_when_disabled():
+    with profiling.engine_scope("unit") as acct:
+        assert acct is None
+    # with only telemetry on, the scope still activates (heartbeats need it)
+    telemetry.enable()
+    with profiling.engine_scope("unit") as acct:
+        assert acct is not None
+
+
+def test_deep_timed_run_waterfall_sums_to_wall():
+    """A deep-timed real run: device + sync + gap must reproduce the
+    measured wall clock (the run decomposition is exact by construction,
+    and device_s must dominate a compute-bound CPU run)."""
+    import time
+
+    import jax
+
+    sim = _data_sim()
+    key = jax.random.PRNGKey(1)
+    sim.WordErrorRate(64, key=key)  # warm
+    profiling.enable()
+    sim.WordErrorRate(64, key=key)  # cost capture outside the timed run
+    with profiling.deep_timing(), profiling.engine_scope("unit") as acct:
+        t0 = time.perf_counter()
+        sim.WordErrorRate(64, key=key)
+        wf = acct.waterfall(time.perf_counter() - t0)
+    st = wf["stages"]
+    assert wf["deep_timed"] and "device_s" in st
+    assert st["device_s"] > 0
+    # stage values round to 6 decimals independently, so allow a few
+    # ulp-of-rounding of absolute slop
+    assert (st["device_s"] + st["host_sync_s"] + st["host_gap_s"]
+            == pytest.approx(wf["wall_s"], abs=5e-6))
+    assert 0 <= wf["dispatch_gap_fraction"] <= 1
+
+
+def test_heartbeat_event_every_engine():
+    """Tier-1 guard (ISSUE 6 satellite): every engine's WordErrorRate
+    emits a heartbeat event with the waterfall stage decomposition when
+    telemetry is enabled."""
+    import jax
+
+    from qldpc_fault_tolerance_tpu.decoders import (
+        BPDecoder,
+        ST_BP_Decoder_Circuit,
+        ST_BP_Decoder_syndrome,
+    )
+    from qldpc_fault_tolerance_tpu.sim import (
+        CodeSimulator_Circuit,
+        CodeSimulator_Circuit_SpaceTime,
+    )
+    from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+    from qldpc_fault_tolerance_tpu.sim.phenom_spacetime import (
+        CodeSimulator_Phenon_SpaceTime,
+    )
+
+    code = _small_code()
+    p = 0.03
+    m = code.hx.shape[0]
+    ep = {"p_i": 0, "p_state_p": 0, "p_m": 0, "p_CX": 0.004,
+          "p_idling_gate": 0}
+
+    def run_data():
+        _data_sim().WordErrorRate(64, key=jax.random.PRNGKey(0))
+
+    def run_phenom():
+        ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+        extz = np.hstack([code.hz, np.eye(code.hz.shape[0],
+                                          dtype=np.uint8)])
+        sim = CodeSimulator_Phenon(
+            code=code,
+            decoder1_x=BPDecoder(extz, np.full(extz.shape[1], p),
+                                 max_iter=6),
+            decoder1_z=BPDecoder(ext, np.full(ext.shape[1], p), max_iter=6),
+            decoder2_x=BPDecoder(code.hz, np.full(code.N, p), max_iter=6),
+            decoder2_z=BPDecoder(code.hx, np.full(code.N, p), max_iter=6),
+            pauli_error_probs=[p / 3] * 3, q=p, batch_size=32, seed=0)
+        sim.WordErrorRate(num_rounds=2, num_samples=32)
+
+    def run_circuit():
+        hx_ext = np.hstack([code.hx, np.eye(m, dtype=np.uint8)])
+        sim = CodeSimulator_Circuit(
+            code=code,
+            decoder1_z=BPDecoder(hx_ext, np.full(hx_ext.shape[1], p),
+                                 max_iter=6),
+            decoder2_z=BPDecoder(code.hx, np.full(code.N, p), max_iter=6),
+            p=0.004, num_cycles=2, error_params=ep, batch_size=32, seed=7)
+        sim.WordErrorRate(32, key=jax.random.PRNGKey(2))
+
+    def run_circuit_st():
+        sim = CodeSimulator_Circuit_SpaceTime(
+            code=code, p=0.004, num_cycles=5, num_rep=2, error_params=ep,
+            batch_size=32, seed=0)
+        sim._generate_circuit()
+        sim._generate_circuit_graph()
+        g = sim.circuit_graph
+        sim.decoder1_z = ST_BP_Decoder_Circuit(g["h1"], g["channel_ps1"],
+                                               max_iter=6)
+        sim.decoder2_z = ST_BP_Decoder_Circuit(g["h2"], g["channel_ps2"],
+                                               max_iter=6)
+        sim.WordErrorRate(32, key=jax.random.PRNGKey(3))
+
+    def run_phenom_st():
+        sim = CodeSimulator_Phenon_SpaceTime(
+            code=code,
+            decoder1_x=ST_BP_Decoder_syndrome(code.hz, p_data=p, p_synd=p,
+                                              max_iter=6, num_rep=2),
+            decoder1_z=ST_BP_Decoder_syndrome(code.hx, p_data=p, p_synd=p,
+                                              max_iter=6, num_rep=2),
+            decoder2_x=BPDecoder(code.hz, np.full(code.N, p), max_iter=6),
+            decoder2_z=BPDecoder(code.hx, np.full(code.N, p), max_iter=6),
+            pauli_error_probs=[p / 3] * 3, q=p, num_rep=2, batch_size=32,
+            seed=0)
+        sim.WordErrorRate(2, 32, key=jax.random.PRNGKey(4))
+
+    engines = {
+        "data": run_data,
+        "phenl": run_phenom,
+        "circuit": run_circuit,
+        "circuit_st": run_circuit_st,
+        "phenl_st": run_phenom_st,
+    }
+    for engine, run in engines.items():
+        telemetry.disable()
+        telemetry.reset()
+        sink = telemetry.MemorySink()
+        telemetry.enable()
+        telemetry.add_sink(sink)
+        try:
+            run()
+        finally:
+            telemetry.remove_sink(sink)
+            telemetry.disable()
+        hbs = [r for r in sink.records
+               if r["kind"] == "heartbeat" and r["engine"] == engine]
+        assert hbs, f"engine {engine} emitted no heartbeat event"
+        wf = hbs[-1].get("waterfall")
+        assert wf and "stages" in wf and \
+            wf.get("dispatch_gap_fraction") is not None, (engine, hbs[-1])
+
+
+def test_wer_bitexact_profiling_on_vs_off():
+    import jax
+
+    sim = _data_sim()
+    key = jax.random.PRNGKey(5)
+    wer_off = sim.WordErrorRate(128, key=key)
+    profiling.enable()
+    with profiling.deep_timing():
+        wer_on = sim.WordErrorRate(128, key=key)
+    assert wer_on == wer_off
+
+
+# ---------------------------------------------------------------------------
+# VMEM probe harness + calibration table
+# ---------------------------------------------------------------------------
+def test_probe_max_block_picks_largest_working():
+    def try_compile(b):
+        if b > 128:
+            raise RuntimeError("scoped vmem oom")
+        return True
+
+    best, attempts = profiling.probe_max_block(try_compile,
+                                               (512, 256, 128, 64))
+    assert best == 128
+    # stops at the first success; failures recorded with their error
+    assert [a[0] for a in attempts] == [512, 256, 128]
+    assert attempts[0][1] is False and "oom" in attempts[0][2]
+    assert attempts[-1][1] is True and attempts[-1][2] is None
+
+
+def test_probe_max_block_fallback_when_nothing_compiles():
+    def try_compile(b):
+        raise RuntimeError("mosaic panic")
+
+    best, attempts = profiling.probe_max_block(try_compile, (64, 32, 8))
+    assert best == 0
+    assert len(attempts) == 3 and not any(ok for _, ok, _ in attempts)
+
+
+def test_vmem_table_lookup_and_fallbacks(tmp_path, monkeypatch):
+    table = {
+        "schema": 1,
+        "ratios": {"bp_head": 1.83},
+        "gates": {"bp_head_scat_limit_bytes": 12 * 1024 * 1024},
+        "entries": [
+            {"kernel": "bp_head", "rw": 6, "m": 100, "n": 400,
+             "measured": True, "per_shot_bytes": 55555.0},
+            {"kernel": "bp_head", "rw": 6, "m": 100, "n": 500,
+             "measured": False, "per_shot_bytes": 77777.0},
+        ],
+    }
+    path = tmp_path / "vmem_table.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setenv("QLDPC_VMEM_TABLE", str(path))
+    profiling.reset_vmem_table_cache()
+    # measured entry overrides the analytic default
+    assert profiling.calibrated_per_shot_bytes(
+        "bp_head", {"rw": 6, "m": 100, "n": 400}, 111.0) == 55555.0
+    # unmeasured entries never override
+    assert profiling.calibrated_per_shot_bytes(
+        "bp_head", {"rw": 6, "m": 100, "n": 500}, 111.0) == 111.0
+    # missing shape -> default; missing kernel ratio -> default
+    assert profiling.calibrated_per_shot_bytes(
+        "bp_head", {"rw": 1, "m": 2, "n": 3}, 42.0) == 42.0
+    assert profiling.calibration_ratio("bp_head", 2.0) == 1.83
+    assert profiling.calibration_ratio("nope", 2.0) == 2.0
+    # corrupt table -> empty, everything falls back
+    path.write_text("{not json")
+    profiling.reset_vmem_table_cache()
+    assert profiling.vmem_table() == {"entries": []}
+    assert profiling.calibration_ratio("bp_head", 2.0) == 2.0
+
+
+def test_bp_pallas_consumes_calibration(tmp_path, monkeypatch):
+    """A measured calibration entry changes the head kernel's tile choice;
+    a calibrated gate limit changes fits_vmem."""
+    from qldpc_fault_tolerance_tpu.ops import bp, bp_pallas
+
+    code = _small_code()
+    graph = bp.build_tanner_graph_host(code.hx)
+    pg = bp_pallas.build_pallas_head(graph)
+    base_block = pg.max_block_b(4096)
+    assert base_block > 0
+    # a huge measured per-shot cost forces the tile to 0 (XLA fallback)
+    table = {
+        "schema": 1,
+        "gates": {"bp_head_scat_limit_bytes": 1},
+        "entries": [{
+            "kernel": "bp_head", "rw": pg.rw, "m": pg.m, "n": pg.n,
+            "measured": True, "per_shot_bytes": 1e9,
+        }],
+    }
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps(table))
+    monkeypatch.setenv("QLDPC_VMEM_TABLE", str(path))
+    profiling.reset_vmem_table_cache()
+    assert pg.per_shot_bytes() == 1e9
+    assert pg.max_block_b(4096) == 0
+    assert not pg.fits_vmem()  # 1-byte calibrated gate
+    monkeypatch.delenv("QLDPC_VMEM_TABLE")
+    profiling.reset_vmem_table_cache()
+    assert pg.max_block_b(4096) == base_block
+
+
+def test_gf2_vmem_gate(monkeypatch):
+    """The calibrated VMEM gate routes infeasible shapes to the XLA twin
+    instead of attempting a doomed mosaic compile."""
+    from qldpc_fault_tolerance_tpu.ops import gf2_pallas
+
+    code = _small_code()
+    spec = gf2_pallas.build_fused_spec(code.hx, code.hz, code.lx, code.lz,
+                                       (0.003,) * 3)
+    # estimate grows monotonically with block_w and is feasible for the
+    # small code at the default block
+    e1 = gf2_pallas.estimate_vmem_bytes(
+        code.N, code.hx.shape[0], code.hz.shape[0], 8)
+    e2 = gf2_pallas.estimate_vmem_bytes(
+        code.N, code.hx.shape[0], code.hz.shape[0], 16)
+    assert 0 < e1 < e2
+    assert gf2_pallas.vmem_feasible(spec, 8)
+    # an infeasible estimate (shrunken cap) gates the pallas path off even
+    # when backend/divisibility would allow it
+    monkeypatch.setattr(gf2_pallas, "_KERNEL_VMEM_LIMIT", 1)
+    assert not gf2_pallas.vmem_feasible(spec, 8)
+    assert not gf2_pallas._use_pallas(4096, "auto", spec, 8)
+    # explicit backend="pallas" stays an override (probe harnesses)
+    assert gf2_pallas._use_pallas(4096, "pallas", spec, 8)
+
+
+def test_checked_in_calibration_table_is_consistent():
+    """The repo ships a generated table: schema 1, every entry carries its
+    kernel + probe provenance, and CPU-generated entries never carry the
+    consumed ``per_shot_bytes`` key (only TPU probes are evidence)."""
+    path = os.path.join(REPO, "calibration", "vmem_table.json")
+    assert os.path.exists(path), "calibration/vmem_table.json not checked in"
+    with open(path) as fh:
+        table = json.load(fh)
+    assert table["schema"] == 1
+    assert table["generated_by"] == "scripts/vmem_calibrate.py"
+    assert table["entries"], "table has no entries"
+    for e in table["entries"]:
+        assert e["kernel"] in ("bp_head", "gf2_sample_synd", "gf2_residual")
+        assert "measured" in e and "attempts" in e
+        if not e["measured"]:
+            assert "per_shot_bytes" not in e
+    # the big-code shapes the ROADMAP Open item 2 targets are probed
+    probed_n = {e.get("n") for e in table["entries"]}
+    assert {1225, 1600} <= probed_n
+
+
+# ---------------------------------------------------------------------------
+# bench_compare regression gate
+# ---------------------------------------------------------------------------
+def _write_round(tmp_path, n, value, schema=1, unit="shots/s", extra=None):
+    if schema == 1:
+        obj = {"n": n, "cmd": "bench", "rc": 0,
+               "parsed": {"metric": "m", "value": value, "unit": unit,
+                          **(extra or {})}}
+    else:
+        obj = {"schema": 2, "round": n,
+               "result": {"metric": "m", "value": value, "unit": unit,
+                          **(extra or {})}}
+    p = tmp_path / f"BENCH_r{n:02d}.json"
+    p.write_text(json.dumps(obj))
+    return str(p)
+
+
+def test_bench_compare_gate_fires_on_synthetic_regression(tmp_path):
+    import bench_compare
+
+    paths = [
+        _write_round(tmp_path, 1, 1000.0),
+        _write_round(tmp_path, 2, 1100.0, schema=2),   # mixed schemas OK
+        _write_round(tmp_path, 3, 700.0),              # -36%: regression
+    ]
+    assert bench_compare.main(paths + ["--tolerance", "10"]) == 0  # no gate
+    assert bench_compare.main(paths + ["--gate", "--tolerance", "10"]) == 1
+    # improvements and in-band noise pass
+    ok = [
+        _write_round(tmp_path, 4, 1000.0),
+        _write_round(tmp_path, 5, 980.0),
+        _write_round(tmp_path, 6, 2000.0, schema=2),
+    ]
+    assert bench_compare.main(ok + ["--gate", "--tolerance", "10"]) == 0
+
+
+def test_bench_compare_gates_stage_fields_and_wallclock(tmp_path):
+    import bench_compare
+
+    # stage-rate field regression fires even when the headline holds
+    paths = [
+        _write_round(tmp_path, 1, 1000.0,
+                     extra={"sample_synd_shots_per_s": {"packed": 500.0}}),
+        _write_round(tmp_path, 2, 1000.0,
+                     extra={"sample_synd_shots_per_s": {"packed": 300.0}}),
+    ]
+    assert bench_compare.main(paths + ["--gate"]) == 1
+    # wall-clock metrics regress UP
+    wall = [
+        _write_round(tmp_path, 3, 100.0, unit="s"),
+        _write_round(tmp_path, 4, 150.0, unit="s"),
+    ]
+    assert bench_compare.main(wall + ["--gate"]) == 1
+    wall_ok = [
+        _write_round(tmp_path, 5, 100.0, unit="s"),
+        _write_round(tmp_path, 6, 95.0, unit="s"),
+    ]
+    assert bench_compare.main(wall_ok + ["--gate"]) == 0
+    # the rendered labels must AGREE with the gate for wall-clock rounds:
+    # a speedup (time down) renders improved, a slowdown REGRESSED
+    fast = bench_compare.compare(bench_compare.load_history([
+        _write_round(tmp_path, 7, 100.0, unit="s"),
+        _write_round(tmp_path, 8, 70.0, unit="s")]), 10.0)
+    assert "REGRESSED" not in bench_compare.render(fast)
+    assert not fast["violations"]
+    slow = bench_compare.compare(bench_compare.load_history([
+        _write_round(tmp_path, 9, 100.0, unit="s"),
+        _write_round(tmp_path, 10, 130.0, unit="s")]), 10.0)
+    assert "REGRESSED" in bench_compare.render(slow)
+    assert slow["violations"]
+
+
+def test_bench_compare_gate_passes_checked_in_history(capsys):
+    """Tier-1 guard (ISSUE 6 acceptance): the r01..r05 history gates
+    clean — r01->r02 is a 10x improvement, r02..r05 sit within the band."""
+    import bench_compare
+
+    paths = sorted(
+        os.path.join(REPO, f)
+        for f in os.listdir(REPO)
+        if f.startswith("BENCH_r") and f.endswith(".json"))
+    assert len(paths) >= 5
+    assert bench_compare.main(paths + ["--gate"]) == 0
+    out = capsys.readouterr().out
+    assert "PASS" in out and "r01" in out
+
+
+def test_bench_compare_normalize_rejects_junk():
+    import bench_compare
+
+    assert bench_compare.normalize_round({"foo": 1}) is None
+    assert bench_compare.normalize_round({"parsed": {"metric": "m"}}) is None
+    rec = bench_compare.normalize_round(
+        {"metric": "m", "value": 1.0, "unit": "shots/s"}, fallback_round=7)
+    assert rec["round"] == 7 and rec["schema"] == 0
+
+
+# ---------------------------------------------------------------------------
+# percentiles (observability + telemetry_report spans)
+# ---------------------------------------------------------------------------
+def test_timings_percentiles():
+    from qldpc_fault_tolerance_tpu.utils.observability import (
+        _TIMINGS,
+        _TIMINGS_LOCK,
+        reset_timings,
+        timings,
+    )
+
+    reset_timings()
+    with _TIMINGS_LOCK:
+        _TIMINGS["stage"] = [0.01] * 90 + [0.5] * 9 + [1.0]
+    t = timings()["stage"]
+    assert t["count"] == 100
+    assert t["p50_s"] == pytest.approx(0.01)
+    assert 0.01 < t["p95_s"] <= 0.5
+    assert t["max_s"] == pytest.approx(1.0)
+    assert t["p50_s"] <= t["p95_s"] <= t["max_s"]
+    reset_timings()
+
+
+def test_telemetry_report_span_percentiles(tmp_path):
+    import telemetry_report
+
+    telemetry.enable()
+    for v in (0.001, 0.002, 0.003, 0.5):
+        telemetry.registry().histogram("span.unit.seconds").observe(v)
+    snap = telemetry.snapshot()
+    events = [{"ts": 0.0, "kind": "snapshot", "metrics": snap,
+               "compile": {}}]
+    summary = telemetry_report.summarize(events)
+    span = summary["spans"]["unit"]
+    assert span["p50_s"] is not None and span["p95_s"] is not None
+    assert span["p50_s"] <= span["p95_s"]
+    assert "p50_s" in telemetry_report.render(summary)
+
+
+# ---------------------------------------------------------------------------
+# trace parser (synthetic chrome trace)
+# ---------------------------------------------------------------------------
+def test_parse_trace_synthetic(tmp_path):
+    trace = {
+        "traceEvents": [
+            {"ph": "M", "name": "process_name", "pid": 1,
+             "args": {"name": "/device:TPU:0"}},
+            {"ph": "M", "name": "process_name", "pid": 2,
+             "args": {"name": "python"}},
+            {"ph": "X", "name": "fusion.1", "pid": 1, "dur": 2000},
+            {"ph": "X", "name": "fusion.1", "pid": 1, "dur": 1000},
+            {"ph": "X", "name": "host_compute", "pid": 2, "dur": 500},
+            {"ph": "B", "name": "ignored", "pid": 2},
+        ],
+    }
+    d = tmp_path / "plugins"
+    d.mkdir()
+    (d / "run.trace.json").write_text(json.dumps(trace))
+    out = profiling.parse_trace(str(tmp_path))
+    assert out["files"] == 1
+    assert out["device_s"] == pytest.approx(0.003)
+    assert out["host_s"] == pytest.approx(0.0005)
+    assert out["events"]["fusion.1"] == pytest.approx(0.003)
+    # empty dir -> empty summary, no crash
+    empty = profiling.parse_trace(str(tmp_path / "nope"))
+    assert empty["files"] == 0 and empty["events"] == {}
