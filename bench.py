@@ -581,6 +581,65 @@ def mode_bp():
     else:
         res_block = {"skipped": "BENCH_RES=0"}
 
+    # diagnostics A/B arm — the <2% overhead acceptance gate of ISSUE 7's
+    # statistical-observability layer.  Diagnostics ride the telemetry
+    # event stream, so BOTH arms run telemetry-enabled (whose own overhead
+    # the telemetry block already gates); the toggled part is the
+    # uncertainty enrichment itself (Wilson intervals on wer_run/heartbeat
+    # events + cell-scope capture, forced off via diagnostics.disable()).
+    # Same order-alternating min-of-4 protocol as the resilience/profiling
+    # arms (BASELINE.md: sequential A/B showed ±30% phantom deltas on a
+    # shared CPU).  BENCH_DIAG=0 skips the arm.
+    from qldpc_fault_tolerance_tpu.utils import diagnostics as _diag
+
+    if os.environ.get("BENCH_DIAG", "1") != "0":
+        times_doff, times_don, wer_diag = [], [], None
+        try:
+            with _no_env_jsonl():
+                telemetry.reset()
+                telemetry.enable()
+                # warm: the telemetry-enabled program variant is already
+                # compiled by the telemetry arm; one rep settles caches
+                sim.WordErrorRate(shots, key=jax.random.fold_in(key, 0))
+
+                def _rep_diag(arm_on: bool):
+                    nonlocal wer_diag
+                    if arm_on:
+                        _diag.enable()
+                    else:
+                        _diag.disable()
+                    try:
+                        t0 = time.perf_counter()
+                        wer = sim.WordErrorRate(
+                            shots, key=jax.random.fold_in(key, 1))
+                        dt = time.perf_counter() - t0
+                    finally:
+                        _diag.auto()
+                    (times_don if arm_on else times_doff).append(dt)
+                    if arm_on:
+                        wer_diag = wer
+
+                for rep in range(4):
+                    first, second = ((False, True) if rep % 2 == 0
+                                     else (True, False))
+                    _rep_diag(first)
+                    _rep_diag(second)
+        finally:
+            _diag.auto()
+            telemetry.disable()
+        rate_doff = shots / min(times_doff)
+        rate_don = shots / min(times_don)
+        diag_block = {
+            "enabled_shots_per_s": round(rate_don, 1),
+            "disabled_shots_per_s": round(rate_doff, 1),
+            "overhead_pct": round(
+                (rate_doff - rate_don) / rate_doff * 100, 2),
+            "wer_bitexact_vs_disabled": bool(
+                wer_diag[0] == wer_main[0] and wer_diag[1] == wer_main[1]),
+        }
+    else:
+        diag_block = {"skipped": "BENCH_DIAG=0"}
+
     out_ab = {}
     if run_ab:
         # dense-uint8 A/B arm: same shapes, same key, same median-of-3
@@ -642,6 +701,7 @@ def mode_bp():
         "hbm_gbps": cost_block.get("hbm_gbps"),
         "telemetry": tele_block,
         "resilience": res_block,
+        "diagnostics": diag_block,
         **prof_blocks,
         **out_ab,
         **_bp_utilization(dec_x, dec_z, code, p, rate,

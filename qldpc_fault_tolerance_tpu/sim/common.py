@@ -421,6 +421,12 @@ class FusedCellProgram:
     # the key bytes to host — only resume paths pay that)
     signature_fn: object = None
     _signature: dict = dataclasses.field(default=None, repr=False)
+    # per-cell identity for the statistical-observability layer: the
+    # builders' p tags, and (when the sweep planner runs the bucket) the
+    # full checkpoint cell-key dicts — utils.diagnostics publishes per-cell
+    # interval gauges / cell_progress events under these names
+    cell_tags: tuple = None
+    cell_keys: list = None
 
     @property
     def signature(self) -> dict:
@@ -506,6 +512,19 @@ def _fused_host(carry):
     return host[0], host[1], host[2], (host[3] if len(host) > 3 else None)
 
 
+def _fused_cell_progress(prog: FusedCellProgram, failures, shots) -> None:
+    """Publish the bucket's per-cell intervals (gauges + one cell_progress
+    event) from counts ALREADY fetched at an existing sync — the
+    statistical-observability hook of the fused drivers (utils.diagnostics;
+    zero extra device round-trips, one boolean when diagnostics are off)."""
+    from ..utils import diagnostics
+
+    if not diagnostics.active():
+        return
+    cells = prog.cell_keys if prog.cell_keys is not None else prog.cell_tags
+    diagnostics.publish_cell_progress(prog.engine, cells, failures, shots)
+
+
 def fused_cell_launch(prog: FusedCellProgram, *, start: int = 0,
                       carry0=None):
     """Enqueue a whole fixed-budget fused bucket asynchronously (no host
@@ -574,6 +593,8 @@ def fused_cell_stream(prog: FusedCellProgram, *, progress, tele_on: bool):
             progress.save_cells(prog.signature, batches_done=done,
                                 failures=failures, shots=shots,
                                 min_w=min_w, tele=tele)
+        # live per-cell intervals at the drain the stream already pays
+        _fused_cell_progress(prog, failures, shots)
         last = (failures, shots, min_w, tele)
     failures, shots, min_w, tele = last
     if tele is not None:
@@ -632,6 +653,10 @@ def fused_cell_adaptive(prog: FusedCellProgram, *, target_failures: int,
             progress.save_cells(signature, batches_done=0,
                                 failures=failures, shots=shots,
                                 min_w=min_w, cursors=cursors, tele=tele)
+        # the adaptive sync already holds the WHOLE grid's counts: publish
+        # per-cell ci_low/ci_high/rse gauges + a cell_progress event here,
+        # at zero extra syncs (utils.diagnostics)
+        _fused_cell_progress(prog, failures, shots)
         undecided = [c for c in range(C)
                      if failures[c] < target_failures
                      and cursors[c] < n_run]
@@ -667,18 +692,33 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
     one ``heartbeat`` event carrying the run's device-time waterfall
     (utils.profiling.engine_scope stage decomposition — every engine run
     under resilient_engine_run has one; paths without an active scope emit
-    the heartbeat without stages)."""
-    from ..utils import profiling, telemetry
+    the heartbeat without stages).
+
+    With utils.diagnostics active, the wer_run event additionally carries
+    the run's uncertainty block (Wilson interval / relative CI width / rse
+    on the failure rate), the heartbeat its rse, and the counts are
+    reported to the enclosing sweep cell scope — all host arithmetic on
+    the two ints already fetched; the estimate itself is untouched.
+    Returns the uncertainty block ({} when diagnostics are off) so cell
+    recorders can reuse it instead of recomputing."""
+    from ..utils import diagnostics, profiling, telemetry
 
     fields = {"engine": engine, "shots": int(shots),
               "failures": int(failures), "wer": float(wer)}
     if dispatches is not None:
         fields["dispatches"] = int(dispatches)
+    ci = {}
+    if diagnostics.active():
+        ci = diagnostics.ci_fields(failures, shots)
+        fields.update(ci)
+        diagnostics.note_run(failures, shots)
     telemetry.count("sim.shots", int(shots))
     telemetry.count("sim.failures", int(failures))
     telemetry.count("sim.runs")
     telemetry.event("wer_run", **fields)
     hb = {"engine": engine, "shots": int(shots)}
+    if ci:
+        hb["rse"] = ci["rse"]
     wf = profiling.run_heartbeat()
     if wf is not None:
         hb["waterfall"] = wf
@@ -686,6 +726,7 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
         if gap is not None:
             telemetry.set_gauge("profile.dispatch_gap_fraction", gap)
     telemetry.event("heartbeat", **hb)
+    return ci
 
 
 def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,

@@ -440,7 +440,7 @@ def fused_cells_program_states(rep, cell_states, ltype_codes, cell_tags,
         n_cells=len(ltypes), engine="data",
         wer_fn=lambda failures, shots: wer_single_shot(
             int(failures), int(shots), K),
-        signature_fn=signature_fn)
+        signature_fn=signature_fn, cell_tags=tuple(cell_tags))
 
 
 def fused_cells_program(sims, num_samples: int, mesh=None):

@@ -444,7 +444,7 @@ def fused_cells_program_states(rep, cell_states, ltype_codes, cell_tags,
         n_cells=len(ltypes), engine="phenl",
         wer_fn=lambda failures, shots: wer_per_cycle(
             int(failures), int(shots), K, num_rounds),
-        signature_fn=signature_fn)
+        signature_fn=signature_fn, cell_tags=tuple(cell_tags))
 
 
 def fused_cells_program(sims, num_samples: int, num_rounds: int, mesh=None):

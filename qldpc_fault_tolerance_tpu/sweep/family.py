@@ -218,7 +218,7 @@ class CodeFamily:
                 circuit_error_params=None, if_plot=True, checkpoint=None,
                 shard_across_processes: bool = False,
                 progress_every: int = 1, fused: bool | str = "auto",
-                target_failures=None):
+                target_failures=None, ledger=None):
         """(len(code_list), len(eval_p_list)) WER array
         (src/Simulators.py:752-908).
 
@@ -254,6 +254,19 @@ class CodeFamily:
         over DCN at the end (parallel/grid.py).  Sharded grids keep the
         serial per-cell loop (cell-granular ownership doesn't line up with
         per-code fused buckets).
+        ``ledger``: statistical-observability run ledger
+        (utils.diagnostics.RunLedger): True = the default ``ledger/`` dir,
+        a path = that dir/.jsonl file, None = the ``QLDPC_LEDGER_DIR`` env
+        var (unset: no ledger).  With a ledger (or telemetry enabled) the
+        grid runs under a diagnostics sweep run: every cell event carries
+        its Wilson interval, the anomaly monitors watch the grid
+        (monotonicity and ladder checks work ledger-only; the BP-statistics
+        detectors — stalled convergence, iteration drift — read the
+        telemetry registry and need telemetry enabled too), and one
+        JSONL ledger record (run id, config fingerprint, per-cell counts +
+        CIs, fit reports, anomalies) is appended at the end —
+        ``scripts/sweep_dashboard.py`` renders it.  Host-side bookkeeping
+        only: WER is bit-exact with diagnostics on vs off.
         """
         assert noise_model in ["data", "phenl", "circuit"], (
             "noise_model should be one of [data, phenl, circuit]"
@@ -262,7 +275,7 @@ class CodeFamily:
             "eval_type should be one of [X, Y, Total]"
         )
         from ..parallel.grid import merge_cell_results, process_cell_owner
-        from ..utils import resilience, telemetry
+        from ..utils import diagnostics, resilience, telemetry
         from ..utils.checkpoint import CellProgress
         from ..utils.observability import get_logger, log_record, stage_timer
 
@@ -302,84 +315,117 @@ class CodeFamily:
                 "samples": int(num_samples),
             }
 
-        results: dict[int, float] = {}
-        serial_cells = [c for c, mine in zip(cells, owned) if mine]
-        # multi-host grids split ownership at CELL granularity and end in a
-        # DCN allgather; the fused bucket programs are per-process device
-        # programs that don't line up with that collective, so sharded
-        # grids keep the serial per-cell loop
-        if (fused is not False and noise_model in ("data", "phenl")
-                and not shard_across_processes):
-            from .fused import eval_cells_fused
+        # the grid's identity for the run ledger / drift compares: the
+        # physics configuration, not execution knobs (fused/serial,
+        # checkpointing and sharding must not change the fingerprint)
+        grid_cfg = {
+            "driver": "CodeFamily.EvalWER", "noise": noise_model,
+            "type": eval_logical_type,
+            "codes": [code.name or f"code{ci}_N{code.N}K{code.K}"
+                      for ci, code in enumerate(self.code_list)],
+            "p_list": [float(p) for p in eval_p_list],
+            "cycles": int(num_cycles), "samples": int(num_samples),
+            "batch": int(self.batch_size), "seed": int(self.seed),
+        }
+        with diagnostics.sweep_run(grid_cfg, ledger=ledger):
+            results: dict[int, float] = {}
+            serial_cells = [c for c, mine in zip(cells, owned) if mine]
+            # multi-host grids split ownership at CELL granularity and end
+            # in a DCN allgather; the fused bucket programs are per-process
+            # device programs that don't line up with that collective, so
+            # sharded grids keep the serial per-cell loop
+            if (fused is not False and noise_model in ("data", "phenl")
+                    and not shard_across_processes):
+                from .fused import eval_cells_fused
 
-            if noise_model == "data":
-                bucket_builder = lambda bucket: (  # noqa: E731
-                    self._data_bucket_program(bucket, eval_logical_type,
-                                              num_samples))
-            else:
-                bucket_builder = lambda bucket: (  # noqa: E731
-                    self._phenl_bucket_program(bucket, eval_logical_type,
-                                               num_samples, num_cycles))
-            results, serial_cells = eval_cells_fused(
-                serial_cells, bucket_builder, cell_key_fn,
-                checkpoint=checkpoint, progress_every=progress_every,
-                target_failures=target_failures)
-        if target_failures is not None and serial_cells \
-                and noise_model == "circuit":
-            raise ValueError(
-                "target_failures is not supported for the circuit noise "
-                "model (its engine has no megabatch early stop)")
-
-        for i, ci, code, eval_p in serial_cells:
-            cell_key = cell_key_fn(i, ci, code, eval_p)
-            if checkpoint is not None and (rec := checkpoint.get(cell_key)):
-                results[i] = rec["wer"]
-                continue
-            # mid-cell resume (utils.checkpoint.CellProgress): megabatch
-            # engines persist their in-cell cursor against the same
-            # checkpoint, so a killed sweep resumes INSIDE the running cell
-            progress = (CellProgress(checkpoint, cell_key,
-                                     every=progress_every)
-                        if checkpoint is not None and progress_every
-                        else None)
-            # cell-level retry (utils.resilience): the closure reconstructs
-            # decoders AND simulator from host data on every attempt, so
-            # this is the level that survives a REAL worker restart (the
-            # engine-level retry inside WordErrorRate reuses per-instance
-            # device buffers, which die with the worker); with ``progress``
-            # attached the rebuilt cell resumes mid-cell instead of
-            # restarting
-            if noise_model == "data":
-                cell = lambda: self._data_wer(  # noqa: E731
-                    code, eval_p, eval_logical_type, num_samples,
-                    progress=progress, target_failures=target_failures)
-            elif noise_model == "phenl":
-                cell = lambda: self._phenl_wer(  # noqa: E731
-                    code, eval_p, eval_logical_type, num_samples,
-                    num_cycles, progress=progress,
+                if noise_model == "data":
+                    bucket_builder = lambda bucket: (  # noqa: E731
+                        self._data_bucket_program(bucket, eval_logical_type,
+                                                  num_samples))
+                else:
+                    bucket_builder = lambda bucket: (  # noqa: E731
+                        self._phenl_bucket_program(bucket,
+                                                   eval_logical_type,
+                                                   num_samples, num_cycles))
+                results, serial_cells = eval_cells_fused(
+                    serial_cells, bucket_builder, cell_key_fn,
+                    checkpoint=checkpoint, progress_every=progress_every,
                     target_failures=target_failures)
-            else:
-                cell = lambda: self._circuit_wer(  # noqa: E731
-                    code, eval_p, eval_logical_type, num_samples,
-                    num_cycles, data_synd_noise_ratio, circuit_type,
-                    circuit_error_params)
-            with stage_timer(f"cell:{noise_model}"):
-                wer = resilience.run_cell(cell,
-                                          label=f"cell:{noise_model}")
-            # per-cell record: one structured log line (always) plus the
-            # telemetry event sink (JSONL stream / report) when enabled
-            log_record(logger, "cell_done", **cell_key, wer=float(wer))
-            telemetry.event("cell_done", **cell_key, wer=float(wer))
-            telemetry.count("sweep.cells")
-            if checkpoint is not None:
-                checkpoint.put(cell_key, {"wer": float(wer)})
-            results[i] = float(wer)
+            if target_failures is not None and serial_cells \
+                    and noise_model == "circuit":
+                raise ValueError(
+                    "target_failures is not supported for the circuit "
+                    "noise model (its engine has no megabatch early stop)")
 
-        values = np.asarray(
-            [results.get(i, np.nan) for i in range(len(cells))], dtype=float)
-        if shard_across_processes:
-            values = merge_cell_results(values)
-        eval_wer_array = values.reshape(len(self.code_list), len(eval_p_list))
+            for i, ci, code, eval_p in serial_cells:
+                cell_key = cell_key_fn(i, ci, code, eval_p)
+                if checkpoint is not None and (
+                        rec := checkpoint.get(cell_key)):
+                    results[i] = rec["wer"]
+                    diagnostics.record_cell(
+                        cell_key, rec["wer"],
+                        {k: rec[k] for k in diagnostics.CI_KEYS
+                         if k in rec})
+                    continue
+                # mid-cell resume (utils.checkpoint.CellProgress):
+                # megabatch engines persist their in-cell cursor against
+                # the same checkpoint, so a killed sweep resumes INSIDE the
+                # running cell
+                progress = (CellProgress(checkpoint, cell_key,
+                                         every=progress_every)
+                            if checkpoint is not None and progress_every
+                            else None)
+                # cell-level retry (utils.resilience): the closure
+                # reconstructs decoders AND simulator from host data on
+                # every attempt, so this is the level that survives a REAL
+                # worker restart (the engine-level retry inside
+                # WordErrorRate reuses per-instance device buffers, which
+                # die with the worker); with ``progress`` attached the
+                # rebuilt cell resumes mid-cell instead of restarting
+                if noise_model == "data":
+                    cell = lambda: self._data_wer(  # noqa: E731
+                        code, eval_p, eval_logical_type, num_samples,
+                        progress=progress, target_failures=target_failures)
+                elif noise_model == "phenl":
+                    cell = lambda: self._phenl_wer(  # noqa: E731
+                        code, eval_p, eval_logical_type, num_samples,
+                        num_cycles, progress=progress,
+                        target_failures=target_failures)
+                else:
+                    cell = lambda: self._circuit_wer(  # noqa: E731
+                        code, eval_p, eval_logical_type, num_samples,
+                        num_cycles, data_synd_noise_ratio, circuit_type,
+                        circuit_error_params)
+                # the cell scope collects the engine run's (failures,
+                # shots) so the cell record carries its Wilson interval
+                # (utils.diagnostics; empty for multi-run circuit 'Total'
+                # cells, which have no single binomial count)
+                with stage_timer(f"cell:{noise_model}"), \
+                        diagnostics.cell_scope() as cell_stats:
+                    wer = resilience.run_cell(cell,
+                                              label=f"cell:{noise_model}")
+                ci_block = cell_stats.fields()
+                # per-cell record: one structured log line (always) plus
+                # the telemetry event sink (JSONL stream / report) when
+                # enabled
+                log_record(logger, "cell_done", **cell_key,
+                           wer=float(wer), **ci_block)
+                telemetry.event("cell_done", **cell_key, wer=float(wer),
+                                **ci_block)
+                telemetry.count("sweep.cells")
+                diagnostics.record_cell(cell_key, float(wer), ci_block)
+                if checkpoint is not None:
+                    checkpoint.put(cell_key, {"wer": float(wer),
+                                              **ci_block})
+                results[i] = float(wer)
+
+            values = np.asarray(
+                [results.get(i, np.nan) for i in range(len(cells))],
+                dtype=float)
+            if shard_across_processes:
+                values = merge_cell_results(values)
+            eval_wer_array = values.reshape(len(self.code_list),
+                                            len(eval_p_list))
         if if_plot:
             self._plot_wer(eval_p_list, eval_wer_array, num_cycles)
         return eval_wer_array
@@ -412,22 +458,34 @@ class CodeFamily:
                       eval_method: str, est_threshold: float,
                       num_samples: int, num_cycles=1, data_synd_noise_ratio=1,
                       circuit_type="coloration", circuit_error_params=None,
-                      if_plot=False):
+                      if_plot=False, ledger=None):
         """p-grid = logspace(0.4 est, 0.8 est, 6); extrapolation fit
-        (src/Simulators.py:912-924)."""
+        (src/Simulators.py:912-924).  ``ledger``: as in EvalWER — the
+        sweep-run scope spans the grid AND the fit, so the threshold's
+        ``fit_report`` (bootstrap CI on p_c included) lands in the same
+        ledger record as the cells it was fit from."""
         assert eval_method in ["extrapolation"], (
             "eval_method should be one of [extrapolation]"
         )
+        from ..utils import diagnostics
+
         eval_p_list = 10 ** (
             np.linspace(np.log10(est_threshold * 0.4),
                         np.log10(est_threshold * 0.8), 6)
         )
-        eval_wer_array = self.EvalWER(
-            noise_model, eval_logical_type, eval_p_list, num_samples,
-            num_cycles, data_synd_noise_ratio, circuit_type,
-            circuit_error_params, if_plot=False,
-        )
-        return ThresholdEst_extrapolation(eval_p_list, eval_wer_array, if_plot)
+        cfg = {"driver": "CodeFamily.EvalThreshold", "noise": noise_model,
+               "type": eval_logical_type,
+               "codes": [c.name or f"N{c.N}K{c.K}" for c in self.code_list],
+               "p_list": [float(p) for p in eval_p_list],
+               "cycles": int(num_cycles), "samples": int(num_samples)}
+        with diagnostics.sweep_run(cfg, ledger=ledger):
+            eval_wer_array = self.EvalWER(
+                noise_model, eval_logical_type, eval_p_list, num_samples,
+                num_cycles, data_synd_noise_ratio, circuit_type,
+                circuit_error_params, if_plot=False,
+            )
+            return ThresholdEst_extrapolation(eval_p_list, eval_wer_array,
+                                              if_plot)
 
     def EvalSustainableThreshold(self, noise_model: str, eval_logical_type: str,
                                  eval_method: str, est_threshold: float,
@@ -435,41 +493,66 @@ class CodeFamily:
                                  num_cycles_list: list,
                                  data_synd_noise_ratio=1,
                                  circuit_type="coloration",
-                                 circuit_error_params=None, if_plot=False):
+                                 circuit_error_params=None, if_plot=False,
+                                 ledger=None):
         """Fit p_sus over thresholds at increasing cycle counts
-        (src/Simulators.py:927-948)."""
-        thresholds = [
-            self.EvalThreshold(
-                noise_model=noise_model, eval_logical_type=eval_logical_type,
-                eval_method=eval_method, est_threshold=est_threshold,
-                num_samples=int(num_samples_per_cycle / n),
-                num_cycles=n, data_synd_noise_ratio=data_synd_noise_ratio,
-                circuit_type=circuit_type,
-                circuit_error_params=circuit_error_params, if_plot=if_plot,
-            )
-            for n in num_cycles_list
-        ]
-        return SustainableThresholdEst(num_cycles_list, thresholds,
-                                       if_plot=if_plot)
+        (src/Simulators.py:927-948).  ``ledger``: the sweep-run scope
+        spans every cycle count's grid, its threshold fit, AND the final
+        sustainable fit — one ledger record for the whole campaign."""
+        from ..utils import diagnostics
+
+        cfg = {"driver": "CodeFamily.EvalSustainableThreshold",
+               "noise": noise_model, "type": eval_logical_type,
+               "codes": [c.name or f"N{c.N}K{c.K}" for c in self.code_list],
+               "est_threshold": float(est_threshold),
+               "cycles_list": [int(n) for n in num_cycles_list],
+               "samples_per_cycle": int(num_samples_per_cycle)}
+        with diagnostics.sweep_run(cfg, ledger=ledger):
+            thresholds = [
+                self.EvalThreshold(
+                    noise_model=noise_model,
+                    eval_logical_type=eval_logical_type,
+                    eval_method=eval_method, est_threshold=est_threshold,
+                    num_samples=int(num_samples_per_cycle / n),
+                    num_cycles=n,
+                    data_synd_noise_ratio=data_synd_noise_ratio,
+                    circuit_type=circuit_type,
+                    circuit_error_params=circuit_error_params,
+                    if_plot=if_plot,
+                )
+                for n in num_cycles_list
+            ]
+            return SustainableThresholdEst(num_cycles_list, thresholds,
+                                           if_plot=if_plot)
 
     def EvalEffectiveDistances(self, noise_model: str, eval_logical_type: str,
                                eval_method: str, est_threshold: float,
                                num_samples: int, num_cycles=1,
                                data_synd_noise_ratio=1,
                                circuit_type="coloration",
-                               circuit_error_params=None, if_plot=False):
+                               circuit_error_params=None, if_plot=False,
+                               ledger=None):
         """p-grid = logspace(est/6, est/4, 5); per-code distance fits
         (src/Simulators.py:951-963; ``circuit_error_params`` added so the
         circuit noise model is usable — the reference omits it and its
-        circuit branch would crash the same way)."""
+        circuit branch would crash the same way).  ``ledger``: as in
+        EvalThreshold — grid and distance fit_reports share one record."""
         assert eval_method in ["extrapolation"]
+        from ..utils import diagnostics
+
         eval_p_list = 10 ** (
             np.linspace(np.log10(est_threshold / 6),
                         np.log10(est_threshold / 4), 5)
         )
-        eval_wer_array = self.EvalWER(
-            noise_model, eval_logical_type, eval_p_list, num_samples,
-            num_cycles, data_synd_noise_ratio, circuit_type,
-            circuit_error_params, if_plot=False,
-        )
-        return DistanceEst(eval_p_list, eval_wer_array, if_plot)
+        cfg = {"driver": "CodeFamily.EvalEffectiveDistances",
+               "noise": noise_model, "type": eval_logical_type,
+               "codes": [c.name or f"N{c.N}K{c.K}" for c in self.code_list],
+               "p_list": [float(p) for p in eval_p_list],
+               "cycles": int(num_cycles), "samples": int(num_samples)}
+        with diagnostics.sweep_run(cfg, ledger=ledger):
+            eval_wer_array = self.EvalWER(
+                noise_model, eval_logical_type, eval_p_list, num_samples,
+                num_cycles, data_synd_noise_ratio, circuit_type,
+                circuit_error_params, if_plot=False,
+            )
+            return DistanceEst(eval_p_list, eval_wer_array, if_plot)

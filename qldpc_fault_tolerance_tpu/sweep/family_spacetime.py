@@ -44,7 +44,8 @@ class CodeFamily_SpaceTime:
                 circuit_type="coloration", circuit_error_params=None,
                 if_plot=True, if_adaptive=False, adaptive_params=None,
                 checkpoint=None, shard_across_processes: bool = False,
-                progress_every: int = 1, fused: bool | str = "auto"):
+                progress_every: int = 1, fused: bool | str = "auto",
+                ledger=None):
         """(ragged) per-code WER/p lists
         (src/Simulators_SpaceTime.py:1158-1307).
 
@@ -62,6 +63,9 @@ class CodeFamily_SpaceTime:
         pruning predicate is deterministic, so every process enumerates the
         same cells); the scalar results merge over DCN at the end
         (parallel/grid.py).
+        ``ledger``: statistical-observability run ledger — see
+        sweep/family.py (same semantics: per-cell Wilson intervals on the
+        events, anomaly monitors over the grid, one JSONL ledger record).
         """
         assert noise_model in ["data", "phenl", "circuit"], (
             "noise_model should be one of [data, phenl, circuit]"
@@ -70,7 +74,7 @@ class CodeFamily_SpaceTime:
             "eval_type should be one of [X, Y, Total]"
         )
         from ..parallel.grid import merge_cell_results, process_cell_owner
-        from ..utils import resilience, telemetry
+        from ..utils import diagnostics, resilience, telemetry
         from ..utils.checkpoint import CellProgress
         from ..utils.observability import get_logger, log_record, stage_timer
 
@@ -102,59 +106,84 @@ class CodeFamily_SpaceTime:
                 "rep": int(num_rep), "samples": int(num_samples),
             }
 
+        grid_cfg = {
+            "driver": "CodeFamily_SpaceTime.EvalWER", "noise": noise_model,
+            "type": eval_logical_type,
+            "codes": [c.name or f"code{ci}_N{c.N}K{c.K}"
+                      for ci, c in enumerate(self.code_list)],
+            "p_list": [[float(p) for p in p_list] for p_list in per_code_p],
+            "cycles": int(num_cycles), "rep": int(num_rep),
+            "samples": int(num_samples),
+            "batch": int(self.batch_size), "seed": int(self.seed),
+        }
         flat_wer = np.full(len(cells), np.nan)
-        serial = [(idx, ci, self.code_list[ci], eval_p)
-                  for idx, (ci, eval_p) in enumerate(cells) if owned[idx]]
-        # sharded grids keep the serial loop (see sweep/family.py)
-        if (fused is not False and noise_model == "data"
-                and not shard_across_processes):
-            # the data branch rides the same fused planner as
-            # sweep/family.py; phenl/circuit ST engines have no fused unit
-            from .fused import eval_cells_fused
+        with diagnostics.sweep_run(grid_cfg, ledger=ledger):
+            serial = [(idx, ci, self.code_list[ci], eval_p)
+                      for idx, (ci, eval_p) in enumerate(cells) if owned[idx]]
+            # sharded grids keep the serial loop (see sweep/family.py)
+            if (fused is not False and noise_model == "data"
+                    and not shard_across_processes):
+                # the data branch rides the same fused planner as
+                # sweep/family.py; phenl/circuit ST engines have no fused
+                # unit
+                from .fused import eval_cells_fused
 
-            results, serial = eval_cells_fused(
-                serial,
-                lambda bucket: self._data_bucket_program(
-                    bucket, eval_logical_type, num_samples),
-                cell_key_fn, checkpoint=checkpoint,
-                progress_every=progress_every)
-            for idx, wer in results.items():
+                results, serial = eval_cells_fused(
+                    serial,
+                    lambda bucket: self._data_bucket_program(
+                        bucket, eval_logical_type, num_samples),
+                    cell_key_fn, checkpoint=checkpoint,
+                    progress_every=progress_every)
+                for idx, wer in results.items():
+                    flat_wer[idx] = wer
+            for idx, ci, code, eval_p in serial:
+                cell_key = cell_key_fn(idx, ci, code, eval_p)
+                if checkpoint is not None and (
+                        rec := checkpoint.get(cell_key)):
+                    flat_wer[idx] = rec["wer"]
+                    diagnostics.record_cell(
+                        cell_key, rec["wer"],
+                        {k: rec[k] for k in diagnostics.CI_KEYS
+                         if k in rec})
+                    continue
+                # mid-cell resume for the data branch (the only ST branch
+                # on the megabatch driver); see sweep/family.py
+                progress = (CellProgress(checkpoint, cell_key,
+                                         every=progress_every)
+                            if checkpoint is not None and progress_every
+                            else None)
+                # cell-level retry survives a real worker restart: each
+                # attempt reconstructs decoders + simulator from host data,
+                # and ``progress`` turns the rebuild into a resume
+                # (sweep/family.py)
+                if noise_model == "data":
+                    cell = lambda: self._data_wer(  # noqa: E731
+                        code, eval_p, eval_logical_type, num_samples,
+                        progress=progress)
+                elif noise_model == "phenl":
+                    cell = lambda: self._phenl_wer(  # noqa: E731
+                        code, eval_p, eval_logical_type, num_samples,
+                        num_cycles, num_rep)
+                else:
+                    cell = lambda: self._circuit_wer(  # noqa: E731
+                        code, eval_p, eval_logical_type, num_samples,
+                        num_cycles, num_rep, circuit_type,
+                        circuit_error_params)
+                with stage_timer(f"cell:st-{noise_model}"), \
+                        diagnostics.cell_scope() as cell_stats:
+                    wer = resilience.run_cell(
+                        cell, label=f"cell:st-{noise_model}")
+                ci_block = cell_stats.fields()
+                log_record(logger, "cell_done", **cell_key,
+                           wer=float(wer), **ci_block)
+                telemetry.event("cell_done", **cell_key, wer=float(wer),
+                                **ci_block)
+                telemetry.count("sweep.cells")
+                diagnostics.record_cell(cell_key, float(wer), ci_block)
+                if checkpoint is not None:
+                    checkpoint.put(cell_key, {"wer": float(wer),
+                                              **ci_block})
                 flat_wer[idx] = wer
-        for idx, ci, code, eval_p in serial:
-            cell_key = cell_key_fn(idx, ci, code, eval_p)
-            if checkpoint is not None and (rec := checkpoint.get(cell_key)):
-                flat_wer[idx] = rec["wer"]
-                continue
-            # mid-cell resume for the data branch (the only ST branch on
-            # the megabatch driver); see sweep/family.py
-            progress = (CellProgress(checkpoint, cell_key,
-                                     every=progress_every)
-                        if checkpoint is not None and progress_every
-                        else None)
-            # cell-level retry survives a real worker restart: each attempt
-            # reconstructs decoders + simulator from host data, and
-            # ``progress`` turns the rebuild into a resume (sweep/family.py)
-            if noise_model == "data":
-                cell = lambda: self._data_wer(  # noqa: E731
-                    code, eval_p, eval_logical_type, num_samples,
-                    progress=progress)
-            elif noise_model == "phenl":
-                cell = lambda: self._phenl_wer(  # noqa: E731
-                    code, eval_p, eval_logical_type, num_samples,
-                    num_cycles, num_rep)
-            else:
-                cell = lambda: self._circuit_wer(  # noqa: E731
-                    code, eval_p, eval_logical_type, num_samples,
-                    num_cycles, num_rep, circuit_type, circuit_error_params)
-            with stage_timer(f"cell:st-{noise_model}"):
-                wer = resilience.run_cell(cell,
-                                          label=f"cell:st-{noise_model}")
-            log_record(logger, "cell_done", **cell_key, wer=float(wer))
-            telemetry.event("cell_done", **cell_key, wer=float(wer))
-            telemetry.count("sweep.cells")
-            if checkpoint is not None:
-                checkpoint.put(cell_key, {"wer": float(wer)})
-            flat_wer[idx] = wer
         if shard_across_processes:
             flat_wer = merge_cell_results(flat_wer)
 
@@ -264,55 +293,93 @@ class CodeFamily_SpaceTime:
                       eval_method: str, est_threshold: float,
                       num_samples: int, num_cycles=1, num_rep=1,
                       circuit_type="coloration", circuit_error_params=None,
-                      if_plot=False):
-        """src/Simulators_SpaceTime.py:1311-1323 (explicit num_rep)."""
+                      if_plot=False, ledger=None):
+        """src/Simulators_SpaceTime.py:1311-1323 (explicit num_rep).
+        ``ledger``: grid + threshold fit_report share one ledger record
+        (see sweep/family.py)."""
         assert eval_method in ["extrapolation"]
+        from ..utils import diagnostics
+
         eval_p_list = 10 ** (
             np.linspace(np.log10(est_threshold * 0.4),
                         np.log10(est_threshold * 0.8), 6)
         )
-        wer_list, _ = self.EvalWER(
-            noise_model, eval_logical_type, eval_p_list, num_samples,
-            num_cycles, num_rep, circuit_type, circuit_error_params,
-            if_plot=False,
-        )
-        return ThresholdEst_extrapolation(eval_p_list, np.array(wer_list), if_plot)
+        cfg = {"driver": "CodeFamily_SpaceTime.EvalThreshold",
+               "noise": noise_model, "type": eval_logical_type,
+               "codes": [c.name or f"N{c.N}K{c.K}" for c in self.code_list],
+               "p_list": [float(p) for p in eval_p_list],
+               "cycles": int(num_cycles), "rep": int(num_rep),
+               "samples": int(num_samples)}
+        with diagnostics.sweep_run(cfg, ledger=ledger):
+            wer_list, _ = self.EvalWER(
+                noise_model, eval_logical_type, eval_p_list, num_samples,
+                num_cycles, num_rep, circuit_type, circuit_error_params,
+                if_plot=False,
+            )
+            return ThresholdEst_extrapolation(eval_p_list,
+                                              np.array(wer_list), if_plot)
 
     def EvalSustainableThreshold(self, noise_model: str, eval_logical_type: str,
                                  eval_method: str, est_threshold: float,
                                  num_samples_per_cycle: int,
                                  num_cycles_list: list, num_rep=1,
                                  circuit_type="coloration",
-                                 circuit_error_params=None, if_plot=False):
-        """src/Simulators_SpaceTime.py:1326-1347."""
-        thresholds = [
-            self.EvalThreshold(
-                noise_model=noise_model, eval_logical_type=eval_logical_type,
-                eval_method=eval_method, est_threshold=est_threshold,
-                num_samples=int(num_samples_per_cycle / n), num_cycles=n,
-                num_rep=num_rep, circuit_type=circuit_type,
-                circuit_error_params=circuit_error_params, if_plot=if_plot,
-            )
-            for n in num_cycles_list
-        ]
-        return SustainableThresholdEst(num_cycles_list, thresholds,
-                                       if_plot=if_plot)
+                                 circuit_error_params=None, if_plot=False,
+                                 ledger=None):
+        """src/Simulators_SpaceTime.py:1326-1347.  ``ledger``: one record
+        spanning every cycle count's grid + fits (see sweep/family.py)."""
+        from ..utils import diagnostics
+
+        cfg = {"driver": "CodeFamily_SpaceTime.EvalSustainableThreshold",
+               "noise": noise_model, "type": eval_logical_type,
+               "codes": [c.name or f"N{c.N}K{c.K}" for c in self.code_list],
+               "est_threshold": float(est_threshold),
+               "cycles_list": [int(n) for n in num_cycles_list],
+               "rep": int(num_rep),
+               "samples_per_cycle": int(num_samples_per_cycle)}
+        with diagnostics.sweep_run(cfg, ledger=ledger):
+            thresholds = [
+                self.EvalThreshold(
+                    noise_model=noise_model,
+                    eval_logical_type=eval_logical_type,
+                    eval_method=eval_method, est_threshold=est_threshold,
+                    num_samples=int(num_samples_per_cycle / n),
+                    num_cycles=n, num_rep=num_rep,
+                    circuit_type=circuit_type,
+                    circuit_error_params=circuit_error_params,
+                    if_plot=if_plot,
+                )
+                for n in num_cycles_list
+            ]
+            return SustainableThresholdEst(num_cycles_list, thresholds,
+                                           if_plot=if_plot)
 
     def EvalEffectiveDistances(self, noise_model: str, eval_logical_type: str,
                                eval_method: str, est_threshold: float,
                                num_samples: int, num_cycles=1, num_rep=1,
                                circuit_type="coloration",
-                               circuit_error_params=None, if_plot=False):
+                               circuit_error_params=None, if_plot=False,
+                               ledger=None):
         """src/Simulators_SpaceTime.py:1350-1362 (circuit_error_params added,
-        see family.py)."""
+        see family.py).  ``ledger``: grid + distance fit_reports share one
+        ledger record (see sweep/family.py)."""
         assert eval_method in ["extrapolation"]
+        from ..utils import diagnostics
+
         eval_p_list = 10 ** (
             np.linspace(np.log10(est_threshold / 6),
                         np.log10(est_threshold / 4), 5)
         )
-        wer_list, _ = self.EvalWER(
-            noise_model, eval_logical_type, eval_p_list, num_samples,
-            num_cycles, num_rep, circuit_type, circuit_error_params,
-            if_plot=False,
-        )
-        return DistanceEst(eval_p_list, np.array(wer_list), if_plot)
+        cfg = {"driver": "CodeFamily_SpaceTime.EvalEffectiveDistances",
+               "noise": noise_model, "type": eval_logical_type,
+               "codes": [c.name or f"N{c.N}K{c.K}" for c in self.code_list],
+               "p_list": [float(p) for p in eval_p_list],
+               "cycles": int(num_cycles), "rep": int(num_rep),
+               "samples": int(num_samples)}
+        with diagnostics.sweep_run(cfg, ledger=ledger):
+            wer_list, _ = self.EvalWER(
+                noise_model, eval_logical_type, eval_p_list, num_samples,
+                num_cycles, num_rep, circuit_type, circuit_error_params,
+                if_plot=False,
+            )
+            return DistanceEst(eval_p_list, np.array(wer_list), if_plot)

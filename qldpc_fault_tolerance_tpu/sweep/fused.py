@@ -107,18 +107,28 @@ def _bucket_progress_key(cell_keys: list[dict]) -> dict:
 
 
 def _record_cell(cell_key: dict, wer: float, engine: str,
-                 failures: int, shots: int) -> None:
+                 failures: int, shots: int, rungs: list = ()) -> dict:
     """Per-cell bookkeeping identical to the serial loop's (one structured
-    log line + telemetry events/counters), plus the fused-path counters."""
+    log line + telemetry events/counters), plus the fused-path counters.
+    With diagnostics active the cell_done event carries the cell's Wilson
+    interval (the counts are right here — no extra syncs) and the cell
+    feeds the active sweep run's monitor/ledger; ``rungs`` is the bucket's
+    pre-drained ladder-rung list (one device run serves every cell, so the
+    label applies bucket-wide).  Returns the uncertainty block (possibly
+    empty) so the checkpoint record can carry it too."""
     from ..sim.common import record_wer_run
-    from ..utils import telemetry
+    from ..utils import diagnostics, telemetry
     from ..utils.observability import get_logger, log_record
 
-    record_wer_run(engine, failures, shots, wer)
-    log_record(get_logger(), "cell_done", **cell_key, wer=float(wer))
-    telemetry.event("cell_done", **cell_key, wer=float(wer))
+    # record_wer_run computes the uncertainty block once for its wer_run
+    # event and hands it back for the cell_done event/checkpoint record
+    ci = record_wer_run(engine, failures, shots, wer)
+    log_record(get_logger(), "cell_done", **cell_key, wer=float(wer), **ci)
+    telemetry.event("cell_done", **cell_key, wer=float(wer), **ci)
+    diagnostics.record_cell(cell_key, float(wer), ci, rungs=list(rungs))
     telemetry.count("sweep.cells")
     telemetry.count("sweep.fused_cells")
+    return ci
 
 
 def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
@@ -144,6 +154,8 @@ def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
     from ..utils import resilience, telemetry
     from ..utils.checkpoint import CellProgress
 
+    from ..utils import diagnostics
+
     results: dict[int, float] = {}
     leftovers: list[tuple] = []
 
@@ -154,6 +166,12 @@ def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
         if checkpoint is not None and (
                 rec := checkpoint.get(cell_key_fn(*item))):
             results[index] = rec["wer"]
+            # resumed cells still feed the grid monitor (their persisted
+            # records carry the uncertainty block when the writing run had
+            # diagnostics on), so monotonicity checks see the whole curve
+            diagnostics.record_cell(
+                cell_key_fn(*item), rec["wer"],
+                {k: rec[k] for k in diagnostics.CI_KEYS if k in rec})
             continue
         if buckets and buckets[-1][0][1] == ci:
             buckets[-1].append(item)
@@ -175,18 +193,30 @@ def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
             leftovers.extend(bucket)
             return None
         telemetry.count("sweep.fused_buckets")
+        # full cell identity for the diagnostics layer's live publishing
+        # (cell_progress events name (code, p, type), not just p tags)
+        prog.cell_keys = [cell_key_fn(*it) for it in bucket]
         return bucket, prog
 
     def record_bucket(bucket, prog, failures, shots, min_w):
         del min_w  # per-cell diagnostic; the grid API returns WER only
+        # ONE device run served every cell of the bucket, so a ladder step
+        # during it applies to ALL of them: drain the rung queue once,
+        # raise one bucket-level anomaly naming every cell, and label each
+        # cell's substrate (cell-by-cell draining would tag only the first)
+        rungs = diagnostics.drain_degrade_rungs()
+        if rungs:
+            diagnostics.report_ladder_anomaly(
+                [cell_key_fn(*it) for it in bucket], rungs)
         for lane, item in enumerate(bucket):
             index = item[0]
             cell_key = cell_key_fn(*item)
             wer = prog.wer_fn(failures[lane], shots[lane])[0]
-            _record_cell(cell_key, float(wer), prog.engine,
-                         int(failures[lane]), int(shots[lane]))
+            ci = _record_cell(cell_key, float(wer), prog.engine,
+                              int(failures[lane]), int(shots[lane]),
+                              rungs=rungs)
             if checkpoint is not None:
-                checkpoint.put(cell_key, {"wer": float(wer)})
+                checkpoint.put(cell_key, {"wer": float(wer), **ci})
             results[index] = float(wer)
 
     if not streaming:

@@ -1,6 +1,8 @@
 """Utilities: par2gen teaching tools, observability, telemetry, sweep
-checkpointing, resilience (retry/watchdog/degradation), fault injection."""
-from . import faultinject, par2gen, profiling, resilience, telemetry
+checkpointing, resilience (retry/watchdog/degradation), fault injection,
+statistical diagnostics (intervals / anomaly monitors / run ledger)."""
+from . import diagnostics, faultinject, par2gen, profiling, resilience, \
+    telemetry
 from .checkpoint import CellProgress, SweepCheckpoint
 from .observability import (
     get_logger,
@@ -17,6 +19,6 @@ __all__ = [
     "par2gen", "HtoG", "GtoH", "HtoP", "GtoP", "LinearBlockCode",
     "SweepCheckpoint", "CellProgress", "stage_timer", "timings",
     "reset_timings", "profile_trace", "get_logger", "log_record",
-    "telemetry", "resilience", "faultinject", "profiling", "RetryPolicy",
-    "WatchdogTimeout",
+    "telemetry", "resilience", "faultinject", "profiling", "diagnostics",
+    "RetryPolicy", "WatchdogTimeout",
 ]

@@ -205,6 +205,17 @@ class CellProgress:
         }
         if tele is not None:
             state["tele"] = [int(x) for x in tele]
+        # statistical observability: the cursor carries its Wilson interval
+        # (shots reconstructed from the fingerprint's batch layout) so a
+        # tail -f of the checkpoint shows estimator health mid-cell; purely
+        # additive — the resume loader ignores the extra keys
+        from . import diagnostics
+
+        if diagnostics.active():
+            shots = int(batches_done) * int(fingerprint.get("batch_size", 0)
+                                            or 0)
+            if shots:
+                state.update(diagnostics.ci_fields(failures, shots))
         self.checkpoint.put_progress(self.key, state)
 
     def save_cells(self, fingerprint, batches_done, failures, shots, min_w,
@@ -229,4 +240,11 @@ class CellProgress:
             state["cursors"] = [int(x) for x in cursors]
         if tele is not None:
             state["tele"] = [int(x) for x in tele]
+        # per-cell Wilson intervals on the fused cursor (counts are right
+        # here; additive keys the resume loader ignores)
+        from . import diagnostics
+
+        if diagnostics.active() and any(int(s) for s in state["shots"]):
+            state.update(diagnostics.ci_arrays(state["failures"],
+                                               state["shots"]))
         self.checkpoint.put_progress(self.key, state)

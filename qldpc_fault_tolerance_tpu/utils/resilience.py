@@ -158,6 +158,12 @@ class DegradationLadder:
         telemetry.count("resilience.degrades")
         telemetry.event("degrade", rung=name)
         _log("degrade", rung=name)
+        # the statistical-observability monitor is notified DIRECTLY (not
+        # via the event stream) so ladder anomalies fire in ledger-only
+        # runs where telemetry is disabled
+        from . import diagnostics
+
+        diagnostics.notify_degrade(name)
         return name
 
 

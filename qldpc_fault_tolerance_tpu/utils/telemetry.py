@@ -47,6 +47,7 @@ __all__ = [
     "write_snapshot_event", "compile_stats",
     "ITER_BUCKETS", "TELE_LEN", "device_tele_vec", "publish_device_tele",
     "record_bp_aux",
+    "EVENT_SCHEMA_VERSION", "EVENT_SCHEMAS", "validate_event",
 ]
 
 # ---------------------------------------------------------------------------
@@ -330,6 +331,147 @@ def event(kind: str, **fields) -> None:
             s.emit(rec)
         except Exception:  # a broken sink must not kill the run
             pass
+
+
+# ---------------------------------------------------------------------------
+# Event schema registry
+# ---------------------------------------------------------------------------
+# Versioned contract between the event emitters and every consumer of the
+# JSONL stream (scripts/telemetry_report.py, scripts/sweep_dashboard.py,
+# scripts/bench_compare.py, the diagnostics monitors): each event kind lists
+# its required and known-optional fields with allowed (json-decoded) types.
+# A tier-1 test validates every kind emitted by real runs against this
+# registry, so a renamed/retyped field fails CI instead of silently breaking
+# a consumer.  Adding a NEW optional field is backward-compatible (add it
+# here in the same change); changing a required field bumps the version.
+EVENT_SCHEMA_VERSION = 1
+
+_NUM = (int, float)
+_OPT_NUM = (int, float, type(None))
+_OPT_STR = (str, type(None))
+# the shared uncertainty block (utils.diagnostics.ci_fields) events may carry
+_CI_FIELDS = {
+    "failures": int, "shots": int, "rate": _NUM,
+    "ci_low": _NUM, "ci_high": _NUM,
+    "rel_ci_width": _OPT_NUM, "rse": _OPT_NUM,
+}
+_CELL_KEY_FIELDS = {
+    "cycles": int, "samples": int, "rep": int, "wer": _NUM,
+}
+
+EVENT_SCHEMAS: dict[str, dict] = {
+    "telemetry_enabled": {"required": {"pid": int}, "optional": {}},
+    "snapshot": {"required": {"metrics": dict, "compile": dict},
+                 "optional": {}},
+    "wer_run": {
+        "required": {"engine": str, "shots": int, "failures": int,
+                     "wer": _NUM},
+        "optional": {"dispatches": int, **_CI_FIELDS},
+    },
+    "heartbeat": {
+        "required": {"engine": str, "shots": int},
+        "optional": {"waterfall": dict, "rse": _OPT_NUM},
+    },
+    "cell_done": {
+        "required": {"code": str, "noise": str, "type": str, "p": _NUM},
+        "optional": {**_CELL_KEY_FIELDS, **_CI_FIELDS},
+    },
+    "cell_progress": {
+        "required": {"engine": str, "cells": list, "failures": list,
+                     "shots": list, "ci_low": list, "ci_high": list},
+        "optional": {"rse": list},
+    },
+    "cell_resume": {
+        "required": {"key": dict, "batches_done": int},
+        "optional": {},
+    },
+    "fit_report": {
+        "required": {"fit": str, "converged": bool},
+        "optional": {"params": dict, "error": str, "p_c": _NUM,
+                     "pc_ci": list, "d_eff": _NUM, "d_ci": list,
+                     "d_per_code": list, "p_sus": _NUM, "stderr": dict,
+                     "r2": _OPT_NUM, "chi2": _OPT_NUM, "dof": int,
+                     "residual_rms": _OPT_NUM, "residual_max": _OPT_NUM,
+                     "n_points": int, "bootstrap": int,
+                     "bootstrap_failed": int, "code_index": int,
+                     "covariance_ok": bool},
+    },
+    "anomaly": {
+        "required": {"anomaly": str},
+        "optional": {"cell": dict, "cells": list, "rungs": list,
+                     "substrates": dict,
+                     "code": _OPT_STR, "type": _OPT_STR, "noise": _OPT_STR,
+                     "p_low": _NUM, "p_high": _NUM, "rate_low": _OPT_NUM,
+                     "rate_high": _OPT_NUM, "ci_low_cell": list,
+                     "ci_high_cell": list, "converged_fraction": _NUM,
+                     "shots": int, "tv_distance": _NUM},
+    },
+    "ledger": {
+        "required": {"run_id": str, "fingerprint": str, "cells": int,
+                     "fits": int, "anomalies": int},
+        "optional": {"path": _OPT_STR, "complete": bool},
+    },
+    "fused_fallback": {
+        "required": {"reason": str, "cells": int}, "optional": {},
+    },
+    "fault_injected": {
+        "required": {"site": str, "fault_kind": str, "seed": int},
+        "optional": {},
+    },
+    "degrade": {"required": {"rung": str}, "optional": {}},
+    "retry": {
+        "required": {"label": str, "attempt": int, "wait_s": _NUM,
+                     "error": str},
+        "optional": {},
+    },
+    "retry_exhausted": {
+        "required": {"label": str, "attempts": int, "error": str},
+        "optional": {},
+    },
+    "fail_fast": {
+        "required": {"label": str, "error": str}, "optional": {},
+    },
+    "watchdog_timeout": {
+        "required": {"label": str, "timeout_s": _NUM}, "optional": {},
+    },
+    "program_cost": {
+        "required": {"label": str},
+        "optional": {"flops": _NUM, "bytes_accessed": _NUM,
+                     "argument_bytes": int, "output_bytes": int,
+                     "temp_bytes": int, "generated_code_bytes": int,
+                     "peak_bytes": int, "backend": str},
+    },
+}
+
+
+def validate_event(record: dict) -> list[str]:
+    """Validate one emitted event against the schema registry.  Returns a
+    list of problems (empty = valid).  Unknown kinds and missing/mistyped
+    declared fields are problems; fields a schema does not declare are
+    allowed (emitters may carry extra context), so consumers must key on
+    declared names only."""
+    problems = []
+    kind = record.get("kind")
+    schema = EVENT_SCHEMAS.get(kind)
+    if schema is None:
+        return [f"unknown event kind {kind!r} "
+                f"(not in EVENT_SCHEMAS v{EVENT_SCHEMA_VERSION})"]
+    ts = record.get("ts")
+    if not isinstance(ts, (int, float)):
+        problems.append(f"{kind}: missing/non-numeric ts")
+    for field, types in schema["required"].items():
+        if field not in record:
+            problems.append(f"{kind}: missing required field {field!r}")
+        elif not isinstance(record[field], types):
+            problems.append(
+                f"{kind}: field {field!r} has type "
+                f"{type(record[field]).__name__}, expected {types}")
+    for field, types in schema.get("optional", {}).items():
+        if field in record and not isinstance(record[field], types):
+            problems.append(
+                f"{kind}: optional field {field!r} has type "
+                f"{type(record[field]).__name__}, expected {types}")
+    return problems
 
 
 # ---------------------------------------------------------------------------

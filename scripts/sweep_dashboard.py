@@ -1,0 +1,380 @@
+"""Live terminal dashboard for sweep runs: the (code x p) grid with per-cell
+state, CI width and shot counts, rendered from the statistical-observability
+layer's outputs alone — no live process required.
+
+    python scripts/sweep_dashboard.py ledger/                 # last run's grid
+    python scripts/sweep_dashboard.py ledger/sweeps.jsonl
+    python scripts/sweep_dashboard.py run.jsonl --follow      # tail a live sink
+    python scripts/sweep_dashboard.py ledger/ --drift         # cross-run compare
+    python scripts/sweep_dashboard.py ledger/ --drift --gate 3
+
+Inputs (auto-detected per line, freely mixable):
+  * run-ledger records (utils.diagnostics.RunLedger — one JSON object per
+    sweep run with per-cell final counts + Wilson CIs, fit reports,
+    anomalies), written under a ``ledger/`` dir by
+    ``CodeFamily.EvalWER(..., ledger=...)`` / ``QLDPC_LEDGER_DIR``;
+  * raw telemetry JSONL event streams (utils.telemetry JsonlSink):
+    ``cell_done`` events fill the grid, ``cell_progress`` events (the fused
+    drivers' live per-cell intervals) mark still-running cells, ``anomaly``
+    events flag cells, ``fit_report`` events list below the grid.
+
+Views (``--view``): ``wer`` (default; WER with relative CI width), ``ci``
+(interval bounds on the failure rate), ``shots``, ``state``.
+
+``--drift`` compares the LAST ledger run against the most recent prior run
+with the SAME config fingerprint (bench_compare's regression-ledger idea,
+applied to physics numbers): per-cell failure-rate deltas in combined-sigma
+units.  ``--gate Z`` exits 1 when any |z| exceeds Z — wire it into CI to
+catch silently shifted physics.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# ---------------------------------------------------------------------------
+# Input loading
+# ---------------------------------------------------------------------------
+def resolve_path(path: str) -> str:
+    """A directory means its ledger file (utils.diagnostics.RunLedger)."""
+    if os.path.isdir(path):
+        return os.path.join(path, "sweeps.jsonl")
+    return path
+
+
+def load_lines(path: str) -> list[dict]:
+    """Parse one JSONL file (ledger records and/or telemetry events) —
+    the library's crash-tolerant loader handles the torn-line and
+    dir -> sweeps.jsonl conventions in ONE place."""
+    from qldpc_fault_tolerance_tpu.utils.diagnostics import load_ledger
+
+    return load_ledger(path)
+
+
+# ---------------------------------------------------------------------------
+# Grid model
+# ---------------------------------------------------------------------------
+def _cell_update(grid: dict, key: dict, fields: dict, state: str) -> None:
+    row = (str(key.get("code", "?")), str(key.get("type", "?")),
+           str(key.get("noise", "?")))
+    p = float(key.get("p", 0.0))
+    cell = grid["rows"].setdefault(row, {}).setdefault(p, {})
+    # events are chronological within a stream, so the LAST update wins —
+    # a later run's progress correctly reopens a cell an earlier run (or
+    # ledger record) finished
+    cell.update({k: v for k, v in fields.items() if v is not None})
+    cell["state"] = state
+
+
+def build_grid(records: list[dict], grid: dict | None = None) -> dict:
+    """Fold ledger records / telemetry events into the grid model:
+    ``{"rows": {(code, type, noise): {p: cell}}, "anomalies": [...],
+    "fits": [...], "runs": [...]}``.  Pass the previous ``grid`` to fold
+    incrementally (the --follow loop feeds only fresh records instead of
+    re-parsing the whole history every poll)."""
+    if grid is None:
+        grid = {"rows": {}, "anomalies": [], "fits": [], "runs": []}
+    for rec in records:
+        kind = rec.get("kind")
+        if kind is None and "cells" in rec and "run_id" in rec:
+            # run-ledger record
+            grid["runs"].append({"run_id": rec.get("run_id"),
+                                 "fingerprint": rec.get("fingerprint"),
+                                 "ts": rec.get("ts")})
+            for c in rec.get("cells", []):
+                _cell_update(grid, c.get("cell", {}),
+                             {k: c.get(k) for k in
+                              ("wer", "failures", "shots", "rate", "ci_low",
+                               "ci_high", "rel_ci_width", "rse",
+                               "substrate")},
+                             "done")
+            grid["anomalies"].extend(rec.get("anomalies", []))
+            grid["fits"].extend(rec.get("fits", []))
+        elif kind == "cell_done":
+            _cell_update(grid, rec,
+                         {k: rec.get(k) for k in
+                          ("wer", "failures", "shots", "rate", "ci_low",
+                           "ci_high", "rel_ci_width", "rse")},
+                         "done")
+        elif kind == "cell_progress":
+            for c, f, n, lo, hi, rse in zip(
+                    rec.get("cells", []), rec.get("failures", []),
+                    rec.get("shots", []), rec.get("ci_low", []),
+                    rec.get("ci_high", []),
+                    rec.get("rse") or [None] * len(rec.get("cells", []))):
+                key = c if isinstance(c, dict) else {"p": c}
+                key.setdefault("code", f"({rec.get('engine', '?')})")
+                rate = (f / n) if n else 0.0
+                _cell_update(grid, key,
+                             {"failures": f, "shots": n, "rate": rate,
+                              "ci_low": lo, "ci_high": hi, "rse": rse,
+                              "rel_ci_width": ((hi - lo) / rate
+                                               if rate > 0 else None)},
+                             "running")
+        elif kind == "anomaly":
+            grid["anomalies"].append(rec)
+        elif kind == "fit_report":
+            grid["fits"].append(rec)
+    # mark anomalous cells
+    for a in grid["anomalies"]:
+        cell_key = a.get("cell")
+        if isinstance(cell_key, dict):
+            row = (str(cell_key.get("code", "?")),
+                   str(cell_key.get("type", "?")),
+                   str(cell_key.get("noise", "?")))
+            p = float(cell_key.get("p", 0.0))
+            c = grid["rows"].get(row, {}).get(p)
+            if c is not None:
+                c["anomaly"] = True
+    return grid
+
+
+# ---------------------------------------------------------------------------
+# Rendering
+# ---------------------------------------------------------------------------
+def _fmt(x, width: int) -> str:
+    return f"{x:>{width}}"
+
+
+def _cell_text(cell: dict, view: str) -> str:
+    if cell is None:
+        return "-"
+    mark = "!" if cell.get("anomaly") else ("~" if cell.get("state") ==
+                                            "running" else "")
+    if view == "state":
+        return mark + (cell.get("state") or "?")
+    if view == "shots":
+        n = cell.get("shots")
+        f = cell.get("failures")
+        if n is None:
+            return mark + "?"
+        return f"{mark}{f}/{n}" if f is not None else f"{mark}{n}"
+    if view == "ci":
+        lo, hi = cell.get("ci_low"), cell.get("ci_high")
+        if lo is None or hi is None:
+            return mark + "?"
+        return f"{mark}[{lo:.1e},{hi:.1e}]"
+    # default: wer with relative CI width
+    wer = cell.get("wer", cell.get("rate"))
+    if wer is None:
+        return mark + "?"
+    rw = cell.get("rel_ci_width")
+    pct = f"±{50 * rw:.0f}%" if rw is not None else ""
+    return f"{mark}{wer:.2e}{pct}"
+
+
+def render_grid(grid: dict, view: str = "wer", title: str = "") -> str:
+    """The terminal grid: one row block per (code, type, noise), one column
+    per p."""
+    lines = [f"== qldpc sweep dashboard{': ' + title if title else ''} =="]
+    if grid["runs"]:
+        last = grid["runs"][-1]
+        lines.append(f"runs: {len(grid['runs'])}   latest "
+                     f"{last.get('run_id')} (config {last.get('fingerprint')})")
+    if not grid["rows"]:
+        lines.append("(no cells yet)")
+        return "\n".join(lines)
+    all_p = sorted({p for cells in grid["rows"].values() for p in cells})
+    width = max(14, max((len(_cell_text(c, view))
+                         for cells in grid["rows"].values()
+                         for c in cells.values()), default=14) + 2)
+    label_w = max(len(f"{code} {lt} ({noise})")
+                  for code, lt, noise in grid["rows"]) + 2
+    header = " " * label_w + "".join(_fmt(f"p={p:g}", width) for p in all_p)
+    lines.append("")
+    lines.append(f"-- grid ({view}; ~ running, ! anomaly) --")
+    lines.append(header)
+    for (code, lt, noise), cells in sorted(grid["rows"].items()):
+        label = f"{code} {lt} ({noise})"
+        row = f"{label:<{label_w}}" + "".join(
+            _fmt(_cell_text(cells.get(p), view), width) for p in all_p)
+        lines.append(row)
+    done = sum(1 for cells in grid["rows"].values()
+               for c in cells.values() if c.get("state") == "done")
+    total = sum(len(cells) for cells in grid["rows"].values())
+    lines.append(f"cells: {done}/{total} done")
+    if grid["fits"]:
+        lines.append("-- fits --")
+        for f in grid["fits"]:
+            bits = [f.get("fit", "?"),
+                    "ok" if f.get("converged") else "FAILED"]
+            if f.get("p_c") is not None:
+                bits.append(f"p_c={f['p_c']:.4g}")
+            if f.get("pc_ci"):
+                bits.append(f"ci=[{f['pc_ci'][0]:.4g},{f['pc_ci'][1]:.4g}]")
+            if f.get("d_eff") is not None:
+                bits.append(f"d_eff={f['d_eff']:.3g}")
+            if f.get("d_ci"):
+                bits.append(f"ci=[{f['d_ci'][0]:.3g},{f['d_ci'][1]:.3g}]")
+            if f.get("r2") is not None:
+                bits.append(f"r2={f['r2']:.4f}")
+            lines.append("  " + "  ".join(bits))
+    if grid["anomalies"]:
+        lines.append(f"-- anomalies ({len(grid['anomalies'])}) --")
+        for a in grid["anomalies"]:
+            kind = a.get("anomaly", "?")
+            cell = a.get("cell") or {}
+            where = (f"{cell.get('code', '')} p={cell.get('p', '')}"
+                     if cell else "")
+            detail = {k: v for k, v in a.items()
+                      if k not in ("anomaly", "cell", "ts", "kind")}
+            lines.append(f"  ! {kind} {where} {json.dumps(detail, default=str)}"
+                         .rstrip())
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# Cross-run drift
+# ---------------------------------------------------------------------------
+def _cell_map(rec: dict) -> dict:
+    out = {}
+    for c in rec.get("cells", []):
+        k = c.get("cell", {})
+        out[(str(k.get("code")), str(k.get("type")), str(k.get("noise")),
+             round(float(k.get("p", 0.0)), 12))] = c
+    return out
+
+
+def drift_report(records: list[dict]) -> dict | None:
+    """Compare the LAST ledger run against the most recent PRIOR run with
+    the same config fingerprint: per-cell failure-rate deltas in
+    combined-sigma units (binomial se from each run's own counts).
+    Runs marked ``complete: false`` (the sweep raised mid-grid) are
+    excluded — gating against a truncated run would pass vacuously.
+    Returns None when no comparable pair exists."""
+    runs = [r for r in records if "cells" in r and "run_id" in r
+            and r.get("complete", True)]
+    if len(runs) < 2:
+        return None
+    cur = runs[-1]
+    prior = next((r for r in reversed(runs[:-1])
+                  if r.get("fingerprint") == cur.get("fingerprint")), None)
+    if prior is None:
+        return None
+    rows = []
+    cur_cells, prior_cells = _cell_map(cur), _cell_map(prior)
+    for key in sorted(set(cur_cells) & set(prior_cells)):
+        a, b = prior_cells[key], cur_cells[key]
+        if not all(x.get("shots") for x in (a, b)):
+            continue
+        ra = a["failures"] / a["shots"]
+        rb = b["failures"] / b["shots"]
+        se2 = (ra * (1 - ra) / a["shots"]) + (rb * (1 - rb) / b["shots"])
+        z = (rb - ra) / se2**0.5 if se2 > 0 else (
+            0.0 if rb == ra else float("inf"))
+        rows.append({"cell": key, "rate_prior": ra, "rate_now": rb,
+                     "z": z})
+    return {
+        "prior_run": prior.get("run_id"), "now_run": cur.get("run_id"),
+        "fingerprint": cur.get("fingerprint"),
+        "cells": rows,
+        "max_abs_z": max((abs(r["z"]) for r in rows), default=0.0),
+    }
+
+
+def render_drift(report: dict) -> str:
+    L = [f"== sweep drift: {report['prior_run']} -> {report['now_run']} "
+         f"(config {report['fingerprint']}) =="]
+    L.append(f"  {'cell':<44}{'prior':>12}{'now':>12}{'z':>8}")
+    for r in report["cells"]:
+        code, lt, noise, p = r["cell"]
+        name = f"{code} {lt} ({noise}) p={p:g}"
+        L.append(f"  {name:<44}{r['rate_prior']:>12.3e}"
+                 f"{r['rate_now']:>12.3e}{r['z']:>8.2f}")
+    L.append(f"max |z| = {report['max_abs_z']:.2f}")
+    return "\n".join(L)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="run-ledger dir/.jsonl or telemetry JSONL "
+                                 "stream")
+    ap.add_argument("--view", choices=("wer", "ci", "shots", "state"),
+                    default="wer")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail the file and re-render on new lines")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll interval in seconds")
+    ap.add_argument("--drift", action="store_true",
+                    help="compare the last ledger run against the prior "
+                         "run with the same config fingerprint")
+    ap.add_argument("--gate", type=float, default=None,
+                    help="with --drift: exit 1 when any |z| exceeds this")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the grid/drift model as json")
+    args = ap.parse_args(argv)
+
+    path = resolve_path(args.path)
+    if args.drift:
+        report = drift_report(load_lines(path))
+        if report is None:
+            print("no comparable ledger run pair (need two complete runs "
+                  "with the same config fingerprint)", file=sys.stderr)
+            # under --gate this is the CI bootstrap case (first run after
+            # a fresh ledger or a config change): nothing to gate, so pass
+            # — a red exit here would be indistinguishable from real drift
+            return 0 if args.gate is not None else 1
+        if args.json:
+            print(json.dumps(report, default=str))
+        else:
+            print(render_drift(report))
+        if args.gate is not None and report["max_abs_z"] > args.gate:
+            print(f"DRIFT GATE FAILED: max |z| {report['max_abs_z']:.2f} "
+                  f"> {args.gate}", file=sys.stderr)
+            return 1
+        return 0
+
+    if args.follow:
+        from scripts.telemetry_report import FollowReader
+
+        reader = FollowReader(path)
+        # incremental fold: only FRESH records are parsed each poll, so a
+        # multi-hour stream doesn't degrade the refresh or grow memory
+        grid = build_grid([])
+        seen_any = False
+        try:
+            while True:
+                fresh = reader.poll()
+                if fresh or not seen_any:
+                    seen_any = seen_any or bool(fresh)
+                    grid = build_grid(fresh, grid)
+                    if sys.stdout.isatty():
+                        sys.stdout.write("\x1b[2J\x1b[H")
+                    print(render_grid(grid, args.view,
+                                      title=os.path.basename(path)
+                                      + " (following)"))
+                    sys.stdout.flush()
+                time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+
+    records = load_lines(path)
+    if not records:
+        print(f"no records in {path}", file=sys.stderr)
+        return 1
+    grid = build_grid(records)
+    if args.json:
+        out = {"rows": {f"{c}|{t}|{n}": cells
+                        for (c, t, n), cells in grid["rows"].items()},
+               "anomalies": grid["anomalies"], "fits": grid["fits"],
+               "runs": grid["runs"]}
+        print(json.dumps(out, default=str))
+        return 0
+    print(render_grid(grid, args.view, title=os.path.basename(args.path)))
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        raise SystemExit(main())
+    except BrokenPipeError:  # e.g. `... | head` — not an error
+        raise SystemExit(0)

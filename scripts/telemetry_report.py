@@ -4,12 +4,18 @@ summary table.
     python scripts/telemetry_report.py RUN.jsonl            # text table
     python scripts/telemetry_report.py RUN.jsonl --json     # summary json
     python scripts/telemetry_report.py RUN.jsonl --prometheus
+    python scripts/telemetry_report.py RUN.jsonl --follow   # live re-render
 
 The stream is the one ``telemetry.enable(jsonl_path=...)`` (or
 ``QLDPC_TELEMETRY_JSONL=...``) writes: ``wer_run`` / ``cell_done`` events as
 the run progresses and a final ``snapshot`` event carrying the full metrics
 registry + compile stats (``telemetry.write_snapshot_event`` /
 ``telemetry.session``).  Metrics are cumulative, so the LAST snapshot wins.
+
+``--follow`` tails an ACTIVE sink: new complete lines are parsed
+incrementally (a partially-flushed tail line is left for the next poll)
+and the table re-renders in place every ``--interval`` seconds until
+Ctrl-C — no need to wait for the run to finish.
 """
 from __future__ import annotations
 
@@ -17,6 +23,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -37,6 +44,79 @@ def load_events(path: str) -> list[dict]:
     if bad:
         print(f"warning: skipped {bad} unparseable line(s)", file=sys.stderr)
     return events
+
+
+class FollowReader:
+    """Incremental JSONL reader for ``--follow``: each ``poll()`` returns
+    the events appended since the last poll.  Only COMPLETE lines are
+    consumed — a torn tail (the writer's in-flight flush, or a crash)
+    stays buffered until its newline arrives, so a mid-write poll never
+    misparses or drops an event.  A file that does not exist yet simply
+    yields nothing (the run may not have opened its sink)."""
+
+    def __init__(self, path: str):
+        self.path = str(path)
+        self._offset = 0
+
+    def poll(self) -> list[dict]:
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []
+        if size < self._offset:  # truncated/rotated: start over
+            self._offset = 0
+        if size == self._offset:
+            return []
+        with open(self.path, "rb") as fh:
+            fh.seek(self._offset)
+            chunk = fh.read(size - self._offset)
+        end = chunk.rfind(b"\n")
+        if end < 0:
+            return []  # no complete line yet
+        self._offset += end + 1
+        events = []
+        for line in chunk[: end + 1].splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line.decode("utf-8")))
+            except (json.JSONDecodeError, UnicodeDecodeError):
+                continue  # torn line from a crashed writer
+        return events
+
+
+def follow(path: str, interval: float = 1.0, *, render_fn=None,
+           out=None, max_polls=None) -> int:
+    """Tail ``path`` and re-render the summary table on new events.
+    Aggregation is INCREMENTAL — each poll folds only the fresh events
+    into a running state (metrics are cumulative and the last snapshot
+    wins, so nothing needs the full history), so a multi-hour sink costs
+    O(new events) per tick and bounded memory.  ``max_polls`` bounds the
+    loop for tests; interactive use runs until Ctrl-C."""
+    out = out or sys.stdout
+    render_fn = render_fn or (lambda s: render(s, title=os.path.basename(
+        path) + " (following)"))
+    reader = FollowReader(path)
+    state = new_fold_state()
+    seen_any = False
+    polls = 0
+    try:
+        while max_polls is None or polls < max_polls:
+            fresh = reader.poll()
+            polls += 1
+            if fresh or polls == 1:
+                fold_events(state, fresh)
+                seen_any = seen_any or bool(fresh)
+                if seen_any:
+                    out.write("\x1b[2J\x1b[H" if out.isatty() else "")
+                    out.write(render_fn(summary_from_state(state)) + "\n")
+                    out.flush()
+            if max_polls is None or polls < max_polls:
+                time.sleep(interval)
+    except KeyboardInterrupt:
+        pass
+    return 0
 
 
 def _metric(snap: dict, name: str, field: str = "value", default=0):
@@ -64,16 +144,45 @@ def _hist_quantile(m: dict, q: float):
     return buckets[-1]  # overflow: lower edge of the open bucket
 
 
+def new_fold_state() -> dict:
+    """Empty incremental-aggregation state for ``fold_events`` (metrics
+    are cumulative and the LAST snapshot wins, so the fold only needs the
+    kind counts, the ts range, and the latest snapshot event)."""
+    return {"kinds": {}, "ts_min": None, "ts_max": None, "snapshot": None}
+
+
+def fold_events(state: dict, events: list[dict]) -> dict:
+    """Fold a batch of events into ``state`` (in place; returns it)."""
+    kinds = state["kinds"]
+    for e in events:
+        k = e.get("kind", "?")
+        kinds[k] = kinds.get(k, 0) + 1
+        ts = e.get("ts")
+        if isinstance(ts, (int, float)):
+            state["ts_min"] = ts if state["ts_min"] is None \
+                else min(state["ts_min"], ts)
+            state["ts_max"] = ts if state["ts_max"] is None \
+                else max(state["ts_max"], ts)
+        if k == "snapshot":
+            state["snapshot"] = e
+    return state
+
+
 def summarize(events: list[dict]) -> dict:
     """Aggregate an event stream into one summary dict (the --json output;
     the text table renders from this)."""
-    kinds: dict[str, int] = {}
-    for e in events:
-        kinds[e.get("kind", "?")] = kinds.get(e.get("kind", "?"), 0) + 1
-    snapshots = [e for e in events if e.get("kind") == "snapshot"]
-    snap = snapshots[-1].get("metrics", {}) if snapshots else {}
-    compile_stats = snapshots[-1].get("compile", {}) if snapshots else {}
-    ts = [e["ts"] for e in events if "ts" in e]
+    return summary_from_state(fold_events(new_fold_state(), events))
+
+
+def summary_from_state(state: dict) -> dict:
+    kinds = state["kinds"]
+    snapshot_event = state["snapshot"]
+    snap = snapshot_event.get("metrics", {}) if snapshot_event else {}
+    compile_stats = snapshot_event.get("compile", {}) if snapshot_event \
+        else {}
+    wall = (round(state["ts_max"] - state["ts_min"], 3)
+            if state["ts_min"] is not None
+            and state["ts_max"] is not None else 0.0)
 
     bp_shots = _metric(snap, "bp.shots")
     bp_conv = _metric(snap, "bp.converged")
@@ -87,7 +196,7 @@ def summarize(events: list[dict]) -> dict:
     }
     return {
         "events": kinds,
-        "wall_s": (round(max(ts) - min(ts), 3) if len(ts) > 1 else 0.0),
+        "wall_s": wall,
         "shots": _metric(snap, "sim.shots"),
         "failures": _metric(snap, "sim.failures"),
         "runs": _metric(snap, "sim.runs"),
@@ -213,7 +322,15 @@ def main(argv=None) -> int:
                     help="emit the summary as json instead of the table")
     ap.add_argument("--prometheus", action="store_true",
                     help="emit the final snapshot in Prometheus text format")
+    ap.add_argument("--follow", action="store_true",
+                    help="tail an active sink and re-render incrementally "
+                         "(Ctrl-C to stop)")
+    ap.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll interval in seconds (default 1)")
     args = ap.parse_args(argv)
+
+    if args.follow:
+        return follow(args.jsonl, args.interval)
 
     events = load_events(args.jsonl)
     if not events:

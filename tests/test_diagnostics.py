@@ -1,0 +1,656 @@
+"""Statistical observability layer (ISSUE 7): uncertainty intervals vs
+closed-form/SciPy references, event-schema validation, anomaly monitors
+(incl. the forced-ladder-step satellite), fit diagnostics with bootstrap
+CIs and the converged:false failure path, the run ledger, the sweep
+dashboard rendering from files alone, and the end-to-end fused-sweep
+acceptance: diagnostics on vs off is bit-exact."""
+import importlib
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class, BPDecoder
+from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+from qldpc_fault_tolerance_tpu.sweep import CodeFamily, fits
+from qldpc_fault_tolerance_tpu.utils import (
+    diagnostics,
+    faultinject,
+    resilience,
+    telemetry,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    """Every test starts with telemetry off, an empty registry, and the
+    diagnostics switch back in auto mode."""
+    telemetry.disable()
+    telemetry.reset()
+    diagnostics.auto()
+    yield
+    diagnostics.auto()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _family(codes=None, batch=64, seed=1):
+    codes = codes or [hgp(rep_code(3), rep_code(3), name="hgp_rep3")]
+    return CodeFamily(
+        codes, BP_Decoder_Class(4, "minimum_sum", 0.625),
+        BP_Decoder_Class(4, "minimum_sum", 0.625),
+        batch_size=batch, seed=seed)
+
+
+def _assert_all_events_valid(records):
+    problems = [p for r in records for p in telemetry.validate_event(r)]
+    assert not problems, "schema violations:\n" + "\n".join(problems)
+
+
+# ---------------------------------------------------------------------------
+# intervals vs independent references
+# ---------------------------------------------------------------------------
+def test_wilson_matches_scipy_and_quadratic_root_reference():
+    """The Wilson interval is the root pair of
+    (phat - p)^2 = z^2 p (1-p) / n — solve that quadratic independently
+    (np.roots) and, where available, cross-check scipy's binomtest
+    proportion_ci; both must agree to 1e-12."""
+    z = diagnostics.Z_95
+    for f, n in [(0, 64), (1, 64), (5, 100), (50, 100), (99, 100),
+                 (100, 100), (3, 7), (1234, 100000)]:
+        lo, hi = diagnostics.wilson_interval(f, n, z)
+        phat = f / n
+        # quadratic: (1 + z²/n) p² - (2 phat + z²/n) p + phat² = 0
+        a = 1.0 + z * z / n
+        b = -(2.0 * phat + z * z / n)
+        c = phat * phat
+        roots = sorted(np.roots([a, b, c]).real)
+        assert abs(lo - max(roots[0], 0.0)) < 1e-12, (f, n)
+        assert abs(hi - min(roots[1], 1.0)) < 1e-12, (f, n)
+        try:
+            from scipy.stats import binomtest
+
+            ci = binomtest(f, n).proportion_ci(confidence_level=0.95,
+                                               method="wilson")
+            assert abs(lo - ci.low) < 1e-12
+            assert abs(hi - ci.high) < 1e-12
+        except (ImportError, AttributeError, TypeError):
+            pass  # old scipy: the quadratic-root check above stands
+
+
+def test_clopper_pearson_matches_scipy_beta():
+    from scipy.stats import beta
+
+    for f, n in [(0, 50), (1, 50), (7, 64), (64, 64)]:
+        lo, hi = diagnostics.clopper_pearson_interval(f, n)
+        ref_lo = 0.0 if f == 0 else beta.ppf(0.025, f, n - f + 1)
+        ref_hi = 1.0 if f == n else beta.ppf(0.975, f + 1, n - f)
+        assert abs(lo - ref_lo) < 1e-12
+        assert abs(hi - ref_hi) < 1e-12
+
+
+def test_ci_fields_edge_cases():
+    empty = diagnostics.ci_fields(0, 0)
+    assert empty["ci_low"] == 0.0 and empty["ci_high"] == 1.0
+    assert empty["rel_ci_width"] is None and empty["rse"] is None
+    zero_fail = diagnostics.ci_fields(0, 128)
+    assert zero_fail["rate"] == 0.0 and zero_fail["rse"] is None
+    assert zero_fail["ci_high"] < 0.1  # informative upper bound
+    full = diagnostics.ci_fields(64, 64)
+    assert full["rate"] == 1.0 and full["rse"] == 0.0
+    some = diagnostics.ci_fields(9, 100)
+    assert some["ci_low"] < 0.09 < some["ci_high"]
+    assert some["rse"] == pytest.approx(np.sqrt(0.91 / 9))
+    # everything must be JSON-round-trippable
+    assert json.loads(json.dumps(some)) == some
+
+
+# ---------------------------------------------------------------------------
+# event schema registry
+# ---------------------------------------------------------------------------
+def test_validate_event_flags_drift():
+    ok = {"ts": 1.0, "kind": "wer_run", "engine": "data", "shots": 10,
+          "failures": 1, "wer": 0.1}
+    assert telemetry.validate_event(ok) == []
+    missing = dict(ok)
+    del missing["shots"]
+    assert any("shots" in p for p in telemetry.validate_event(missing))
+    mistyped = dict(ok, failures="1")
+    assert any("failures" in p for p in telemetry.validate_event(mistyped))
+    assert telemetry.validate_event({"ts": 1.0, "kind": "nope"})
+    # every registered kind names its required fields
+    for kind, schema in telemetry.EVENT_SCHEMAS.items():
+        assert isinstance(schema["required"], dict), kind
+
+
+# ---------------------------------------------------------------------------
+# anomaly monitors (synthetic feeds)
+# ---------------------------------------------------------------------------
+def _cell_key(p, code="c0"):
+    return {"code": code, "noise": "data", "type": "Total", "p": float(p),
+            "cycles": 1, "samples": 64}
+
+
+def test_monitor_flags_non_monotone_beyond_ci_overlap():
+    telemetry.enable()
+    mon = diagnostics.SweepMonitor()
+    # decisively decreasing rate with p: 60/1000 at p=0.02 vs 5/1000 at
+    # p=0.04 — disjoint CIs -> anomaly
+    mon.note_cell(_cell_key(0.02), 0.06, diagnostics.ci_fields(60, 1000))
+    mon.note_cell(_cell_key(0.04), 0.005, diagnostics.ci_fields(5, 1000))
+    mon.finalize()
+    kinds = [a["anomaly"] for a in mon.anomalies]
+    assert "non_monotone_wer" in kinds
+    snap = telemetry.snapshot()
+    assert snap["diag.anomaly.non_monotone_wer"]["value"] == 1
+
+    # overlapping CIs (10 vs 9 failures in 1000) are noise, not an anomaly
+    mon2 = diagnostics.SweepMonitor()
+    mon2.note_cell(_cell_key(0.02), 0.01, diagnostics.ci_fields(10, 1000))
+    mon2.note_cell(_cell_key(0.04), 0.009, diagnostics.ci_fields(9, 1000))
+    mon2.finalize()
+    assert not [a for a in mon2.anomalies
+                if a["anomaly"] == "non_monotone_wer"]
+
+
+def test_monitor_flags_stalled_convergence_and_iteration_drift():
+    telemetry.enable()
+    mon = diagnostics.SweepMonitor(min_shots=100)
+    nb = len(telemetry.ITER_BUCKETS) + 1
+    hist = telemetry.histogram("bp.iterations", telemetry.ITER_BUCKETS)
+
+    # cell 1: healthy — 95% converged, iterations concentrated low
+    telemetry.count("bp.shots", 1000)
+    telemetry.count("bp.converged", 950)
+    hist.merge_counts([950] + [0] * (nb - 1), 950.0, 950)
+    mon.note_cell(_cell_key(0.01), 0.01, None)
+    assert not mon.anomalies
+
+    # cell 2: stalled (20% converged) AND iteration mass moved to the top
+    telemetry.count("bp.shots", 1000)
+    telemetry.count("bp.converged", 200)
+    hist.merge_counts([0] * (nb - 1) + [200], 12800.0, 200)
+    mon.note_cell(_cell_key(0.02), 0.2, None)
+    kinds = [a["anomaly"] for a in mon.anomalies]
+    assert "stalled_convergence" in kinds
+    assert "bp_iteration_drift" in kinds
+
+
+def test_monitor_substrate_mismatch_on_partial_degrade():
+    telemetry.enable()
+    mon = diagnostics.SweepMonitor()
+    telemetry.add_sink(mon)
+    try:
+        telemetry.event("degrade", rung="packed->dense")
+        mon.note_cell(_cell_key(0.02), 0.01,
+                      diagnostics.ci_fields(10, 1000))
+        mon.note_cell(_cell_key(0.04), 0.02,
+                      diagnostics.ci_fields(20, 1000))
+    finally:
+        telemetry.remove_sink(mon)
+    mon.finalize()
+    kinds = [a["anomaly"] for a in mon.anomalies]
+    assert "ladder_degrade" in kinds
+    assert "substrate_mismatch" in kinds
+    ladder = next(a for a in mon.anomalies
+                  if a["anomaly"] == "ladder_degrade")
+    assert ladder["cell"]["p"] == 0.02  # names the cell...
+    assert "packed->dense" in ladder["rungs"]  # ...and the rung
+
+
+# ---------------------------------------------------------------------------
+# forced ladder step through a REAL sweep (satellite)
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_forced_ladder_step_raises_grid_visible_anomaly(tmp_path):
+    """A fault-injected ladder step inside one cell of a CodeFamily sweep
+    must surface as a grid-visible anomaly event naming the cell and the
+    substrate rung (ISSUE 7 satellite)."""
+    fam = _family()
+    key_p = [0.02, 0.06]
+    clean = fam.EvalWER("data", "Total", key_p, num_samples=64,
+                        if_plot=False, fused=False)
+    # two transient faults at the data engine's WER entry: with
+    # degrade_after=1 the first failure steps packed->dense, and the cell
+    # then completes on the fallback substrate (bit-exact rung)
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="wer.data", kind="raise", count=2),
+    ])
+    pol = resilience.RetryPolicy(max_attempts=4, base_delay=0.0,
+                                 jitter=0.0, reset_caches=False,
+                                 degrade_after=1)
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        with resilience.policy_override(pol), plan.active():
+            with telemetry.session(reset_metrics=True) as reg:
+                faulted = _family().EvalWER(
+                    "data", "Total", key_p, num_samples=64,
+                    if_plot=False, fused=False)
+                snap = reg.snapshot()
+    finally:
+        telemetry.remove_sink(sink)
+    assert np.array_equal(faulted, clean)  # the rung is bit-exact
+    anomalies = [r for r in sink.records if r["kind"] == "anomaly"]
+    ladder = [a for a in anomalies if a["anomaly"] == "ladder_degrade"]
+    assert ladder, f"no ladder anomaly in {[a['anomaly'] for a in anomalies]}"
+    assert ladder[0]["cell"]["code"] == "hgp_rep3"
+    assert ladder[0]["cell"]["p"] == key_p[0]
+    assert "packed->dense" in ladder[0]["rungs"]
+    # only one of the two cells degraded -> the grid is substrate-mixed
+    assert [a for a in anomalies if a["anomaly"] == "substrate_mismatch"]
+    assert snap["diag.anomaly.ladder_degrade"]["value"] >= 1
+    _assert_all_events_valid(sink.records)
+
+
+def test_fused_bucket_degrade_labels_every_cell():
+    """One device run serves every cell of a fused bucket: a ladder step
+    during it must label ALL the bucket's cells (one bucket-level anomaly,
+    no spurious substrate_mismatch from a half-labeled bucket)."""
+    telemetry.enable()
+    with diagnostics.sweep_run({"grid": "fused"}) as run:
+        diagnostics.notify_degrade("packed->dense")
+        rungs = diagnostics.drain_degrade_rungs()
+        assert rungs == ["packed->dense"]
+        cells = [_cell_key(0.02), _cell_key(0.04)]
+        diagnostics.report_ladder_anomaly(cells, rungs)
+        for ck, f in zip(cells, (10, 20)):
+            diagnostics.record_cell(ck, f / 1000,
+                                    diagnostics.ci_fields(f, 1000),
+                                    rungs=rungs)
+        mon = run.monitor
+    kinds = [a["anomaly"] for a in mon.anomalies]
+    assert kinds.count("ladder_degrade") == 1  # one bucket-level anomaly
+    ladder = next(a for a in mon.anomalies
+                  if a["anomaly"] == "ladder_degrade")
+    assert len(ladder["cells"]) == 2  # ...naming every cell it served
+    # every cell carries the substrate -> uniform grid, no mismatch alarm
+    assert all(c.get("substrate") == "packed->dense" for c in mon.cells)
+    assert "substrate_mismatch" not in kinds
+
+
+@pytest.mark.faults
+def test_ledger_only_run_still_flags_ladder_anomaly(tmp_path):
+    """Ledger-only mode (telemetry DISABLED): ladder steps reach the grid
+    monitor via the direct resilience->diagnostics notification, not the
+    (dead) event stream, so the ledger record still carries the
+    anomaly."""
+    assert not telemetry.enabled()
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="wer.data", kind="raise", count=2),
+    ])
+    pol = resilience.RetryPolicy(max_attempts=4, base_delay=0.0,
+                                 jitter=0.0, reset_caches=False,
+                                 degrade_after=1)
+    led = str(tmp_path / "ledger")
+    with resilience.policy_override(pol), plan.active():
+        _family().EvalWER("data", "Total", [0.02, 0.06], num_samples=64,
+                          if_plot=False, fused=False, ledger=led)
+    recs = diagnostics.load_ledger(led)
+    assert recs and recs[-1]["complete"] is True
+    kinds = [a["anomaly"] for a in recs[-1]["anomalies"]]
+    assert "ladder_degrade" in kinds
+    assert "substrate_mismatch" in kinds
+    assert all("ci_low" in c for c in recs[-1]["cells"])
+
+
+def test_aborted_sweep_marked_incomplete_and_drift_skips_it(tmp_path):
+    """A sweep that raises mid-grid still appends its ledger record, but
+    marked complete: false with the error — and drift compares skip it
+    instead of gating against a truncated run."""
+    dash = importlib.import_module("scripts.sweep_dashboard")
+    led = diagnostics.RunLedger(str(tmp_path))
+    with diagnostics.sweep_run({"grid": 1}, ledger=led):
+        diagnostics.record_cell(_cell_key(0.02), 0.01,
+                                diagnostics.ci_fields(10, 1000))
+    with pytest.raises(RuntimeError, match="boom"):
+        with diagnostics.sweep_run({"grid": 1}, ledger=led):
+            diagnostics.record_cell(_cell_key(0.02), 0.08,
+                                    diagnostics.ci_fields(80, 1000))
+            raise RuntimeError("boom")
+    recs = led.load()
+    assert recs[0]["complete"] is True
+    assert recs[1]["complete"] is False and "boom" in recs[1]["error"]
+    assert dash.drift_report(recs) is None  # one complete run: no pair
+    # CI bootstrap semantics: nothing to gate yet -> --gate passes (0),
+    # while a bare --drift query still reports failure (1)
+    assert dash.main([str(tmp_path), "--drift", "--gate", "3"]) == 0
+    assert dash.main([str(tmp_path), "--drift"]) == 1
+    with diagnostics.sweep_run({"grid": 1}, ledger=led):
+        diagnostics.record_cell(_cell_key(0.02), 0.011,
+                                diagnostics.ci_fields(11, 1000))
+    report = dash.drift_report(led.load())
+    # pairs with the FIRST run, skipping the aborted one in between
+    assert report["prior_run"] == recs[0]["run_id"]
+    assert report["max_abs_z"] < 1.0
+
+
+# ---------------------------------------------------------------------------
+# fit diagnostics
+# ---------------------------------------------------------------------------
+def test_fit_distance_report_diagnostics_and_bootstrap():
+    rng = np.random.default_rng(3)
+    p = np.logspace(-3, -2, 6)
+    true_A, true_d = 40.0, 4.0
+    pl = fits.FitDistance(p, true_A, true_d) * rng.normal(1.0, 0.03, p.size)
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        report = fits.fit_distance_report(p, pl, bootstrap=80)
+    finally:
+        telemetry.remove_sink(sink)
+    assert report["converged"] is True
+    assert report["d_eff"] == pytest.approx(true_d, rel=0.1)
+    assert report["d_ci"][0] < true_d < report["d_ci"][1]
+    assert report["r2"] > 0.9
+    assert report["stderr"]["d_eff"] is not None
+    events = [r for r in sink.records if r["kind"] == "fit_report"]
+    assert events and events[-1]["d_eff"] == report["d_eff"]
+    _assert_all_events_valid(sink.records)
+
+
+def test_threshold_fit_report_bootstrap_ci_contains_truth():
+    # synthetic family generated FROM the fit ansatz with mild noise
+    rng = np.random.default_rng(7)
+    true_pc = 0.04
+    p = np.linspace(0.016, 0.032, 6)
+    d_list = [3.0, 5.0]
+    pl = np.array([
+        fits.EmpericalFit((p, d), true_pc, 0.1)
+        * rng.normal(1.0, 0.05, p.size)
+        for d in d_list
+    ])
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        report = fits.threshold_fit_report(p, pl, bootstrap=100)
+        pc_legacy = fits.ThresholdEst_extrapolation(p, pl, verbose=False)
+    finally:
+        telemetry.remove_sink(sink)
+    assert report["converged"] is True
+    assert report["p_c"] == pytest.approx(true_pc, rel=0.15)
+    assert report["pc_ci"][0] < report["p_c"] < report["pc_ci"][1]
+    assert len(report["d_per_code"]) == 2
+    # legacy surface unchanged: ThresholdEst returns the same point estimate
+    assert pc_legacy == pytest.approx(report["p_c"], abs=1e-12)
+    _assert_all_events_valid(sink.records)
+
+
+def test_threshold_fit_forwards_sigma_and_bootstrap_to_distance_fits():
+    """An explicit bootstrap count and per-cell sigma reach the per-code
+    distance fits (and the bootstrap replicates refit the same weighted
+    estimator as the point fit)."""
+    rng = np.random.default_rng(11)
+    p = np.linspace(0.016, 0.032, 6)
+    pl = np.array([
+        fits.EmpericalFit((p, d), 0.04, 0.1) * rng.normal(1.0, 0.05, p.size)
+        for d in (3.0, 5.0)
+    ])
+    sigma = 0.1 * pl + 1e-6
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        report = fits.threshold_fit_report(p, pl, sigma=sigma, bootstrap=30)
+    finally:
+        telemetry.remove_sink(sink)
+    assert report["bootstrap"] == 30 and "pc_ci" in report
+    assert "chi2" in report  # sigma-weighted goodness-of-fit present
+    dist = [r for r in sink.records if r["kind"] == "fit_report"
+            and r["fit"] == "distance"]
+    assert len(dist) == 2
+    for r in dist:
+        assert r["bootstrap"] == 30 and "d_ci" in r
+        assert "chi2" in r
+
+
+def test_failed_fit_emits_converged_false_fit_report():
+    """scipy's max-iteration failure path must be machine-visible as a
+    structured fit_report with converged: false, not just a raised line
+    (ISSUE 7 satellite)."""
+    p = np.logspace(-3, -2, 6)
+    pl = fits.FitDistance(p, 40.0, 4.0)
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        with pytest.raises(RuntimeError, match="maxfev"):
+            fits.fit_distance_report(p, pl, bootstrap=0, maxfev=1)
+    finally:
+        telemetry.remove_sink(sink)
+    reports = [r for r in sink.records if r["kind"] == "fit_report"]
+    assert len(reports) == 1
+    assert reports[0]["converged"] is False
+    assert "maxfev" in reports[0]["error"]
+    assert telemetry.snapshot()["fits.failed"]["value"] == 1
+    _assert_all_events_valid(sink.records)
+
+
+# ---------------------------------------------------------------------------
+# run ledger + drift
+# ---------------------------------------------------------------------------
+def _synthetic_ledger_record(run_id, fingerprint, failures):
+    cells = []
+    for p, f in zip([0.02, 0.04], failures):
+        cells.append({"cell": _cell_key(p), "wer": f / 1000,
+                      **diagnostics.ci_fields(f, 1000)})
+    return {"v": 1, "run_id": run_id, "ts": 0.0, "fingerprint": fingerprint,
+            "config": {}, "cells": cells, "fits": [], "anomalies": []}
+
+
+def test_ledger_round_trip_and_fingerprint_stability(tmp_path):
+    led = diagnostics.RunLedger(str(tmp_path / "ledger"))
+    led.append(_synthetic_ledger_record("r1", "fp", [10, 20]))
+    led.append(_synthetic_ledger_record("r2", "fp", [12, 21]))
+    recs = led.load()
+    assert [r["run_id"] for r in recs] == ["r1", "r2"]
+    # fingerprint: float formatting must not matter, config content must
+    cfg = {"p_list": [0.02, 0.04], "codes": ["a"]}
+    assert diagnostics.config_signature(cfg) == \
+        diagnostics.config_signature({"codes": ["a"],
+                                        "p_list": [0.020000000000000004 - 4e-18,
+                                                   0.04]})
+    assert diagnostics.config_signature(cfg) != \
+        diagnostics.config_signature({**cfg, "codes": ["b"]})
+
+
+def test_dashboard_drift_compare_and_gate(tmp_path):
+    dash = importlib.import_module("scripts.sweep_dashboard")
+    led = diagnostics.RunLedger(str(tmp_path))
+    led.append(_synthetic_ledger_record("r1", "fp", [10, 20]))
+    led.append(_synthetic_ledger_record("rX", "OTHER", [10, 20]))
+    led.append(_synthetic_ledger_record("r2", "fp", [80, 21]))
+    report = dash.drift_report(led.load())
+    # matches against r1 (same fingerprint), skipping the OTHER-config run
+    assert report["prior_run"] == "r1" and report["now_run"] == "r2"
+    z_by_p = {r["cell"][3]: r["z"] for r in report["cells"]}
+    assert abs(z_by_p[0.04]) < 1.0  # 20 -> 21 failures: noise
+    assert z_by_p[0.02] > 5.0       # 10 -> 80 failures: drift
+    assert report["max_abs_z"] == pytest.approx(z_by_p[0.02])
+    text = dash.render_drift(report)
+    assert "r1 -> r2" in text
+    # CLI gate: exit 1 beyond the z threshold, 0 within
+    assert dash.main([str(tmp_path), "--drift", "--gate", "3"]) == 1
+    assert dash.main([str(tmp_path), "--drift", "--gate", "100"]) == 0
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report --follow
+# ---------------------------------------------------------------------------
+def test_follow_reader_consumes_only_complete_lines(tmp_path):
+    report = importlib.import_module("scripts.telemetry_report")
+    path = str(tmp_path / "run.jsonl")
+    reader = report.FollowReader(path)
+    assert reader.poll() == []  # not created yet
+    with open(path, "w") as fh:
+        fh.write(json.dumps({"kind": "wer_run", "ts": 1.0}) + "\n")
+        fh.write('{"kind": "hea')  # torn tail mid-flush
+    first = reader.poll()
+    assert [e["kind"] for e in first] == ["wer_run"]
+    with open(path, "a") as fh:
+        fh.write('rtbeat", "ts": 2.0}\n')
+    second = reader.poll()
+    assert [e["kind"] for e in second] == ["heartbeat"]
+    assert reader.poll() == []
+    # the follow loop renders incrementally without waiting for run end
+    import io
+
+    out = io.StringIO()
+    assert report.follow(path, interval=0.0, out=out, max_polls=2) == 0
+    assert "telemetry report" in out.getvalue()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end acceptance: fused sweep with ledger, bit-exact on/off
+# ---------------------------------------------------------------------------
+def test_e2e_fused_sweep_ledger_dashboard_bitexact(tmp_path):
+    """The ISSUE 7 acceptance path: a small fused CodeFamily sweep with
+    the ledger enabled yields (a) cell events whose Wilson intervals match
+    the closed-form reference to 1e-12, (b) threshold fit_report with
+    bootstrap CI on p_c, (d) the dashboard rendering from the ledger/JSONL
+    alone — with WER bit-exact diagnostics-on vs off.  (The injected
+    ladder fault, (c), is test_forced_ladder_step_raises_grid_visible_
+    anomaly above.)"""
+    from qldpc_fault_tolerance_tpu.utils.checkpoint import SweepCheckpoint
+
+    codes = [hgp(rep_code(3), rep_code(3), name="hgp_rep3"),
+             hgp(rep_code(4), rep_code(4), name="hgp_rep4")]
+    p_list = [0.02, 0.06]
+    wer_off = _family(codes).EvalWER("data", "Total", p_list,
+                                     num_samples=64, if_plot=False)
+
+    jsonl = str(tmp_path / "run.jsonl")
+    ledger_dir = str(tmp_path / "ledger")
+    ckpt = SweepCheckpoint(str(tmp_path / "sweep_ckpt.jsonl"))
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        with telemetry.session(jsonl):
+            wer_on = _family(codes).EvalWER(
+                "data", "Total", p_list, num_samples=64, if_plot=False,
+                ledger=ledger_dir, checkpoint=ckpt)
+    finally:
+        telemetry.remove_sink(sink)
+    # diagnostics are host-side bookkeeping only: WER bit-exact on vs off
+    assert np.array_equal(wer_on, wer_off)
+
+    # (a) every cell event carries a Wilson interval matching the
+    # closed-form reference to 1e-12
+    cell_dones = [r for r in sink.records if r["kind"] == "cell_done"]
+    assert len(cell_dones) == len(codes) * len(p_list)
+    for e in cell_dones:
+        assert {"failures", "shots", "ci_low", "ci_high"} <= set(e)
+        lo, hi = diagnostics.wilson_interval(e["failures"], e["shots"])
+        assert abs(e["ci_low"] - lo) < 1e-12
+        assert abs(e["ci_high"] - hi) < 1e-12
+    # live per-cell publishing at the existing syncs: cell_progress events
+    # (the checkpointed fused run streams per-megabatch) + interval gauges
+    progress_events = [r for r in sink.records
+                       if r["kind"] == "cell_progress"]
+    assert progress_events
+    assert progress_events[-1]["ci_low"]
+    # checkpoint cursors carry intervals too (additive keys)
+    with open(ckpt.path) as fh:
+        progress_lines = [json.loads(line) for line in fh
+                          if '"progress"' in line]
+    assert progress_lines
+    assert "ci_low" in progress_lines[-1]["progress"]
+    # every emitted event validates against the schema registry
+    _assert_all_events_valid(sink.records)
+
+    # ledger record: per-cell counts + CIs, fingerprint, anomalies list
+    recs = diagnostics.load_ledger(ledger_dir)
+    assert len(recs) == 1
+    assert len(recs[0]["cells"]) == len(codes) * len(p_list)
+    assert all("ci_low" in c for c in recs[0]["cells"])
+
+    # (d) dashboard renders the grid from the ledger alone and from the
+    # JSONL sink alone — no live process
+    dash = importlib.import_module("scripts.sweep_dashboard")
+    for source in (ledger_dir, jsonl):
+        text = dash.render_grid(dash.build_grid(dash.load_lines(
+            dash.resolve_path(source))))
+        assert "hgp_rep3" in text and "hgp_rep4" in text
+        assert "p=0.02" in text and "p=0.06" in text
+        assert "2e-01" in text or "e-0" in text  # a rendered WER
+
+    # (b) a threshold fit over the same family emits a fit_report with a
+    # bootstrap CI on p_c, landing in the SAME ledger as its grid
+    sink2 = telemetry.MemorySink()
+    telemetry.add_sink(sink2)
+    try:
+        with telemetry.session(reset_metrics=True):
+            pc = _family(codes).EvalThreshold(
+                "data", "Total", "extrapolation", est_threshold=0.07,
+                num_samples=64, ledger=ledger_dir)
+    finally:
+        telemetry.remove_sink(sink2)
+    assert 0 < pc
+    fit_events = [r for r in sink2.records if r["kind"] == "fit_report"
+                  and r["fit"] == "threshold"]
+    assert fit_events and "pc_ci" in fit_events[-1]
+    assert fit_events[-1]["pc_ci"][0] <= fit_events[-1]["p_c"] \
+        <= fit_events[-1]["pc_ci"][1]
+    _assert_all_events_valid(sink2.records)
+    recs = diagnostics.load_ledger(ledger_dir)
+    assert len(recs) == 2
+    assert any(f.get("fit") == "threshold" for f in recs[-1]["fits"])
+
+
+def test_wer_run_event_and_heartbeat_enriched():
+    code = hgp(rep_code(3), rep_code(3))
+    p = 0.05
+    dec = lambda h: BPDecoder(h, np.full(code.N, p), max_iter=6)  # noqa: E731
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        sim = CodeSimulator_DataError(
+            code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
+            pauli_error_probs=[p / 3] * 3, batch_size=32, seed=0)
+        sim.WordErrorRate(64)
+    finally:
+        telemetry.remove_sink(sink)
+    runs = [r for r in sink.records if r["kind"] == "wer_run"]
+    assert runs and "ci_low" in runs[-1] and "rse" in runs[-1]
+    lo, hi = diagnostics.wilson_interval(runs[-1]["failures"],
+                                         runs[-1]["shots"])
+    assert runs[-1]["ci_low"] == pytest.approx(lo, abs=1e-15)
+    hbs = [r for r in sink.records if r["kind"] == "heartbeat"]
+    assert hbs and "rse" in hbs[-1]
+    _assert_all_events_valid(sink.records)
+
+
+def test_diagnostics_disabled_is_plain():
+    """Forced-off diagnostics under enabled telemetry: no ci fields on
+    events, no monitor, no ledger side effects — the bench A/B's off arm."""
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        telemetry.enable()
+        diagnostics.disable()
+        assert not diagnostics.active()
+        _family().EvalWER("data", "Total", [0.04], num_samples=64,
+                          if_plot=False)
+    finally:
+        diagnostics.auto()
+        telemetry.remove_sink(sink)
+    cell_dones = [r for r in sink.records if r["kind"] == "cell_done"]
+    assert cell_dones and "ci_low" not in cell_dones[-1]
+    assert not [r for r in sink.records if r["kind"] == "ledger"]
+
+
+def test_no_ledger_dir_side_effect_by_default(tmp_path, monkeypatch):
+    """Without a ledger= knob or QLDPC_LEDGER_DIR, no ledger/ dir appears
+    — enabling telemetry must not write to the working tree."""
+    monkeypatch.chdir(tmp_path)
+    monkeypatch.delenv("QLDPC_LEDGER_DIR", raising=False)
+    telemetry.enable()
+    _family().EvalWER("data", "Total", [0.04], num_samples=64,
+                      if_plot=False)
+    assert not os.path.exists(tmp_path / "ledger")
