@@ -17,6 +17,8 @@ Layers (bottom to top):
   sim/       Monte-Carlo engines (data / phenom / phenom-ST / circuit / circuit-ST)
   parallel/  device-mesh sharding of the shot/grid axes
   sweep/     code-family orchestration, threshold & distance fits
+  serve/     decode-as-a-service: persistent AOT sessions, continuous
+             batching, asyncio front-end
   compat/    drop-in shims for the reference module/API names
 """
 
@@ -114,7 +116,7 @@ __all__ = ["codes", "__version__"]
 def __getattr__(name):
     # heavier subpackages (jit compilation, scipy) load lazily
     if name in ("ops", "noise", "decoders", "circuits", "sim", "parallel",
-                "sweep", "compat", "utils"):
+                "serve", "sweep", "compat", "utils"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

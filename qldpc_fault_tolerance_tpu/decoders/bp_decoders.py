@@ -26,6 +26,7 @@ from ..ops import bp
 from .osd import osd_postprocess
 
 __all__ = [
+    "device_syndrome_width",
     "BPDecoder",
     "BPOSD_Decoder",
     "FirstMinBPDecoder",
@@ -174,6 +175,19 @@ def decode_device(static, state, syndromes):
 
 
 _decode_device_jit = jax.jit(decode_device, static_argnums=0)
+
+
+def device_syndrome_width(static, state) -> int:
+    """Columns of the syndrome batch a value-based decode program consumes —
+    what the serving layer (serve/session.py) sizes its padded request
+    buckets by.  Defined here because it knows the static layouts: the
+    space-time wrapper flattens ``num_rep`` detector slices into one row;
+    every other kind reads the check count off the Tanner graph in
+    ``state`` (bposd_dev / firstmin states carry the same ``graph`` leaf)."""
+    if static[0] == "st_syndrome":
+        _, num_rep, m, _n, _inner = static
+        return int(num_rep) * int(m)
+    return int(state["graph"].chk_mask.shape[0])
 
 
 def _maybe_pallas_head(bp_method: str, graph_host):

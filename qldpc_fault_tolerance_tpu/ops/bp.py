@@ -66,26 +66,103 @@ class _LruCache:
     turns per-cell decoder construction from seconds (host rebuild + device
     uploads over a tunneled chip) into a dict hit.  Bounded so long-lived
     multi-circuit sweeps don't pin retired structures (per advisor note on
-    the FrameSampler cache)."""
+    the FrameSampler cache).
+
+    Thread-safe with per-key single-flight builds: the decode service
+    (serve/) hits these memos from concurrent request paths
+    (``GetDecoderState`` for the same H from many sessions), where an
+    unguarded ``OrderedDict`` mutation can corrupt the map or build the
+    same key twice.  Concurrent first requests for ONE key build it
+    exactly once (losers wait on the builder); builds for DIFFERENT keys
+    overlap — the map lock is never held across ``make()``, so a
+    multi-code service cold start doesn't serialize seconds-long graph
+    builds behind each other.  ``make()`` must not recursively request
+    its own key (builds may consult OTHER caches freely; the device graph
+    builder calling the host graph builder crosses cache instances)."""
 
     def __init__(self, maxsize: int = 128):
+        import threading
         from collections import OrderedDict
 
         self._d = OrderedDict()
+        self._lock = threading.Lock()
+        self._building: dict = {}  # key -> Event set when the build lands
+        self._gen = 0  # bumped by clear(); stale in-flight builds don't cache
         self.maxsize = maxsize
+        # optional (key, value) callback on LRU eviction — the serve-layer
+        # SessionCache counts/announces evicted sessions through it
+        self.on_evict = None
 
     def get(self, key, make):
+        import threading
+
+        while True:
+            with self._lock:
+                try:
+                    self._d.move_to_end(key)
+                    return self._d[key]
+                except KeyError:
+                    pass
+                waiter = self._building.get(key)
+                if waiter is None:
+                    waiter = self._building[key] = threading.Event()
+                    gen = self._gen
+                    break  # this thread builds
+            # another thread is building this key: wait, then re-check (a
+            # failed build leaves the map empty and the loop retries here)
+            waiter.wait()
         try:
+            val = make()
+        except BaseException:
+            with self._lock:
+                self._building.pop(key, None)
+            waiter.set()
+            raise
+        evicted = None
+        with self._lock:
+            # a clear() (reset_device_state after a worker restart) that
+            # landed mid-build invalidates this value — its device buffers
+            # may live on the dead worker; hand it to THIS caller (whose
+            # enclosing retry re-resolves anyway) but never cache it
+            if self._gen == gen:
+                self._d[key] = val
+                self._d.move_to_end(key)
+                if len(self._d) > self.maxsize:
+                    evicted = self._d.popitem(last=False)
+            self._building.pop(key, None)
+        waiter.set()
+        # the hook runs OUTSIDE the lock (the map lock is never held
+        # across user code): hook I/O must not stall concurrent lookups,
+        # and a hook touching this cache must not deadlock
+        if evicted is not None and self.on_evict is not None:
+            try:
+                self.on_evict(*evicted)
+            except Exception:  # a hook must not poison the memo
+                pass
+        return val
+
+    def peek(self, key):
+        """Existing entry (LRU-touched), or KeyError — never builds."""
+        with self._lock:
             self._d.move_to_end(key)
             return self._d[key]
-        except KeyError:
-            val = self._d[key] = make()
-            if len(self._d) > self.maxsize:
-                self._d.popitem(last=False)
-            return val
+
+    def keys(self):
+        with self._lock:
+            return list(self._d)
+
+    def __len__(self):
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key):
+        with self._lock:
+            return key in self._d
 
     def clear(self):
-        self._d.clear()
+        with self._lock:
+            self._d.clear()
+            self._gen += 1
 
 
 _graph_host_cache = _LruCache()

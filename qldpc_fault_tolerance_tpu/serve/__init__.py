@@ -1,0 +1,48 @@
+"""Decode-as-a-service: persistent sessions + continuous batching + an
+asyncio front-end (ISSUE 8 / ROADMAP open item 1).
+
+The offline stack runs sweeps that rebuild device programs per run; this
+subsystem turns the same library pieces — value-based decode programs
+(decoders.bp_decoders.decode_device), the per-H build memos (ops/bp), the
+resilience retry/watchdog layer, the telemetry registry — into a
+request-driven decoder service:
+
+  session.py    DecodeSession / SessionCache: AOT-compiled decode programs
+                per (H, shape-bucket), persistently cached — warm requests
+                perform zero retraces.
+  scheduler.py  ContinuousBatcher: coalesces requests across tenants into
+                padded megabatches with deadline-aware flush and
+                round-robin fairness; graceful drain.
+  server.py     asyncio TCP front-end (length-prefixed JSON frames),
+                streamed per-request responses, drain-on-shutdown.
+  client.py     blocking pipelined client (the bench load generator).
+
+``bench.py serve`` (BENCH_MODE=serve) measures sustained QPS and p50/p99
+latency under a mixed-code multi-tenant request storm; the ``serve.*``
+telemetry surface is rendered by scripts/telemetry_report.py and
+scripts/sweep_dashboard.py.
+"""
+from .session import (
+    DEFAULT_BUCKETS,
+    DecodeOutput,
+    DecodeSession,
+    SessionCache,
+)
+from .scheduler import ContinuousBatcher, DecodeResult, assemble_round_robin
+from .server import DecodeServer, ServerHandle, start_server_thread
+from .client import ClientResult, DecodeClient
+
+__all__ = [
+    "DEFAULT_BUCKETS",
+    "DecodeOutput",
+    "DecodeSession",
+    "SessionCache",
+    "ContinuousBatcher",
+    "DecodeResult",
+    "assemble_round_robin",
+    "DecodeServer",
+    "ServerHandle",
+    "start_server_thread",
+    "ClientResult",
+    "DecodeClient",
+]

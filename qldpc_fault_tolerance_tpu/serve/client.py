@@ -1,0 +1,190 @@
+"""Blocking decode-service client with pipelined submits.
+
+A thin stdlib-socket counterpart to serve/server.py's protocol: ``submit``
+sends a decode frame and returns a future immediately (responses stream
+back in completion order and are matched by id on a background reader
+thread), so a load generator keeps a window of requests in flight without
+one connection per request.  ``decode`` is the submit+wait convenience.
+
+Latency is measured CLIENT-side (submit to response-parsed), which is the
+number a tail-latency SLO is actually about — it includes the wire, the
+queue wait, the batch fill and the dispatch.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import socket
+import threading
+import time
+import uuid
+from collections import deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from .wire import HEADER, MAX_FRAME_BYTES, encode_frame
+
+__all__ = ["ClientResult", "DecodeClient"]
+
+
+@dataclasses.dataclass
+class ClientResult:
+    corrections: np.ndarray          # (k, n) uint8
+    converged: list | None
+    latency_s: float                 # client-side: submit -> response parsed
+    server_latency_ms: float | None  # scheduler-side, from the response
+    request_id: str
+
+
+class DecodeClient:
+    def __init__(self, host: str, port: int, *, tenant: str = "default",
+                 timeout: float = 60.0):
+        self.tenant = str(tenant)
+        self.timeout = float(timeout)
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._wlock = threading.Lock()
+        self._plock = threading.Lock()
+        self._pending: dict[str, tuple[Future, float]] = {}
+        # ping waiters queue FIFO (pongs come back in order): concurrent
+        # pings from threads sharing one client each get their own future
+        self._pongs: deque[Future] = deque()
+        self._closed = False
+        self._ids = itertools.count()
+        self._prefix = uuid.uuid4().hex[:8]
+        self._reader = threading.Thread(target=self._read_loop, daemon=True,
+                                        name="qldpc-serve-client")
+        self._reader.start()
+
+    # ------------------------------------------------------------------
+    def _send(self, obj) -> None:
+        frame = encode_frame(obj)
+        with self._wlock:
+            self._sock.sendall(frame)
+
+    def _recv_exact(self, n: int) -> bytes | None:
+        buf = b""
+        while len(buf) < n:
+            try:
+                chunk = self._sock.recv(n - len(buf))
+            except socket.timeout:
+                # idle is NOT disconnect: a low-traffic client must keep
+                # its reader alive past the socket timeout (close() breaks
+                # the loop via shutdown -> OSError below)
+                if self._closed:
+                    return None
+                continue
+            except (OSError, ValueError):
+                return None
+            if not chunk:
+                return None
+            buf += chunk
+        return buf
+
+    def _read_loop(self) -> None:
+        while True:
+            head = self._recv_exact(HEADER.size)
+            if head is None:
+                break
+            (length,) = HEADER.unpack(head)
+            if length > MAX_FRAME_BYTES:
+                break  # protocol corruption — fail pending via loop exit
+            body = self._recv_exact(length)
+            if body is None:
+                break
+            try:
+                msg = json.loads(body.decode("utf-8"))
+            except json.JSONDecodeError:
+                continue
+            if msg.get("pong"):
+                with self._plock:
+                    pong = self._pongs.popleft() if self._pongs else None
+                if pong is not None:
+                    pong.set_result(msg)
+                continue
+            rid = msg.get("id")
+            with self._plock:
+                entry = self._pending.pop(rid, None)
+            if entry is None:
+                continue
+            fut, t0 = entry
+            if msg.get("ok"):
+                fut.set_result(ClientResult(
+                    corrections=np.asarray(msg["corrections"], np.uint8),
+                    converged=msg.get("converged"),
+                    latency_s=time.perf_counter() - t0,
+                    server_latency_ms=msg.get("latency_ms"),
+                    request_id=str(rid)))
+            else:
+                fut.set_exception(
+                    RuntimeError(msg.get("error", "decode failed")))
+        # socket gone: fail whatever is still outstanding
+        with self._plock:
+            pending, self._pending = self._pending, {}
+            pongs, self._pongs = list(self._pongs), deque()
+        err = ConnectionError("decode-service connection closed")
+        for fut, _ in pending.values():
+            if not fut.done():
+                fut.set_exception(err)
+        for pong in pongs:
+            if not pong.done():
+                pong.set_exception(err)
+
+    # ------------------------------------------------------------------
+    def submit(self, session: str, syndromes, *,
+               tenant: str | None = None) -> Future:
+        arr = np.atleast_2d(np.asarray(syndromes))
+        rid = f"{self._prefix}-{next(self._ids)}"
+        fut: Future = Future()
+        with self._plock:
+            if self._closed:
+                raise RuntimeError("client closed")
+            self._pending[rid] = (fut, time.perf_counter())
+        try:
+            self._send({"op": "decode", "id": rid, "session": str(session),
+                        "tenant": tenant or self.tenant,
+                        "syndromes": arr.tolist()})
+        except OSError:
+            with self._plock:
+                self._pending.pop(rid, None)
+            raise
+        return fut
+
+    def decode(self, session: str, syndromes, *,
+               tenant: str | None = None) -> ClientResult:
+        return self.submit(session, syndromes,
+                           tenant=tenant).result(timeout=self.timeout)
+
+    def ping(self) -> dict:
+        fut: Future = Future()
+        # register + send atomically under the WRITE lock: pongs match
+        # waiters FIFO, so the waiter-queue order must equal the on-wire
+        # send order (two threads racing between the two steps would
+        # receive each other's pong).  Lock order is _wlock -> _plock;
+        # no other path nests them, so no inversion.
+        with self._wlock:
+            with self._plock:
+                if self._closed:
+                    raise RuntimeError("client closed")
+                self._pongs.append(fut)
+            self._sock.sendall(encode_frame({"op": "ping"}))
+        return fut.result(timeout=self.timeout)
+
+    def close(self) -> None:
+        with self._plock:
+            self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+        self._reader.join(timeout=10.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,370 @@
+"""Continuous-batching scheduler: coalesce decode requests into padded
+megabatches on persistent sessions.
+
+The same shape LLM inference servers use: requests arrive whenever they
+arrive, the dispatcher keeps one queue per (session, tenant) and flushes a
+session's queue into ONE padded device batch when either the **batch-fill**
+threshold (``max_batch_shots``) or the **deadline** (``max_wait_s`` since
+the session's oldest queued request) is reached — small-request tenants pay
+bounded latency, bursty tenants get amortized dispatches, and the chip sees
+full buckets instead of per-request dribbles.
+
+Fairness is round-robin across tenants at assembly time
+(``assemble_round_robin``): a tenant flooding the queue cannot starve the
+others — every flush takes at most its rotating share, and the other
+tenants' requests ride the same batch.
+
+Every dispatch runs under the active resilience policy
+(utils.resilience.run_cell) with a one-rung degradation ladder that
+invalidates + rebuilds the session's compiled programs — the recovery that
+actually helps after a worker restart killed the uploaded graph buffers.
+A dispatch that still fails after retries FAILS the batch's futures (the
+requests are answered, never dropped); ``drain()`` flushes everything left
+before stopping, so shutdown loses nothing either.
+
+SLO observability (utils.telemetry, free when disabled): ``serve.requests``
+/ ``serve.shots`` / ``serve.batches`` / ``serve.errors`` counters (plus
+per-tenant request counters), ``serve.queue_depth`` gauge,
+``serve.latency_s`` / ``serve.batch_occupancy`` / ``serve.batch_wait_s``
+histograms, and ``serve_request`` / ``serve_batch`` / ``serve_drain``
+events in the versioned schema scripts/telemetry_report.py and
+scripts/sweep_dashboard.py render.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+
+import numpy as np
+
+from ..utils import resilience, telemetry
+from .session import OCCUPANCY_BUCKETS, DecodeSession, SessionCache
+
+__all__ = ["DecodeResult", "ContinuousBatcher", "assemble_round_robin"]
+
+
+@dataclasses.dataclass
+class DecodeResult:
+    """What a request's future resolves to."""
+
+    corrections: np.ndarray          # (k, n) uint8 — this request's rows
+    converged: np.ndarray | None     # (k,) bool when the decoder reports it
+    request_id: str | None
+    latency_s: float                 # submit -> completion, scheduler-side
+
+
+@dataclasses.dataclass
+class _Request:
+    request_id: str | None
+    tenant: str
+    session: str
+    syndromes: np.ndarray
+    future: Future
+    t0: float
+
+    @property
+    def shots(self) -> int:
+        return int(self.syndromes.shape[0])
+
+
+class _SessionQueue:
+    """Per-session pending state: one FIFO per tenant + a rotation order."""
+
+    __slots__ = ("tenants", "order", "shots", "oldest_t")
+
+    def __init__(self):
+        self.tenants: "OrderedDict[str, deque[_Request]]" = OrderedDict()
+        self.order: deque[str] = deque()
+        self.shots = 0
+        self.oldest_t: float | None = None
+
+    def add(self, req: _Request) -> None:
+        q = self.tenants.get(req.tenant)
+        if q is None:
+            q = self.tenants[req.tenant] = deque()
+            self.order.append(req.tenant)
+        q.append(req)
+        self.shots += req.shots
+        if self.oldest_t is None or req.t0 < self.oldest_t:
+            self.oldest_t = req.t0
+
+    def empty(self) -> bool:
+        return not self.tenants
+
+
+def assemble_round_robin(queue: _SessionQueue, max_shots: int,
+                         force: bool = False) -> list[_Request]:
+    """Pop one flush's worth of requests, one request per tenant per
+    rotation, until adding the next would exceed ``max_shots`` (the first
+    request always goes in, so an oversize request still dispatches — the
+    session chunks it).  ``force`` ignores the cap (drain).  Pure queue
+    surgery, unit-tested directly for the fairness property: with tenants
+    A(flood) and B(one request), B's request rides the FIRST batch."""
+    batch: list[_Request] = []
+    taken = 0
+    while queue.order:
+        tenant = queue.order[0]
+        q = queue.tenants.get(tenant)
+        if not q:
+            queue.order.popleft()
+            queue.tenants.pop(tenant, None)
+            continue
+        nxt = q[0]
+        if batch and not force and taken + nxt.shots > max_shots:
+            break
+        q.popleft()
+        batch.append(nxt)
+        taken += nxt.shots
+        queue.order.rotate(-1)
+        if not force and taken >= max_shots:
+            break
+    # trim exhausted tenants + refresh the aggregate bookkeeping
+    for tenant in [t for t, q in queue.tenants.items() if not q]:
+        queue.tenants.pop(tenant)
+        try:
+            queue.order.remove(tenant)
+        except ValueError:
+            pass
+    queue.shots -= taken
+    queue.oldest_t = min(
+        (q[0].t0 for q in queue.tenants.values() if q), default=None)
+    return batch
+
+
+class ContinuousBatcher:
+    """The dispatcher: one daemon worker thread draining per-session queues
+    into padded megabatches on the persistent sessions.
+
+    ``sessions``: a ``SessionCache``, or a dict name -> DecodeSession
+    (wrapped).  ``submit`` returns a ``concurrent.futures.Future`` that
+    resolves to a ``DecodeResult`` (asyncio callers wrap it with
+    ``asyncio.wrap_future`` — that is exactly what serve/server.py does).
+    """
+
+    def __init__(self, sessions, *, max_batch_shots: int = 1024,
+                 max_wait_s: float = 0.002):
+        if isinstance(sessions, dict):
+            cache = SessionCache(max_sessions=max(8, len(sessions)))
+            for s in sessions.values():
+                cache.add(s)
+            sessions = cache
+        self.sessions: SessionCache = sessions
+        self.max_batch_shots = max(1, int(max_batch_shots))
+        self.max_wait_s = float(max_wait_s)
+        self._cv = threading.Condition()
+        self._pending: dict[str, _SessionQueue] = {}
+        self._queued_requests = 0
+        self._draining = False
+        self._stopped = False
+        self.completed = 0
+        self.failed = 0
+        self._drain_emitted = False
+        # per-tenant counter labels are bounded: the tenant string arrives
+        # from the wire, and a unique-tenant-per-request client would
+        # otherwise grow the process-wide metrics registry without limit
+        # in a long-lived service; overflow tenants fold into one label
+        self._tenant_labels: set[str] = set()
+        self.max_tenant_counters = 32
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="qldpc-serve-scheduler")
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    # submission
+    # ------------------------------------------------------------------
+    def submit(self, session: str, syndromes, *, tenant: str = "default",
+               request_id: str | None = None) -> Future:
+        """Enqueue one decode request; returns its future.  Validation
+        (unknown session, wrong width, empty batch) raises HERE, on the
+        caller's thread, so the queue only ever holds dispatchable work."""
+        sess = self.sessions.get(str(session))
+        arr = np.atleast_2d(np.asarray(syndromes, dtype=np.uint8))
+        if arr.ndim != 2 or arr.shape[0] == 0:
+            raise ValueError(f"syndromes must be (B, m), got {arr.shape}")
+        if arr.shape[1] != sess.syndrome_width:
+            raise ValueError(
+                f"session {session!r} decodes width {sess.syndrome_width}, "
+                f"got {arr.shape[1]}")
+        req = _Request(request_id=request_id, tenant=str(tenant),
+                       session=str(session), syndromes=arr,
+                       future=Future(), t0=time.perf_counter())
+        with self._cv:
+            if self._stopped or self._draining:
+                raise RuntimeError("scheduler is draining/stopped")
+            self._pending.setdefault(req.session, _SessionQueue()).add(req)
+            self._queued_requests += 1
+            if req.tenant not in self._tenant_labels:
+                if len(self._tenant_labels) < self.max_tenant_counters:
+                    self._tenant_labels.add(req.tenant)
+            label = (req.tenant if req.tenant in self._tenant_labels
+                     else "__other__")
+            telemetry.set_gauge("serve.queue_depth", self._queued_requests)
+            self._cv.notify()
+        telemetry.count("serve.requests")
+        telemetry.count("serve.shots", req.shots)
+        telemetry.count(f"serve.tenant.{label}.requests")
+        return req.future
+
+    # ------------------------------------------------------------------
+    # worker
+    # ------------------------------------------------------------------
+    def _pick_locked(self, now: float, force: bool):
+        """Choose (session name, flush batch) under the lock, or None.
+        Flushable: batch-fill reached, deadline passed, or ``force``
+        (drain).  Among flushable sessions the oldest queued request wins
+        (FIFO across sessions)."""
+        best, best_t = None, None
+        for name, q in self._pending.items():
+            if q.empty():
+                continue
+            due = (force or q.shots >= self.max_batch_shots
+                   or (q.oldest_t is not None
+                       and now - q.oldest_t >= self.max_wait_s))
+            if due and (best_t is None or q.oldest_t < best_t):
+                best, best_t = name, q.oldest_t
+        if best is None:
+            return None
+        q = self._pending[best]
+        batch = assemble_round_robin(q, self.max_batch_shots, force=force)
+        if q.empty():
+            self._pending.pop(best, None)
+        return best, batch
+
+    def _next_deadline(self) -> float | None:
+        ts = [q.oldest_t for q in self._pending.values()
+              if q.oldest_t is not None]
+        return (min(ts) + self.max_wait_s) if ts else None
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    if self._stopped:
+                        return
+                    now = time.perf_counter()
+                    picked = self._pick_locked(now, force=self._draining)
+                    if picked is not None:
+                        self._queued_requests -= len(picked[1])
+                        telemetry.set_gauge("serve.queue_depth",
+                                            self._queued_requests)
+                        break
+                    if self._draining and not self._pending:
+                        self._stopped = True
+                        self._cv.notify_all()
+                        return
+                    deadline = self._next_deadline()
+                    timeout = (None if deadline is None
+                               else max(0.0, deadline - now))
+                    self._cv.wait(timeout)
+            self._dispatch(*picked)
+
+    def _dispatch(self, session_name: str, batch: list[_Request]) -> None:
+        synd = (batch[0].syndromes if len(batch) == 1
+                else np.concatenate([r.syndromes for r in batch]))
+        wait_s = time.perf_counter() - min(r.t0 for r in batch)
+        t0 = time.perf_counter()
+        try:
+            # the lookup lives INSIDE the guard: a session evicted between
+            # submit and flush must fail this batch's futures, not kill
+            # the dispatcher thread (which would hang the whole service)
+            sess: DecodeSession = self.sessions.get(session_name)
+            # the recovery rung: repeated transient faults invalidate the
+            # session (programs recompile against freshly uploaded state)
+            # — the rung that matters after a worker restart
+            ladder = resilience.DegradationLadder(
+                [("serve_session_recompile", sess.invalidate)])
+            with telemetry.span("serve.dispatch"):
+                out = resilience.run_cell(lambda: sess.decode(synd),
+                                          label="serve_dispatch",
+                                          degrade=ladder.step)
+        except Exception as exc:  # noqa: BLE001 — answered, not dropped
+            self.failed += len(batch)
+            telemetry.count("serve.errors", len(batch))
+            telemetry.event("serve_batch", session=session_name,
+                            requests=len(batch), shots=int(synd.shape[0]),
+                            bucket=0, ok=False,
+                            error=f"{type(exc).__name__}: {exc}")
+            for r in batch:
+                r.future.set_exception(exc)
+            return
+        dispatch_s = time.perf_counter() - t0
+        occupancy = out.shots / out.padded_shots if out.padded_shots else 0.0
+        now = time.perf_counter()
+        lo = 0
+        for r in batch:
+            hi = lo + r.shots
+            lat = now - r.t0
+            r.future.set_result(DecodeResult(
+                corrections=out.corrections[lo:hi],
+                converged=(None if out.converged is None
+                           else out.converged[lo:hi]),
+                request_id=r.request_id, latency_s=lat))
+            lo = hi
+            self.completed += 1
+            telemetry.observe("serve.latency_s", lat)
+            telemetry.event("serve_request", session=session_name,
+                            tenant=r.tenant, shots=r.shots,
+                            id=(None if r.request_id is None
+                                else str(r.request_id)),
+                            latency_s=round(lat, 6), ok=True)
+        telemetry.count("serve.batches")
+        telemetry.count("serve.padded_shots", out.padded_shots - out.shots)
+        telemetry.observe("serve.batch_occupancy", occupancy,
+                          buckets=OCCUPANCY_BUCKETS)
+        telemetry.observe("serve.batch_wait_s", wait_s)
+        telemetry.event("serve_batch", session=session_name,
+                        requests=len(batch), shots=out.shots,
+                        bucket=int(max(out.buckets)),
+                        occupancy=round(occupancy, 4),
+                        tenants=len({r.tenant for r in batch}),
+                        wait_s=round(wait_s, 6),
+                        dispatch_s=round(dispatch_s, 6), ok=True)
+
+    # ------------------------------------------------------------------
+    # shutdown
+    # ------------------------------------------------------------------
+    def drain(self, timeout: float | None = 60.0) -> None:
+        """Graceful shutdown: stop accepting, flush EVERY queued request
+        (partial batches included), resolve all futures, stop the worker.
+        Idempotent.  A drain that cannot finish within ``timeout`` raises
+        ``TimeoutError`` — returning normally would let the caller tear
+        down connections while requests are still in flight, silently
+        breaking the no-request-dropped guarantee."""
+        with self._cv:
+            self._draining = True
+            if not self._pending and not self._stopped:
+                self._stopped = True
+            self._cv.notify_all()
+        self._thread.join(timeout=timeout)
+        if self._thread.is_alive():
+            telemetry.count("serve.drain_timeouts")
+            raise TimeoutError(
+                f"scheduler drain did not complete within {timeout}s "
+                f"({self._queued_requests} requests still queued/in flight)")
+        # idempotent means ONE serve_drain event too: a cleanup-pattern
+        # second drain() must not double-count shutdowns downstream
+        if not self._drain_emitted:
+            self._drain_emitted = True
+            telemetry.event("serve_drain",
+                            pending_requests=self._queued_requests,
+                            completed=int(self.completed))
+
+    def close(self) -> None:
+        """Abandoning shutdown (tests/errors): fail queued futures instead
+        of running them."""
+        with self._cv:
+            self._stopped = True
+            pending = [r for q in self._pending.values()
+                       for dq in q.tenants.values() for r in dq]
+            self._pending.clear()
+            # the abandoned requests are ANSWERED below, not pending: a
+            # later snapshot / idempotent drain() must not report them
+            self._queued_requests = 0
+            telemetry.set_gauge("serve.queue_depth", 0)
+            self._cv.notify_all()
+        for r in pending:
+            r.future.set_exception(RuntimeError("scheduler closed"))
+        self._thread.join(timeout=10.0)

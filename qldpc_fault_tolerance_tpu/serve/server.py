@@ -1,0 +1,288 @@
+"""Asyncio front-end for the decode service: stdlib TCP, length-prefixed
+JSON frames, streamed per-request responses, graceful drain.
+
+Wire protocol (no dependencies beyond the stdlib):
+
+    frame    := uint32 big-endian payload length | payload
+    payload  := one UTF-8 JSON object
+
+Requests (client -> server):
+    {"op": "decode", "id": <str>, "session": <name>, "tenant": <str>,
+     "syndromes": [[0,1,...], ...]}
+    {"op": "ping"}
+
+Responses (server -> client; decode responses stream back in COMPLETION
+order, matched by "id" — a slow megabatch never head-of-line-blocks a fast
+one):
+    {"id": ..., "ok": true, "corrections": [[...], ...],
+     "converged": [true, ...] | null, "latency_ms": <float>}
+    {"id": ..., "ok": false, "error": "..."}
+    {"ok": true, "pong": true, "sessions": [...], "draining": false}
+
+JSON keeps the protocol inspectable and dependency-free; the frame layer is
+codec-agnostic, so a binary payload (packed bitplanes) is a drop-in when
+wire size ever matters.
+
+``shutdown(drain=True)`` is the graceful path: stop accepting connections,
+reject NEW decode ops with an error response, drain the batcher (every
+accepted request completes and its response is written) and only then close
+— no accepted request is ever dropped (tests/test_serve.py pins this).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+
+import numpy as np
+
+from ..utils import telemetry
+from .scheduler import ContinuousBatcher
+from .wire import HEADER, MAX_FRAME_BYTES, encode_frame
+
+__all__ = ["DecodeServer", "ServerHandle", "start_server_thread",
+           "MAX_FRAME_BYTES", "encode_frame"]
+
+
+async def read_frame(reader: asyncio.StreamReader):
+    """One length-prefixed JSON frame, or None on EOF / disconnect —
+    including a client dropping MID-frame (after the header, before the
+    full body), which must take the clean-disconnect path, not kill the
+    connection task with an unretrieved exception."""
+    try:
+        head = await reader.readexactly(HEADER.size)
+        (length,) = HEADER.unpack(head)
+        if length > MAX_FRAME_BYTES:
+            raise ValueError(f"frame of {length} bytes exceeds the "
+                             f"{MAX_FRAME_BYTES}-byte cap")
+        body = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    return json.loads(body.decode("utf-8"))
+
+
+class DecodeServer:
+    """The asyncio service: accepts connections, feeds decode ops to the
+    ContinuousBatcher, streams responses back per request."""
+
+    def __init__(self, batcher: ContinuousBatcher, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.batcher = batcher
+        self.host = host
+        self.port = int(port)
+        self._server: asyncio.AbstractServer | None = None
+        self._tasks: set[asyncio.Task] = set()
+        self._conns: set[asyncio.Task] = set()
+        self._draining = False
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    # ------------------------------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        task = asyncio.current_task()
+        if task is not None:
+            self._conns.add(task)
+            task.add_done_callback(self._conns.discard)
+        wlock = asyncio.Lock()
+        try:
+            while True:
+                try:
+                    msg = await read_frame(reader)
+                except (ValueError, json.JSONDecodeError) as exc:
+                    await self._write(writer, wlock,
+                                      {"ok": False,
+                                       "error": f"bad frame: {exc}"})
+                    break
+                if msg is None:
+                    break
+                if not isinstance(msg, dict):
+                    # valid JSON but not an object: a structured reply,
+                    # not a dead connection for everything pipelined on it
+                    await self._write(writer, wlock, {
+                        "ok": False,
+                        "error": f"frame must be a JSON object, got "
+                                 f"{type(msg).__name__}"})
+                    continue
+                op = msg.get("op")
+                if op == "ping":
+                    await self._write(writer, wlock, {
+                        "ok": True, "pong": True,
+                        "sessions": self.batcher.sessions.names(),
+                        "draining": self._draining})
+                elif op == "decode":
+                    await self._handle_decode(msg, writer, wlock)
+                else:
+                    await self._write(writer, wlock, {
+                        "id": msg.get("id"), "ok": False,
+                        "error": f"unknown op {op!r}"})
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    async def _handle_decode(self, msg, writer, wlock) -> None:
+        rid = msg.get("id")
+        if self._draining:
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False, "error": "server is draining"})
+            return
+        try:
+            fut = self.batcher.submit(
+                msg["session"],
+                np.asarray(msg["syndromes"], dtype=np.uint8),
+                tenant=str(msg.get("tenant", "default")),
+                request_id=None if rid is None else str(rid))
+        except Exception as exc:  # noqa: BLE001 — answered, not dropped
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False,
+                "error": f"{type(exc).__name__}: {exc}"})
+            return
+        task = asyncio.ensure_future(
+            self._respond(rid, fut, writer, wlock))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _respond(self, rid, fut, writer, wlock) -> None:
+        try:
+            res = await asyncio.wrap_future(fut)
+            payload = {
+                "id": rid, "ok": True,
+                # .tolist() alone yields native ints — no int64 copy
+                "corrections": res.corrections.tolist(),
+                "converged": (None if res.converged is None
+                              else [bool(x) for x in res.converged]),
+                "latency_ms": round(res.latency_s * 1e3, 3),
+            }
+        except Exception as exc:  # noqa: BLE001
+            payload = {"id": rid, "ok": False,
+                       "error": f"{type(exc).__name__}: {exc}"}
+        try:
+            await self._write(writer, wlock, payload)
+        except (ConnectionError, RuntimeError):
+            pass  # client went away; the decode itself completed
+
+    @staticmethod
+    async def _write(writer, wlock, obj) -> None:
+        try:
+            frame = encode_frame(obj)
+        except ValueError as exc:
+            # a response too large for one frame (huge decode batch):
+            # answer the request with a structured error instead of
+            # killing the connection mid-pipeline
+            frame = encode_frame({"id": obj.get("id"), "ok": False,
+                                  "error": str(exc)})
+        async with wlock:
+            writer.write(frame)
+            await writer.drain()
+
+    # ------------------------------------------------------------------
+    async def shutdown(self, drain: bool = True, grace_s: float = 0.25,
+                       drain_timeout: float = 60.0) -> None:
+        """Stop accepting connections; with ``drain``, serve for a short
+        grace window (so request bytes already on the wire still reach the
+        batcher), then flush the batcher so every accepted request's
+        response is written, and only then close the remaining
+        connections.  Requests arriving after the grace window get a
+        structured "draining" error response — answered, never silently
+        dropped."""
+        if self._server is not None:
+            # close() stops accepting immediately; wait_closed() is
+            # deferred to the END — on Python >= 3.12.1 it also waits for
+            # every live connection handler, which are only cancelled
+            # below (awaiting it here would deadlock the graceful path
+            # while pipelined clients stay connected)
+            self._server.close()
+        if drain and grace_s:
+            await asyncio.sleep(grace_s)
+        self._draining = True
+        # both paths block (join the dispatcher thread): run off-loop so
+        # in-flight response tasks keep streaming.  drain flushes every
+        # queued request; the abandon path (drain=False) fails queued
+        # futures IMMEDIATELY and stops the worker — without it the
+        # response-task gather below would sit out the scheduler's
+        # max_wait deadline and the dispatcher thread would leak
+        await asyncio.get_running_loop().run_in_executor(
+            None, ((lambda: self.batcher.drain(timeout=drain_timeout))
+                   if drain else self.batcher.close))
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+        for conn in list(self._conns):
+            conn.cancel()
+        if self._conns:
+            await asyncio.gather(*list(self._conns), return_exceptions=True)
+        if self._server is not None:
+            await self._server.wait_closed()
+        if not drain:
+            # the drained path already emitted its serve_drain from
+            # batcher.drain() (with the real pending/completed counts) —
+            # a second event here would double-count shutdowns downstream
+            telemetry.event("serve_drain", pending_requests=-1,
+                            completed=int(self.batcher.completed))
+
+
+class ServerHandle:
+    """A DecodeServer running on its own event-loop thread (what the bench
+    and tests use — the caller's thread stays free to drive clients)."""
+
+    def __init__(self, server: DecodeServer, loop: asyncio.AbstractEventLoop,
+                 thread: threading.Thread):
+        self.server = server
+        self._loop = loop
+        self._thread = thread
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.server.host, self.server.port)
+
+    def stop(self, drain: bool = True, timeout: float = 60.0) -> None:
+        try:
+            # the batcher's drain deadline is the binding one (it raises
+            # the informative TimeoutError); the outer wait gets headroom
+            # so it cannot fire first and kill a near-deadline drain
+            asyncio.run_coroutine_threadsafe(
+                self.server.shutdown(drain=drain, drain_timeout=timeout),
+                self._loop).result(timeout + 15.0)
+        finally:
+            # even a failed/timed-out drain must tear the loop thread down
+            # — leaving it running would leak the thread and keep client
+            # connections open with no one serving them
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=timeout)
+
+
+def start_server_thread(batcher: ContinuousBatcher, host: str = "127.0.0.1",
+                        port: int = 0) -> ServerHandle:
+    """Start a DecodeServer on a daemon thread; returns once it accepts."""
+    loop = asyncio.new_event_loop()
+    server = DecodeServer(batcher, host=host, port=port)
+    started = threading.Event()
+    box: dict = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        try:
+            try:
+                loop.run_until_complete(server.start())
+            except Exception as exc:  # surface bind failures to the caller
+                box["error"] = exc
+                return
+            started.set()
+            loop.run_forever()
+        finally:
+            started.set()
+            loop.close()  # a failed bind must not leak the loop's fds
+
+    thread = threading.Thread(target=run, daemon=True,
+                              name="qldpc-serve-server")
+    thread.start()
+    if not started.wait(timeout=30.0):
+        raise RuntimeError("decode server failed to start within 30s")
+    if "error" in box:
+        raise box["error"]
+    return ServerHandle(server, loop, thread)

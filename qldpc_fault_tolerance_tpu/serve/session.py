@@ -1,0 +1,323 @@
+"""Persistent decode sessions: build + AOT-compile once, serve forever.
+
+The sweep stack rebuilds its device programs per run — fine for offline
+Monte-Carlo, fatal for a long-lived decoder service where every request
+must hit a warm executable.  ``DecodeSession`` splits "build + compile the
+decode program for an (H, shape-bucket) pair" out of sweep orchestration:
+
+  * construction resolves the decoder's value-based ``(device_static,
+    device_state)`` pair — via a built decoder or a factory's
+    ``GetDecoderState`` (the per-H memos in ops/bp make a warm H a dict
+    hit, and the memo is lock-guarded so concurrent sessions never race a
+    rebuild);
+  * requests are padded up to a small set of shape BUCKETS and run through
+    an **AOT-compiled** executable per (static, bucket) —
+    ``jax.jit(decode_device).lower(...).compile()`` — cached on the
+    session, so the warm path performs **zero retraces** (the PR-2 compile
+    tracker gates this in tests and ``bench.py serve``) and survives
+    ``jax.clear_caches()`` (the resilience layer's between-retry reset);
+  * padding is bit-exact: BP freezes every shot at its own convergence and
+    the OSD/compaction tiers select program PATHS, not per-shot results,
+    so a request's corrections are identical whether it rides alone, in a
+    coalesced megabatch, or in the offline ``WordErrorRate`` pipeline
+    (pinned by tests/test_serve.py).
+
+``SessionCache`` bounds the live-session set (LRU) so a multi-code service
+host doesn't pin retired (H, config) programs forever.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+
+import numpy as np
+
+from ..decoders.bp_decoders import (
+    DecoderClass,
+    _decode_device_jit,
+    device_syndrome_width,
+)
+from ..utils import resilience, telemetry
+
+__all__ = ["DEFAULT_BUCKETS", "DecodeOutput", "DecodeSession", "SessionCache"]
+
+# request batches pad up to the smallest bucket that fits; the ladder is
+# geometric so padding waste is bounded at ~2x worst case and the compiled-
+# program set per session stays small
+DEFAULT_BUCKETS = (32, 64, 128, 256, 512, 1024, 2048, 4096)
+
+# batch-occupancy histogram edges (fraction of the padded bucket that was
+# real request shots)
+OCCUPANCY_BUCKETS = (0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0)
+
+
+@dataclasses.dataclass
+class DecodeOutput:
+    """One served decode: host corrections + per-shot convergence flags
+    (None for decoders without BP aux) + padding accounting."""
+
+    corrections: np.ndarray          # (B, n) uint8
+    converged: np.ndarray | None     # (B,) bool, when the decoder reports it
+    shots: int                       # real request shots decoded
+    padded_shots: int                # total padded shots dispatched
+    buckets: tuple                   # bucket sizes the decode ran as
+
+
+class DecodeSession:
+    """One (H, decoder-config) pair's persistent decode programs.
+
+    ``decoder``: a built pure-device decoder (``device_static`` /
+    ``device_state``; host-postprocess OSD decoders are rejected — their
+    output depends on a host stage the compiled program cannot run).
+    ``decoder_class`` + ``params``: the factory path —
+    ``GetDecoderState(params)`` resolves the pair without building a
+    decoder (the library BP classes serve it from the per-H memo).
+
+    ``decode(syndromes)`` pads the batch to a shape bucket and calls the
+    AOT executable; batches beyond the largest bucket are chunked.  All
+    state is immutable after construction except the program cache, which
+    is lock-guarded (the scheduler thread and warmers may race).
+    """
+
+    def __init__(self, name: str, *, decoder=None, decoder_class=None,
+                 params=None, buckets=DEFAULT_BUCKETS):
+        if (decoder is None) == (decoder_class is None):
+            raise ValueError(
+                "pass exactly one of decoder= or (decoder_class=, params=)")
+        self.name = str(name)
+        if decoder is not None:
+            if getattr(decoder, "needs_host_postprocess", False):
+                raise ValueError(
+                    "sessions serve the pure-device decode program; host-"
+                    "postprocess (host-OSD) decoders have no compiled unit")
+            # snapshot the array leaves to HOST while the buffers are
+            # alive: handing back decoder.device_state on invalidate()
+            # would re-serve the same (dead, after a worker restart)
+            # device pytree and the recompile recovery rung could never
+            # work for decoder=-built sessions.  Non-array leaves (e.g. a
+            # TPU Pallas head object) pass through best-effort — the
+            # factory path, which rebuilds through the cleared per-H
+            # memos, is the fully-restart-safe one.
+            import jax
+
+            static0 = decoder.device_static
+            host_state = jax.tree_util.tree_map(
+                lambda x: np.asarray(x) if isinstance(x, jax.Array) else x,
+                decoder.device_state)
+            self._rebuild = lambda: (static0, jax.tree_util.tree_map(
+                lambda x: (jax.device_put(x) if isinstance(x, np.ndarray)
+                           else x), host_state))
+        else:
+            if params is None:
+                raise ValueError("decoder_class= requires params=")
+
+            def rebuild():
+                # the DEFAULT GetDecoderState builds the decoder, and a
+                # host-OSD config's device_static silently degrades to the
+                # plain BP program — check the flag there so e.g. a CPU
+                # BPOSD factory fails loudly instead of serving BP-only
+                # corrections that diverge from the offline path.  Light
+                # overrides (the library BP classes) are pure-device by
+                # construction and skip the build.
+                if (type(decoder_class).GetDecoderState
+                        is DecoderClass.GetDecoderState):
+                    dec = decoder_class.GetDecoder(dict(params))
+                    if getattr(dec, "needs_host_postprocess", False):
+                        raise ValueError(
+                            "sessions serve the pure-device decode "
+                            "program; this factory's decoder needs host "
+                            "postprocessing (host-OSD) for these params")
+                    return dec.device_static, dec.device_state
+                return decoder_class.GetDecoderState(dict(params))
+
+            self._rebuild = rebuild
+        self.buckets = tuple(sorted(int(b) for b in buckets))
+        if not self.buckets or self.buckets[0] < 1:
+            raise ValueError(f"invalid bucket ladder {buckets!r}")
+        self._lock = threading.RLock()
+        self._programs: dict[int, object] = {}
+        self.compiles = 0
+        self._resolve_state()
+
+    def _resolve_state(self) -> None:
+        self.static, self.state = self._rebuild()
+        self.syndrome_width = device_syndrome_width(self.static, self.state)
+        telemetry.count("serve.session.builds")
+
+    # ------------------------------------------------------------------
+    # program cache
+    # ------------------------------------------------------------------
+    def bucket_for(self, n_shots: int) -> int:
+        """Smallest bucket holding ``n_shots`` (callers chunk beyond the
+        largest)."""
+        for b in self.buckets:
+            if n_shots <= b:
+                return b
+        return self.buckets[-1]
+
+    def program(self, bucket: int):
+        """The AOT-compiled executable for one bucket (compiling on miss).
+
+        The compiled object is self-contained — it keeps serving after
+        ``jax.clear_caches()`` / ``reset_device_state`` drop the global jit
+        caches, which is what makes the warm path of a long-lived service
+        retrace-free by construction."""
+        prog = self._programs.get(bucket)
+        if prog is not None:
+            telemetry.count("serve.session.hits")
+            return prog
+        with self._lock:
+            prog = self._programs.get(bucket)
+            if prog is not None:
+                return prog
+            import jax
+            import jax.numpy as jnp
+
+            t0 = time.perf_counter()
+            shape = jax.ShapeDtypeStruct((int(bucket), self.syndrome_width),
+                                         jnp.uint8)
+            prog = _decode_device_jit.lower(
+                self.static, self.state, shape).compile()
+            dt = time.perf_counter() - t0
+            self._programs[bucket] = prog
+            self.compiles += 1
+            telemetry.count("serve.session.compiles")
+            telemetry.observe("serve.session.compile_s", dt)
+            telemetry.event("serve_session", session=self.name,
+                            event="compile", bucket=int(bucket),
+                            compile_s=round(dt, 4),
+                            syndrome_width=self.syndrome_width)
+            return prog
+
+    def warm(self, max_shots: int | None = None) -> list[int]:
+        """Precompile every bucket up to ``bucket_for(max_shots)`` (all
+        buckets when None) — the warmup discipline ``bench.py serve`` and
+        the server use so the timed/served path never compiles."""
+        top = (self.buckets[-1] if max_shots is None
+               else self.bucket_for(int(max_shots)))
+        done = []
+        for b in self.buckets:
+            if b > top:
+                break
+            self.program(b)
+            done.append(b)
+        return done
+
+    def invalidate(self) -> None:
+        """Drop compiled programs and re-resolve the decoder state — the
+        recovery rung a serving dispatch steps after repeated transient
+        faults (a worker restart kills the uploaded graph buffers; the
+        retry's ``reset_device_state`` cleared the per-H memos, so the
+        re-resolve re-uploads and the next ``program()`` recompiles against
+        live buffers)."""
+        with self._lock:
+            self._programs.clear()
+            self._resolve_state()
+            telemetry.count("serve.session.invalidations")
+            telemetry.event("serve_session", session=self.name,
+                            event="invalidate",
+                            syndrome_width=self.syndrome_width)
+
+    # ------------------------------------------------------------------
+    # serving
+    # ------------------------------------------------------------------
+    def decode(self, syndromes) -> DecodeOutput:
+        """Decode a (B, m) uint8 syndrome batch on the persistent program.
+
+        Pads to the shape bucket (chunking past the largest), fetches the
+        FULL padded planes under the resilience watchdog, and slices the
+        pad off on HOST — a traced device-side slice would retrace per
+        distinct request size and break the zero-retrace warm path.
+        Bit-exact with the offline decode of the same rows."""
+        import jax
+        import jax.numpy as jnp
+
+        arr = np.atleast_2d(np.asarray(syndromes, dtype=np.uint8))
+        if arr.ndim != 2 or arr.shape[0] == 0:
+            raise ValueError(f"syndromes must be (B, m), got {arr.shape}")
+        if arr.shape[1] != self.syndrome_width:
+            raise ValueError(
+                f"session {self.name!r} decodes syndromes of width "
+                f"{self.syndrome_width}, got {arr.shape[1]}")
+        top = self.buckets[-1]
+        cors, convs, buckets_used, padded = [], [], [], 0
+        for lo in range(0, arr.shape[0], top):
+            chunk = arr[lo:lo + top]
+            bucket = self.bucket_for(chunk.shape[0])
+            prog = self.program(bucket)
+            pad = np.zeros((bucket, self.syndrome_width), np.uint8)
+            pad[:chunk.shape[0]] = chunk
+            with telemetry.span("serve.decode"):
+                cor, aux = prog(self.state, jnp.asarray(pad))
+                conv = aux.get("converged")
+                # fetch the FULL padded planes and slice on host: a traced
+                # device-side cor[:B] would retrace per distinct request
+                # size, breaking the zero-retrace warm path (and the pad
+                # rows are a few KB against a ~100ms tunneled fetch)
+                host = resilience.guarded_fetch(
+                    lambda: jax.device_get((cor, conv)),
+                    label="serve_fetch")
+            cors.append(np.asarray(host[0])[:chunk.shape[0]])
+            convs.append(None if host[1] is None
+                         else np.asarray(host[1])[:chunk.shape[0]]
+                         .astype(bool))
+            buckets_used.append(bucket)
+            padded += bucket
+        return DecodeOutput(
+            corrections=np.concatenate(cors) if len(cors) > 1 else cors[0],
+            converged=(None if convs[0] is None
+                       else (np.concatenate(convs) if len(convs) > 1
+                             else convs[0])),
+            shots=int(arr.shape[0]), padded_shots=int(padded),
+            buckets=tuple(buckets_used))
+
+
+class SessionCache:
+    """Bounded LRU of live sessions keyed by name.
+
+    ``get_or_create(name, factory)`` returns the cached session or builds
+    one; beyond ``max_sessions`` the least-recently-used session is
+    evicted (its compiled programs are dropped with it — a re-request
+    rebuilds via its factory).  Built ON the shared single-flight LRU
+    (ops/bp._LruCache): concurrent first requests for one name build
+    once, the map lock is never held across ``factory()`` (a seconds-long
+    cold-start build must not stall the dispatcher's ``get`` for warm
+    sessions or serialize other codes' builds), and the subtle
+    lock/Event/retry machinery lives in ONE place."""
+
+    def __init__(self, max_sessions: int = 8):
+        from ..ops.bp import _LruCache
+
+        self._cache = _LruCache(maxsize=max(1, int(max_sessions)))
+        self._cache.on_evict = self._evicted
+        self.max_sessions = self._cache.maxsize
+
+    @staticmethod
+    def _evicted(name, old: "DecodeSession") -> None:
+        telemetry.count("serve.session.evictions")
+        telemetry.event("serve_session", session=name, event="evict",
+                        syndrome_width=old.syndrome_width)
+
+    def get(self, name: str) -> DecodeSession:
+        try:
+            return self._cache.peek(name)
+        except KeyError:
+            raise KeyError(f"unknown session {name!r}") from None
+
+    def get_or_create(self, name: str, factory) -> DecodeSession:
+        sess = self._cache.get(name, factory)
+        telemetry.set_gauge("serve.sessions", len(self._cache))
+        return sess
+
+    def add(self, session: DecodeSession) -> DecodeSession:
+        return self.get_or_create(session.name, lambda: session)
+
+    def names(self) -> list[str]:
+        return self._cache.keys()
+
+    def __len__(self) -> int:
+        return len(self._cache)
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._cache

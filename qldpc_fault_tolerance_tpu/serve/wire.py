@@ -1,0 +1,32 @@
+"""The decode service's wire format, defined ONCE for both ends.
+
+    frame   := uint32 big-endian payload length | payload
+    payload := one UTF-8 JSON object
+
+serve/server.py (asyncio) and serve/client.py (blocking sockets) both
+import from here, so a protocol change — e.g. the binary payload codec the
+server docstring anticipates — cannot drift one-sided and silently break
+the wire.
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+__all__ = ["HEADER", "MAX_FRAME_BYTES", "encode_frame"]
+
+HEADER = struct.Struct(">I")
+MAX_FRAME_BYTES = 64 * 1024 * 1024  # a malformed length must not OOM us
+
+
+def encode_frame(obj) -> bytes:
+    """Encode one frame, enforcing the cap on the SEND side too: an
+    oversize payload raises here, per-request, instead of reaching the
+    peer's read cap — which answers with "bad frame" and then closes the
+    connection, collateral-failing every other request pipelined on it."""
+    body = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(body) > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"frame of {len(body)} bytes exceeds the {MAX_FRAME_BYTES}-byte "
+            "cap; split the request batch")
+    return HEADER.pack(len(body)) + body

@@ -47,6 +47,13 @@ class SweepCheckpoint:
 
     def __init__(self, path: str):
         self.path = path
+        # a fresh service/sweep host hands a path whose directory doesn't
+        # exist yet; creating it here (not at first append) means the
+        # cold-start failure surfaces at construction, where it's
+        # actionable, instead of killing the first cell's put
+        parent = os.path.dirname(path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         self._cells: dict[str, dict] = {}
         self._progress: dict[str, dict] = {}
         # a crash mid-append can leave the file without a trailing newline;

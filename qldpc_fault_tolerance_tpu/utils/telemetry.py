@@ -344,7 +344,21 @@ def event(kind: str, **fields) -> None:
 # registry, so a renamed/retyped field fails CI instead of silently breaking
 # a consumer.  Adding a NEW optional field is backward-compatible (add it
 # here in the same change); changing a required field bumps the version.
-EVENT_SCHEMA_VERSION = 1
+#
+# v2 (ISSUE 8): adds the serve.* kinds (serve_session / serve_request /
+# serve_batch / serve_drain) emitted by the decode service.  Purely
+# additive — every v1 event validates unchanged (pinned by the
+# back-compat test in tests/test_serve.py against _V1_EVENT_KINDS).
+EVENT_SCHEMA_VERSION = 2
+
+# the v1 kind set, frozen for the back-compat guarantee: these kinds and
+# their required fields must keep validating across schema bumps
+_V1_EVENT_KINDS = frozenset({
+    "telemetry_enabled", "snapshot", "wer_run", "heartbeat", "cell_done",
+    "cell_progress", "cell_resume", "fit_report", "anomaly", "ledger",
+    "fused_fallback", "fault_injected", "degrade", "retry",
+    "retry_exhausted", "fail_fast", "watchdog_timeout", "program_cost",
+})
 
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
@@ -441,6 +455,27 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "temp_bytes": int, "generated_code_bytes": int,
                      "peak_bytes": int, "backend": str},
     },
+    # --- v2: decode-service (serve/) events ------------------------------
+    "serve_session": {
+        "required": {"session": str, "event": str},
+        "optional": {"bucket": int, "compile_s": _NUM,
+                     "syndrome_width": int},
+    },
+    "serve_request": {
+        "required": {"session": str, "tenant": str, "shots": int},
+        "optional": {"id": _OPT_STR, "latency_s": _NUM, "ok": bool,
+                     "error": str},
+    },
+    "serve_batch": {
+        "required": {"session": str, "requests": int, "shots": int,
+                     "bucket": int},
+        "optional": {"occupancy": _NUM, "tenants": int, "wait_s": _NUM,
+                     "dispatch_s": _NUM, "ok": bool, "error": str},
+    },
+    "serve_drain": {
+        "required": {"pending_requests": int, "completed": int},
+        "optional": {"elapsed_s": _NUM},
+    },
 }
 
 
@@ -484,6 +519,11 @@ class JsonlSink:
 
     def __init__(self, path: str):
         self.path = str(path)
+        # cold-start friendliness (shared with checkpoint/ledger writers):
+        # a fresh host's stream directory is created, not required
+        parent = os.path.dirname(self.path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
         self._lock = threading.Lock()
         self._fh = open(self.path, "a", encoding="utf-8")
 

@@ -17,10 +17,12 @@ Three artifact schemas are accepted per round (the ledger spans them):
 
 The gate compares CONSECUTIVE rounds on the headline ``value`` plus any
 stage-rate fields present in both rounds (``GATED_FIELDS`` — the
-CPU-measurable sample→syndrome substrate rates and the whole-grid sweep
-speedup), and fails when any drops more than ``--tolerance`` percent.
+CPU-measurable sample→syndrome substrate rates, the whole-grid sweep
+speedup, and the decode-service QPS companions ``shots_per_s`` /
+``p99_ms``), and fails when any drops more than ``--tolerance`` percent.
 Higher-is-better is assumed for shots/s metrics; wall-clock metrics
-(``unit == "s"``) gate on INCREASES instead.
+(``unit == "s"``) and latency fields (``LOWER_IS_BETTER_FIELDS``, e.g. the
+serve round's tail latency) gate on INCREASES instead.
 """
 from __future__ import annotations
 
@@ -41,7 +43,15 @@ GATED_FIELDS = (
     "sample_synd_shots_per_s.packed",
     "sample_synd_shots_per_s.fused",
     "fused_speedup_vs_serial",
+    # decode-service rounds (bench.py serve): aggregate decoded shots/s
+    # rides alongside the QPS headline, and the tail-latency SLO gates on
+    # INCREASES (LOWER_IS_BETTER_FIELDS)
+    "shots_per_s",
+    "p99_ms",
 )
+
+# gated fields where a RISE is the regression (latencies)
+LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms"})
 
 
 def _dig(d: dict, dotted: str):
@@ -128,8 +138,9 @@ def compare(rounds: list[dict], tolerance_pct: float) -> dict:
             if a is None or b is None or a == 0:
                 continue
             delta_pct = (b - a) / abs(a) * 100.0
-            regressed = (delta_pct > tolerance_pct if lower_is_better
-                         and name == "value"
+            field_lower = (lower_is_better if name == "value"
+                           else name in LOWER_IS_BETTER_FIELDS)
+            regressed = (delta_pct > tolerance_pct if field_lower
                          else delta_pct < -tolerance_pct)
             pair["fields"][name] = {
                 "from": a, "to": b, "delta_pct": round(delta_pct, 2),
@@ -189,7 +200,7 @@ def render(cmp: dict) -> str:
         for p, name, f in stage_rows:
             L.append(f"  r{p['from']:>02}->r{p['to']:>02}  {name:<36}"
                      f"{f['delta_pct']:+8.2f}%  "
-                     f"{_band(f['delta_pct'], tol)}")
+                     f"{_band(f['delta_pct'], tol, name in LOWER_IS_BETTER_FIELDS)}")
     if cmp["violations"]:
         L.append("-- VIOLATIONS --")
         for v in cmp["violations"]:

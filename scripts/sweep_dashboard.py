@@ -16,7 +16,9 @@ Inputs (auto-detected per line, freely mixable):
   * raw telemetry JSONL event streams (utils.telemetry JsonlSink):
     ``cell_done`` events fill the grid, ``cell_progress`` events (the fused
     drivers' live per-cell intervals) mark still-running cells, ``anomaly``
-    events flag cells, ``fit_report`` events list below the grid.
+    events flag cells, ``fit_report`` events list below the grid, and the
+    decode service's ``serve_*`` events (schema v2) fold into a per-session
+    serve block instead of being dropped.
 
 Views (``--view``): ``wer`` (default; WER with relative CI width), ``ci``
 (interval bounds on the failure rate), ``shots``, ``state``.
@@ -80,6 +82,11 @@ def build_grid(records: list[dict], grid: dict | None = None) -> dict:
     re-parsing the whole history every poll)."""
     if grid is None:
         grid = {"rows": {}, "anomalies": [], "fits": [], "runs": []}
+    # decode-service events (utils.telemetry schema v2) fold into a serve
+    # summary instead of being dropped: per-session request/shot/batch
+    # totals, last occupancy, tenant set, drain marker
+    serve = grid.setdefault(
+        "serve", {"sessions": {}, "drains": 0, "errors": 0})
     for rec in records:
         kind = rec.get("kind")
         if kind is None and "cells" in rec and "run_id" in rec:
@@ -121,6 +128,28 @@ def build_grid(records: list[dict], grid: dict | None = None) -> dict:
             grid["anomalies"].append(rec)
         elif kind == "fit_report":
             grid["fits"].append(rec)
+        elif kind in ("serve_request", "serve_batch", "serve_session"):
+            name = str(rec.get("session", "?"))
+            s = serve["sessions"].setdefault(
+                name, {"requests": 0, "shots": 0, "batches": 0,
+                       "compiles": 0, "occupancy": None, "tenants": set()})
+            if kind == "serve_request":
+                s["requests"] += 1
+                s["shots"] += int(rec.get("shots", 0))
+                s["tenants"].add(str(rec.get("tenant", "?")))
+                if rec.get("ok") is False:
+                    serve["errors"] += 1
+            elif kind == "serve_batch":
+                s["batches"] += 1
+                if rec.get("occupancy") is not None:
+                    s["occupancy"] = rec["occupancy"]
+                if rec.get("ok") is False:
+                    serve["errors"] += int(rec.get("requests", 1))
+            else:  # serve_session
+                if rec.get("event") == "compile":
+                    s["compiles"] += 1
+        elif kind == "serve_drain":
+            serve["drains"] += 1
     # mark anomalous cells
     for a in grid["anomalies"]:
         cell_key = a.get("cell")
@@ -177,7 +206,11 @@ def render_grid(grid: dict, view: str = "wer", title: str = "") -> str:
         last = grid["runs"][-1]
         lines.append(f"runs: {len(grid['runs'])}   latest "
                      f"{last.get('run_id')} (config {last.get('fingerprint')})")
+    serve = grid.get("serve") or {}
     if not grid["rows"]:
+        if serve.get("sessions"):
+            lines.extend(_serve_lines(serve))
+            return "\n".join(lines)
         lines.append("(no cells yet)")
         return "\n".join(lines)
     all_p = sorted({p for cells in grid["rows"].values() for p in cells})
@@ -226,7 +259,30 @@ def render_grid(grid: dict, view: str = "wer", title: str = "") -> str:
                       if k not in ("anomaly", "cell", "ts", "kind")}
             lines.append(f"  ! {kind} {where} {json.dumps(detail, default=str)}"
                          .rstrip())
+    if serve.get("sessions"):
+        lines.extend(_serve_lines(serve))
     return "\n".join(lines)
+
+
+def _serve_lines(serve: dict) -> list[str]:
+    """The decode-service block: per-session request/shot/batch totals."""
+    lines = ["-- serve (decode service) --"]
+    for name, s in sorted(serve["sessions"].items()):
+        occ = (f"  occ {s['occupancy']:.2f}"
+               if s.get("occupancy") is not None else "")
+        lines.append(
+            f"  {name:<24}{s['requests']:>7} req  {s['shots']:>8} shots  "
+            f"{s['batches']:>6} batches  {len(s['tenants'])} tenant(s)"
+            f"{occ}"
+            + (f"  {s['compiles']} compiles" if s.get("compiles") else ""))
+    tail = []
+    if serve.get("errors"):
+        tail.append(f"{serve['errors']} failed request(s)")
+    if serve.get("drains"):
+        tail.append(f"{serve['drains']} drain(s)")
+    if tail:
+        lines.append("  " + ", ".join(tail))
+    return lines
 
 
 # ---------------------------------------------------------------------------
@@ -363,10 +419,16 @@ def main(argv=None) -> int:
         return 1
     grid = build_grid(records)
     if args.json:
+        serve = grid.get("serve") or {}
         out = {"rows": {f"{c}|{t}|{n}": cells
                         for (c, t, n), cells in grid["rows"].items()},
                "anomalies": grid["anomalies"], "fits": grid["fits"],
-               "runs": grid["runs"]}
+               "runs": grid["runs"],
+               "serve": {**serve,
+                         "sessions": {
+                             name: {**s, "tenants": sorted(s["tenants"])}
+                             for name, s in serve.get("sessions",
+                                                      {}).items()}}}
         print(json.dumps(out, default=str))
         return 0
     print(render_grid(grid, args.view, title=os.path.basename(args.path)))

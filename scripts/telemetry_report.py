@@ -189,6 +189,30 @@ def summary_from_state(state: dict) -> dict:
     iters = snap.get("bp.iterations", {})
     osd_host_shots = _metric(snap, "osd.shots")
     osd_dev_shots = _metric(snap, "osd.device_shots")
+    lat = snap.get("serve.latency_s", {})
+    occ = snap.get("serve.batch_occupancy", {})
+    serve_requests = _metric(snap, "serve.requests")
+    serve = {
+        "requests": serve_requests,
+        "shots": _metric(snap, "serve.shots"),
+        "batches": _metric(snap, "serve.batches"),
+        "padded_shots": _metric(snap, "serve.padded_shots"),
+        "errors": _metric(snap, "serve.errors"),
+        "queue_depth_max": _metric(snap, "serve.queue_depth", "max"),
+        "sessions": _metric(snap, "serve.sessions"),
+        "session_compiles": _metric(snap, "serve.session.compiles"),
+        "session_evictions": _metric(snap, "serve.session.evictions"),
+        "occupancy_mean": (round(occ["mean"], 4)
+                           if occ.get("mean") is not None else None),
+        "latency_p50_s": _hist_quantile(lat, 0.50),
+        "latency_p99_s": _hist_quantile(lat, 0.99),
+        "tenants": {
+            name[len("serve.tenant."):-len(".requests")]: m.get("value", 0)
+            for name, m in snap.items()
+            if name.startswith("serve.tenant.")
+            and name.endswith(".requests")
+        },
+    }
     spans = {
         name[len("span."):-len(".seconds")]: m
         for name, m in snap.items()
@@ -221,6 +245,7 @@ def summary_from_state(state: dict) -> dict:
             "shots": osd_host_shots + osd_dev_shots,
             "host_round_trips": _metric(snap, "osd.host_round_trips"),
         },
+        "serve": serve,
         "jax": {
             "retraces": compile_stats.get(
                 "jax.retraces", _metric(snap, "jax.retraces")),
@@ -289,6 +314,26 @@ def render(summary: dict, title: str = "") -> str:
             for lab, n in zip(labels, counts):
                 if n:
                     L.append(f"    {lab:>6} {n:>10}  {_bar(n, peak)}")
+    srv = s.get("serve") or {}
+    if srv.get("requests"):
+        L.append("-- serve (decode service) --")
+        L.append(f"  {'requests':<22}{srv['requests']}"
+                 f"  ({srv['errors']} failed)")
+        L.append(f"  {'shots':<22}{srv['shots']}"
+                 f"  (+{srv['padded_shots']} pad)")
+        L.append(f"  {'batches':<22}{srv['batches']}"
+                 + (f"  (occupancy {srv['occupancy_mean']:.2f})"
+                    if srv.get("occupancy_mean") is not None else ""))
+        p50, p99 = srv.get("latency_p50_s"), srv.get("latency_p99_s")
+        if p50 is not None:
+            L.append(f"  {'latency p50/p99':<22}"
+                     f"{1e3 * p50:.1f} / {1e3 * p99:.1f} ms")
+        L.append(f"  {'queue depth (max)':<22}{srv['queue_depth_max']}")
+        L.append(f"  {'sessions':<22}{srv['sessions']}"
+                 f"  ({srv['session_compiles']} compiles, "
+                 f"{srv['session_evictions']} evictions)")
+        for tenant, n in sorted(srv.get("tenants", {}).items()):
+            L.append(f"  {'tenant ' + tenant:<22}{n}")
     osd = s["osd"]
     L.append("-- osd --")
     L.append(f"  {'invocations':<22}{osd['invocations']}")

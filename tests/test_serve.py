@@ -1,0 +1,798 @@
+"""Decode-as-a-service tests (ISSUE 8): session cache semantics (warm-path
+zero retraces, eviction/rebuild, bit-exact served decodes vs the offline
+path), continuous-batching coalescing + tenant fairness, graceful drain
+(scheduler- and server-level — no request dropped on shutdown), the TCP
+front-end round trip, the per-H decoder-state memo's thread safety, the
+cold-start parent-dir creation of the checkpoint/ledger/JSONL writers, the
+v2 event-schema back-compat guarantee, and the bench_compare serve gate
+(QPS/p99 join the regression ledger)."""
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+from qldpc_fault_tolerance_tpu.serve import (
+    ContinuousBatcher,
+    DecodeClient,
+    DecodeSession,
+    SessionCache,
+    assemble_round_robin,
+    start_server_thread,
+)
+from qldpc_fault_tolerance_tpu.serve.scheduler import _Request, _SessionQueue
+from qldpc_fault_tolerance_tpu.utils import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+DEC_CLS = BP_Decoder_Class(4, "minimum_sum", 0.625)
+CODE3 = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+CODE4 = hgp(rep_code(4), rep_code(4), name="hgp_rep4")
+P = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _params(code):
+    return {"h": code.hx, "p_data": P}
+
+
+def _session(code, name=None, buckets=(8, 32, 128)):
+    return DecodeSession(name or code.name, decoder_class=DEC_CLS,
+                         params=_params(code), buckets=buckets)
+
+
+def _synd(code, k, rng):
+    err = (rng.random((k, code.N)) < P).astype(np.uint8)
+    return (err @ np.asarray(code.hx, np.uint8).T % 2).astype(np.uint8)
+
+
+def _offline(code, synd):
+    return DEC_CLS.GetDecoder(_params(code)).decode_batch(synd)
+
+
+# ---------------------------------------------------------------------------
+# DecodeSession: bit-exactness, padding, chunking
+# ---------------------------------------------------------------------------
+def test_session_decode_bitexact_vs_offline_padded_and_chunked():
+    """Served decodes — padded to a bucket, or chunked past the largest
+    bucket — must be bit-exact with the offline decode path on the
+    identical syndromes (the acceptance gate: request boundaries and
+    megabatch padding must not leak into results)."""
+    rng = np.random.default_rng(0)
+    sess = _session(CODE3)
+    for k in (1, 5, 8, 31, 40, 300):  # pad-only, exact-bucket, chunked
+        synd = _synd(CODE3, k, rng)
+        out = sess.decode(synd)
+        assert out.corrections.shape == (k, CODE3.N)
+        assert np.array_equal(out.corrections, _offline(CODE3, synd)), k
+        assert out.shots == k
+        assert out.padded_shots >= k
+        assert out.converged is not None and out.converged.shape == (k,)
+
+
+def test_session_rejects_bad_input():
+    sess = _session(CODE3)
+    with pytest.raises(ValueError):
+        sess.decode(np.zeros((4, sess.syndrome_width + 1), np.uint8))
+    with pytest.raises(ValueError):
+        sess.decode(np.zeros((0, sess.syndrome_width), np.uint8))
+    with pytest.raises(ValueError):
+        DecodeSession("x", decoder_class=DEC_CLS)  # params missing
+    with pytest.raises(ValueError):
+        DecodeSession("x")  # neither decoder nor factory
+
+
+def test_session_factory_path_rejects_host_osd_config():
+    """The factory path must apply the same pure-device guard as the
+    decoder path: a CPU BPOSD factory resolves to host OSD, whose
+    device_static silently degrades to plain BP — serving it would break
+    the bit-exact-vs-offline guarantee instead of failing loudly."""
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
+
+    cls = BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_e", 10)
+    with pytest.raises(ValueError, match="host"):
+        DecodeSession("x", decoder_class=cls, params=_params(CODE3))
+
+
+def test_session_warm_cache_zero_retraces():
+    """The AOT program cache is the point of the session: after warmup the
+    served path performs ZERO retraces (PR-2 compile tracker), no matter
+    how request sizes vary within the warmed buckets."""
+    telemetry.enable()
+    try:
+        sess = _session(CODE4, name="warm4")
+        sess.warm()
+        rng = np.random.default_rng(1)
+        for k in (2, 8, 30):  # one warm pass per bucket (device transfers)
+            sess.decode(_synd(CODE4, k, rng))
+        before = telemetry.compile_stats().get("jax.retraces", 0)
+        compiles_before = sess.compiles
+        for k in (1, 3, 7, 8, 9, 17, 31, 32, 100, 128):
+            sess.decode(_synd(CODE4, k, rng))
+        after = telemetry.compile_stats().get("jax.retraces", 0)
+    finally:
+        telemetry.disable()
+    assert sess.compiles == compiles_before
+    assert after - before == 0, (
+        f"{after - before} retraces on the warm serve path: something is "
+        "tracing per request instead of hitting the AOT program cache")
+
+
+def test_session_cache_eviction_and_rebuild():
+    """Bounded LRU semantics: a third (H, shape) session evicts the least
+    recently used; re-requesting it rebuilds (fresh factory call + fresh
+    compiles)."""
+    builds = []
+
+    def factory(name, code):
+        def make():
+            builds.append(name)
+            return _session(code, name=name)
+        return make
+
+    cache = SessionCache(max_sessions=2)
+    a = cache.get_or_create("a", factory("a", CODE3))
+    cache.get_or_create("b", factory("b", CODE4))
+    assert cache.get_or_create("a", factory("a", CODE3)) is a  # hit, no build
+    assert builds == ["a", "b"]
+    cache.get_or_create("c", factory("c", CODE3))  # evicts b (LRU)
+    assert len(cache) == 2 and "b" not in cache and "a" in cache
+    b2 = cache.get_or_create("b", factory("b", CODE4))  # rebuild ("a" LRU now? no: a was touched)
+    assert builds == ["a", "b", "c", "b"]
+    assert b2.compiles == 0  # fresh session: programs compile on demand
+    rng = np.random.default_rng(2)
+    out = b2.decode(_synd(CODE4, 4, rng))
+    assert b2.compiles == 1  # rebuilt program compiled again
+    assert out.corrections.shape[0] == 4
+
+
+# ---------------------------------------------------------------------------
+# ContinuousBatcher: coalescing, fairness, drain
+# ---------------------------------------------------------------------------
+def test_scheduler_coalesces_across_tenants_and_codes_bitexact():
+    """Requests from several tenants against two codes coalesce into a few
+    megabatches (serve.batches << serve.requests) and every request's
+    corrections stay bit-exact vs the offline decode of its own rows."""
+    telemetry.enable()
+    try:
+        sessions = {"hgp_rep3": _session(CODE3), "hgp_rep4": _session(CODE4)}
+        for s in sessions.values():
+            s.warm()
+        bat = ContinuousBatcher(sessions, max_batch_shots=128,
+                                max_wait_s=0.2)
+        rng = np.random.default_rng(3)
+        subs = []
+        for i in range(12):
+            code = CODE3 if i % 2 == 0 else CODE4
+            synd = _synd(code, int(rng.integers(1, 9)), rng)
+            subs.append((code, synd, bat.submit(
+                code.name, synd, tenant=f"t{i % 3}", request_id=str(i))))
+        for code, synd, fut in subs:
+            res = fut.result(timeout=60)
+            assert np.array_equal(res.corrections, _offline(code, synd))
+            assert res.latency_s > 0
+        bat.drain()
+        snap = telemetry.snapshot()
+        assert snap["serve.requests"]["value"] == 12
+        batches = snap["serve.batches"]["value"]
+        assert 2 <= batches < 12  # coalesced (>= one per session)
+        assert snap["serve.tenant.t0.requests"]["value"] == 4
+    finally:
+        telemetry.disable()
+
+
+def _mk_req(tenant, shots, t0=0.0):
+    from concurrent.futures import Future
+
+    return _Request(request_id=None, tenant=tenant, session="s",
+                    syndromes=np.zeros((shots, 4), np.uint8),
+                    future=Future(), t0=t0)
+
+
+def test_assemble_round_robin_fairness():
+    """A flooding tenant cannot starve the others: with A holding 10
+    queued requests and B one, B's request rides the FIRST flush, and A
+    only gets its rotating share of the batch."""
+    q = _SessionQueue()
+    for i in range(10):
+        q.add(_mk_req("A", 4, t0=float(i)))
+    q.add(_mk_req("B", 4, t0=99.0))
+    batch = assemble_round_robin(q, max_shots=16)
+    tenants = [r.tenant for r in batch]
+    assert "B" in tenants  # fairness: B made the first batch
+    assert sum(r.shots for r in batch) <= 16
+    assert tenants.count("A") <= 3  # A capped at its share, not the queue
+    # bookkeeping survives a partial flush
+    assert q.shots == sum(r.shots
+                          for dq in q.tenants.values() for r in dq)
+    # force mode (drain) empties everything regardless of the cap
+    rest = assemble_round_robin(q, max_shots=16, force=True)
+    assert q.empty() and q.shots == 0
+    assert len(batch) + len(rest) == 11
+
+
+def test_scheduler_graceful_drain_no_request_dropped():
+    """Acceptance: drain() resolves EVERY submitted request (partial
+    batches included) before stopping; submits after drain are rejected
+    loudly, not queued into the void."""
+    sessions = {"hgp_rep3": _session(CODE3)}
+    # huge wait + huge batch: nothing would flush without the drain
+    bat = ContinuousBatcher(sessions, max_batch_shots=10_000,
+                            max_wait_s=60.0)
+    rng = np.random.default_rng(4)
+    subs = [(s := _synd(CODE3, 3, rng),
+             bat.submit("hgp_rep3", s, tenant=f"t{i % 2}"))
+            for i in range(25)]
+    assert not any(fut.done() for _, fut in subs)  # all parked in queue
+    bat.drain()
+    for synd, fut in subs:
+        res = fut.result(timeout=1)  # resolved by the drain flush
+        assert np.array_equal(res.corrections, _offline(CODE3, synd))
+    with pytest.raises(RuntimeError):
+        bat.submit("hgp_rep3", _synd(CODE3, 1, rng))
+    assert bat.completed == 25 and bat.failed == 0
+
+
+def test_scheduler_survives_session_evicted_between_submit_and_flush():
+    """A session evicted from the cache while its requests sit queued must
+    fail THOSE futures (answered, not dropped) and leave the dispatcher
+    thread alive for subsequent traffic — an escaping KeyError would
+    silently hang the whole service."""
+    cache = SessionCache(max_sessions=1)
+    cache.get_or_create("a", lambda: _session(CODE3, name="a"))
+    bat = ContinuousBatcher(cache, max_batch_shots=10_000, max_wait_s=60.0)
+    rng = np.random.default_rng(8)
+    fut = bat.submit("a", _synd(CODE3, 2, rng))
+    cache.get_or_create("b", lambda: _session(CODE4, name="b"))  # evicts a
+    fut_b = bat.submit("b", _synd(CODE4, 2, rng))
+    bat.drain()
+    with pytest.raises(KeyError):
+        fut.result(timeout=1)
+    res = fut_b.result(timeout=1)  # dispatcher survived the failed batch
+    assert res.corrections.shape[0] == 2
+    assert bat.failed == 1 and bat.completed == 1
+
+
+def test_scheduler_validates_on_submit():
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.01)
+    with pytest.raises(KeyError):
+        bat.submit("nope", np.zeros((1, 6), np.uint8))
+    with pytest.raises(ValueError):
+        bat.submit("hgp_rep3", np.zeros((1, 7), np.uint8))
+    bat.drain()
+
+
+# ---------------------------------------------------------------------------
+# TCP front-end
+# ---------------------------------------------------------------------------
+def test_server_roundtrip_ping_error_and_graceful_drain():
+    """Full-stack: frames over TCP, streamed responses matched by id,
+    structured error replies, and the shutdown drain answering every
+    in-flight request (none dropped)."""
+    sessions = {"hgp_rep3": _session(CODE3), "hgp_rep4": _session(CODE4)}
+    for s in sessions.values():
+        s.warm(32)
+    bat = ContinuousBatcher(sessions, max_batch_shots=64, max_wait_s=0.01)
+    handle = start_server_thread(bat)
+    rng = np.random.default_rng(5)
+    cli = DecodeClient(*handle.address, tenant="alice")
+    try:
+        pong = cli.ping()
+        assert pong["ok"] and set(pong["sessions"]) == set(sessions)
+        # pipelined mixed-code submits
+        subs = []
+        for i in range(10):
+            code = CODE3 if i % 2 else CODE4
+            synd = _synd(code, int(rng.integers(1, 6)), rng)
+            subs.append((code, synd, cli.submit(code.name, synd)))
+        for code, synd, fut in subs:
+            res = fut.result(timeout=60)
+            assert np.array_equal(res.corrections, _offline(code, synd))
+            assert res.server_latency_ms is not None
+        # structured error for an unknown session — answered, not dropped
+        with pytest.raises(RuntimeError, match="unknown session"):
+            cli.decode("nope", np.zeros((1, 6), np.uint8))
+        # graceful drain: submit, then stop the server before waiting
+        synd3 = _synd(CODE3, 3, rng)
+        pending = [cli.submit("hgp_rep3", synd3) for _ in range(8)]
+        handle.stop(drain=True)
+        for fut in pending:
+            res = fut.result(timeout=10)
+            assert np.array_equal(res.corrections, _offline(CODE3, synd3))
+    finally:
+        cli.close()
+
+
+def test_scheduler_drain_timeout_raises_instead_of_lying():
+    """A drain that cannot finish in time must raise — returning normally
+    would let the server tear connections down mid-flight and silently
+    break the no-request-dropped guarantee."""
+    sess = _session(CODE3)
+    orig = sess.decode
+
+    def slow(synd):
+        time.sleep(0.5)
+        return orig(synd)
+
+    sess.decode = slow
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=1,
+                            max_wait_s=0.0)
+    rng = np.random.default_rng(9)
+    futs = [bat.submit("hgp_rep3", _synd(CODE3, 1, rng)) for _ in range(3)]
+    with pytest.raises(TimeoutError):
+        bat.drain(timeout=0.2)
+    bat.drain(timeout=60.0)  # the flush itself kept going; finish it
+    for f in futs:
+        assert f.result(timeout=5).corrections.shape[0] == 1
+
+
+def test_server_abandon_shutdown_stops_worker_and_answers():
+    """shutdown(drain=False) is the fast abandon: queued futures fail
+    immediately (no max_wait sit-out) and the dispatcher thread stops
+    instead of leaking into the embedding process."""
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=10_000, max_wait_s=60.0)
+    handle = start_server_thread(bat)
+    cli = DecodeClient(*handle.address)
+    rng = np.random.default_rng(10)
+    futs = [cli.submit("hgp_rep3", _synd(CODE3, 2, rng)) for _ in range(4)]
+    time.sleep(0.4)  # let the frames reach the (parked) batcher queue
+    t0 = time.perf_counter()
+    handle.stop(drain=False)
+    assert time.perf_counter() - t0 < 10  # not the 60s deadline
+    for f in futs:  # answered with the abandon error, not dropped silently
+        with pytest.raises((RuntimeError, ConnectionError)):
+            f.result(timeout=5)
+    assert not bat._thread.is_alive()
+    cli.close()
+
+
+def test_server_answers_non_object_json_frame():
+    """Valid JSON that is not an object gets a structured error reply and
+    the connection keeps serving the pipelined requests behind it."""
+    import socket
+
+    from qldpc_fault_tolerance_tpu.serve.wire import HEADER
+
+    sess = _session(CODE3)
+    sess.warm(8)
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=64,
+                            max_wait_s=0.01)
+    handle = start_server_thread(bat)
+    raw = socket.create_connection(handle.address)
+    body = b"[1,2,3]"
+    raw.sendall(HEADER.pack(len(body)) + body)
+    head = b""
+    while len(head) < 4:
+        head += raw.recv(4 - len(head))
+    (length,) = HEADER.unpack(head)
+    reply = b""
+    while len(reply) < length:
+        reply += raw.recv(length - len(reply))
+    msg = json.loads(reply)
+    assert msg["ok"] is False and "JSON object" in msg["error"]
+    raw.close()
+    cli = DecodeClient(*handle.address)  # connection handling still alive
+    try:
+        res = cli.decode("hgp_rep3", _synd(CODE3, 2,
+                                           np.random.default_rng(15)))
+        assert res.corrections.shape[0] == 2
+        handle.stop(drain=True)
+    finally:
+        cli.close()
+
+
+def test_server_survives_midframe_disconnect():
+    """A client dying after the frame header but before the body must take
+    the clean-disconnect path; the server keeps serving other clients."""
+    import socket
+    import struct
+
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.01)
+    handle = start_server_thread(bat)
+    raw = socket.create_connection(handle.address)
+    raw.sendall(struct.pack(">I", 100) + b"partial")  # header, torn body
+    raw.close()
+    time.sleep(0.2)
+    cli = DecodeClient(*handle.address)
+    try:
+        res = cli.decode("hgp_rep3",
+                         _synd(CODE3, 2, np.random.default_rng(12)))
+        assert res.corrections.shape[0] == 2
+        handle.stop(drain=True)
+    finally:
+        cli.close()
+
+
+# ---------------------------------------------------------------------------
+# Satellite: per-H decoder-state memo thread safety
+# ---------------------------------------------------------------------------
+def test_decoder_state_memo_thread_safe(monkeypatch):
+    """Concurrent GetDecoderState for the SAME H (the serve session
+    construction path) must build the Tanner graph exactly once and hand
+    every caller the identical memoized objects — the _LruCache lock
+    regression test (an unlocked OrderedDict races move_to_end/insert and
+    can rebuild or corrupt)."""
+    from qldpc_fault_tolerance_tpu.ops import bp as bp_mod
+
+    bp_mod._graph_host_cache.clear()
+    bp_mod._graph_dev_cache.clear()
+    calls = []
+    orig = bp_mod._build_tanner_graph_host
+
+    def counting(h):
+        calls.append(threading.get_ident())
+        time.sleep(0.02)  # widen the unlocked race window
+        return orig(h)
+
+    monkeypatch.setattr(bp_mod, "_build_tanner_graph_host", counting)
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    results = [None] * n_threads
+    errors = []
+
+    def worker(i):
+        try:
+            barrier.wait()
+            results[i] = DEC_CLS.GetDecoderState(_params(CODE4))
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len(calls) == 1, (
+        f"{len(calls)} Tanner-graph builds for one H under concurrency — "
+        "the per-H memo raced")
+    g0 = results[0][1]["graph"]
+    for static, state in results[1:]:
+        assert static == results[0][0]
+        assert state["graph"] is g0  # the memoized object, not a rebuild
+
+
+# ---------------------------------------------------------------------------
+# Satellite: cold-start parent-directory creation
+# ---------------------------------------------------------------------------
+def test_memo_builds_for_different_keys_overlap():
+    """Single-flight is per KEY: two threads building DIFFERENT keys must
+    run their makes concurrently (a multi-code service cold start must not
+    serialize seconds-long graph builds behind one cache-wide lock)."""
+    from qldpc_fault_tolerance_tpu.ops.bp import _LruCache
+
+    cache = _LruCache()
+    barrier = threading.Barrier(2, timeout=5)  # trips only if concurrent
+
+    def make(tag):
+        def m():
+            barrier.wait()
+            return tag
+        return m
+
+    out, errors = {}, []
+
+    def worker(tag):
+        try:
+            out[tag] = cache.get((tag,), make(tag))
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(t,)) for t in (1, 2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors  # BrokenBarrierError = builds serialized
+    assert out == {1: 1, 2: 2}
+
+
+def test_session_from_decoder_invalidate_reuploads_fresh_state():
+    """decoder=-built sessions must survive invalidate() (the recompile
+    recovery rung): the rebuild re-uploads from a construction-time host
+    snapshot instead of re-serving the decoder's original device pytree
+    (which a worker restart would have killed)."""
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+
+    dec = BPDecoder(CODE3.hx, np.full(CODE3.N, P), max_iter=6)
+    sess = DecodeSession("d3", decoder=dec, buckets=(8,))
+    rng = np.random.default_rng(13)
+    synd = _synd(CODE3, 4, rng)
+    before = sess.decode(synd).corrections
+    state_before = sess.state
+    sess.invalidate()
+    assert sess.state is not state_before  # genuinely re-resolved
+    after = sess.decode(synd).corrections
+    assert np.array_equal(before, after)
+    assert np.array_equal(before, dec.decode_batch(synd))
+
+
+def test_client_reader_survives_idle_longer_than_socket_timeout():
+    """An idle gap longer than the socket timeout must not kill the
+    reader thread — a low-traffic client's later requests still resolve."""
+    sess = _session(CODE3)
+    sess.warm(8)
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=64,
+                            max_wait_s=0.01)
+    handle = start_server_thread(bat)
+    cli = DecodeClient(*handle.address, timeout=1.0)
+    try:
+        assert cli.ping()["ok"]
+        time.sleep(1.5)  # > the 1.0s socket timeout, reader must survive
+        res = cli.decode("hgp_rep3", _synd(CODE3, 2,
+                                           np.random.default_rng(14)))
+        assert res.corrections.shape[0] == 2
+        handle.stop(drain=True)
+    finally:
+        cli.close()
+
+
+def test_memo_on_evict_hook_runs_outside_the_lock():
+    """The eviction hook must run with the map lock RELEASED: hook I/O
+    must not stall concurrent lookups, and a hook touching the cache
+    (here: len(), which takes the lock) must not deadlock."""
+    from qldpc_fault_tolerance_tpu.ops.bp import _LruCache
+
+    cache = _LruCache(maxsize=1)
+    seen = []
+    cache.on_evict = lambda k, v: seen.append((k, v, len(cache)))
+    cache.get("a", lambda: 1)
+    cache.get("b", lambda: 2)  # evicts "a"; hook re-enters the cache
+    assert seen == [("a", 1, 1)]
+
+
+def test_memo_clear_mid_build_is_not_cached():
+    """A clear() landing while a build is in flight (reset_device_state
+    after a worker restart) invalidates that build: the in-flight caller
+    still gets its value (its enclosing retry re-resolves), but the stale
+    value — whose device buffers may live on the dead worker — must NOT
+    be cached for later callers."""
+    from qldpc_fault_tolerance_tpu.ops.bp import _LruCache
+
+    cache = _LruCache()
+    started, release = threading.Event(), threading.Event()
+
+    def make():
+        started.set()
+        release.wait(5)
+        return "stale"
+
+    out = {}
+    t = threading.Thread(target=lambda: out.update(v=cache.get("k", make)))
+    t.start()
+    assert started.wait(5)
+    cache.clear()  # the worker-restart reset, mid-build
+    release.set()
+    t.join(5)
+    assert out["v"] == "stale"
+    assert cache.get("k", lambda: "fresh") == "fresh"
+
+
+def test_memo_failed_build_retries_clean():
+    from qldpc_fault_tolerance_tpu.ops.bp import _LruCache
+
+    cache = _LruCache()
+    with pytest.raises(RuntimeError):
+        cache.get("k", lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    assert cache.get("k", lambda: 42) == 42  # no poisoned entry left
+
+
+def test_tenant_counter_cardinality_is_bounded():
+    """The tenant label comes off the wire: a unique-tenant-per-request
+    client must not grow the metrics registry without bound — overflow
+    tenants fold into one __other__ counter."""
+    telemetry.enable()
+    try:
+        bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                                max_batch_shots=256, max_wait_s=0.05)
+        bat.max_tenant_counters = 5
+        rng = np.random.default_rng(11)
+        futs = [bat.submit("hgp_rep3", _synd(CODE3, 1, rng),
+                           tenant=f"uuid-{i}") for i in range(20)]
+        for f in futs:
+            f.result(timeout=60)
+        bat.drain()
+        snap = telemetry.snapshot()
+        tenant_counters = [n for n in snap if n.startswith("serve.tenant.")]
+        assert len(tenant_counters) == 6  # 5 named + __other__
+        assert snap["serve.tenant.__other__.requests"]["value"] == 15
+    finally:
+        telemetry.disable()
+
+
+def test_wire_frame_cap_enforced_on_send():
+    from qldpc_fault_tolerance_tpu.serve import wire
+
+    small = wire.encode_frame({"ok": True})
+    assert wire.HEADER.unpack(small[:4])[0] == len(small) - 4
+    orig = wire.MAX_FRAME_BYTES
+    wire.MAX_FRAME_BYTES = 16
+    try:
+        with pytest.raises(ValueError, match="exceeds"):
+            wire.encode_frame({"corrections": [[0, 1]] * 100})
+    finally:
+        wire.MAX_FRAME_BYTES = orig
+
+
+def test_checkpoint_cold_start_creates_parent_dirs(tmp_path):
+    """A fresh service host points the checkpoint/ledger/telemetry writers
+    at directories that don't exist yet; the first append must create
+    them, not crash (exist_ok semantics)."""
+    from qldpc_fault_tolerance_tpu.utils.checkpoint import SweepCheckpoint
+
+    path = tmp_path / "state" / "nested" / "sweep.jsonl"
+    ckpt = SweepCheckpoint(str(path))
+    ckpt.put({"code": "c", "p": 0.1}, {"wer": 0.5})
+    assert path.exists()
+    again = SweepCheckpoint(str(path))
+    assert again.get({"code": "c", "p": 0.1}) == {"wer": 0.5}
+
+
+def test_jsonl_sink_cold_start_creates_parent_dirs(tmp_path):
+    path = tmp_path / "tele" / "run.jsonl"
+    telemetry.enable(str(path))
+    try:
+        telemetry.event("telemetry_enabled", pid=1)
+    finally:
+        telemetry.disable()
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert any(e["kind"] == "telemetry_enabled" for e in lines)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: event schema v2 — serve kinds validate, v1 still validates
+# ---------------------------------------------------------------------------
+def _serve_events_from_real_run():
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    try:
+        sessions = {"hgp_rep3": _session(CODE3)}
+        bat = ContinuousBatcher(sessions, max_batch_shots=32,
+                                max_wait_s=0.01)
+        rng = np.random.default_rng(6)
+        futs = [bat.submit("hgp_rep3", _synd(CODE3, 2, rng),
+                           tenant=f"t{i % 2}", request_id=str(i))
+                for i in range(6)]
+        for f in futs:
+            f.result(timeout=60)
+        bat.drain()
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+    return sink.records
+
+
+def test_serve_events_validate_against_schema_v2():
+    events = _serve_events_from_real_run()
+    kinds = {e["kind"] for e in events}
+    assert {"serve_session", "serve_request", "serve_batch",
+            "serve_drain"} <= kinds
+    problems = [p for e in events for p in telemetry.validate_event(e)]
+    assert problems == [], problems
+
+
+def test_v1_events_still_validate_after_schema_bump():
+    """The v2 bump is additive: representative v1 events (one per frozen
+    v1 kind) must still validate unchanged."""
+    v1_samples = {
+        "telemetry_enabled": {"pid": 1},
+        "snapshot": {"metrics": {}, "compile": {}},
+        "wer_run": {"engine": "data", "shots": 10, "failures": 1,
+                    "wer": 0.1},
+        "heartbeat": {"engine": "data", "shots": 10},
+        "cell_done": {"code": "c", "noise": "data", "type": "Total",
+                      "p": 0.1},
+        "cell_progress": {"engine": "data", "cells": [], "failures": [],
+                          "shots": [], "ci_low": [], "ci_high": []},
+        "cell_resume": {"key": {}, "batches_done": 3},
+        "fit_report": {"fit": "threshold", "converged": True},
+        "anomaly": {"anomaly": "non_monotone_wer"},
+        "ledger": {"run_id": "r", "fingerprint": "f", "cells": 1,
+                   "fits": 0, "anomalies": 0},
+        "fused_fallback": {"reason": "x", "cells": 2},
+        "fault_injected": {"site": "s", "fault_kind": "raise", "seed": 0},
+        "degrade": {"rung": "packed->dense"},
+        "retry": {"label": "l", "attempt": 1, "wait_s": 0.5, "error": "e"},
+        "retry_exhausted": {"label": "l", "attempts": 3, "error": "e"},
+        "fail_fast": {"label": "l", "error": "e"},
+        "watchdog_timeout": {"label": "l", "timeout_s": 5.0},
+        "program_cost": {"label": "megabatch.data"},
+    }
+    assert set(v1_samples) == set(telemetry._V1_EVENT_KINDS)
+    assert telemetry.EVENT_SCHEMA_VERSION >= 2
+    for kind, fields in v1_samples.items():
+        rec = {"ts": 1.0, "kind": kind, **fields}
+        assert telemetry.validate_event(rec) == [], (kind, fields)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: report + dashboard render serve events instead of dropping them
+# ---------------------------------------------------------------------------
+def test_telemetry_report_and_dashboard_render_serve(tmp_path):
+    import importlib
+
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    try:
+        sessions = {"hgp_rep3": _session(CODE3)}
+        bat = ContinuousBatcher(sessions, max_batch_shots=32,
+                                max_wait_s=0.01)
+        rng = np.random.default_rng(7)
+        futs = [bat.submit("hgp_rep3", _synd(CODE3, 2, rng),
+                           tenant=f"t{i % 2}") for i in range(4)]
+        for f in futs:
+            f.result(timeout=60)
+        bat.drain()
+        telemetry.write_snapshot_event()
+        events = list(sink.records)
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+
+    report = importlib.import_module("scripts.telemetry_report")
+    summary = report.summarize(events)
+    assert summary["serve"]["requests"] == 4
+    assert summary["serve"]["batches"] >= 1
+    assert summary["serve"]["tenants"] == {"t0": 2, "t1": 2}
+    text = report.render(summary)
+    assert "serve (decode service)" in text and "tenant t0" in text
+
+    dash = importlib.import_module("scripts.sweep_dashboard")
+    grid = dash.build_grid(events)
+    srv = grid["serve"]["sessions"]["hgp_rep3"]
+    assert srv["requests"] == 4 and srv["tenants"] == {"t0", "t1"}
+    text = dash.render_grid(grid)
+    assert "serve (decode service)" in text and "hgp_rep3" in text
+
+
+# ---------------------------------------------------------------------------
+# Satellite: bench_compare gates QPS + p99 for serve rounds
+# ---------------------------------------------------------------------------
+def test_bench_compare_gates_serve_qps_and_p99(tmp_path):
+    import importlib
+
+    bench_compare = importlib.import_module("bench_compare")
+
+    def write_round(n, qps, p99, shots_per_s):
+        obj = {"schema": 2, "round": n,
+               "result": {"metric": "decode-service sustained QPS",
+                          "value": qps, "unit": "req/s",
+                          "p99_ms": p99, "shots_per_s": shots_per_s}}
+        p = tmp_path / f"BENCH_SERVE_r{n:02d}.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    # p99 regression (latency RISES) fires even with the QPS headline flat
+    paths = [write_round(1, 500.0, 100.0, 8000.0),
+             write_round(2, 500.0, 180.0, 8000.0)]
+    assert bench_compare.main(paths + ["--gate", "--tolerance", "10"]) == 1
+    # improving latency + QPS passes
+    ok = [write_round(3, 500.0, 100.0, 8000.0),
+          write_round(4, 520.0, 80.0, 8200.0)]
+    assert bench_compare.main(ok + ["--gate", "--tolerance", "10"]) == 0
+    # QPS regression fires
+    bad = [write_round(5, 500.0, 100.0, 8000.0),
+           write_round(6, 300.0, 100.0, 8000.0)]
+    assert bench_compare.main(bad + ["--gate", "--tolerance", "10"]) == 1
