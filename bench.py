@@ -447,8 +447,12 @@ def mode_bp():
     batch = int(os.environ.get("BENCH_BATCH", "16384"))
     n_batches = int(os.environ.get("BENCH_BATCHES", "128"))
     packed = os.environ.get("BENCH_PACKED", "1") != "0"
-    # the fused sampler rides on the packed substrate; BENCH_PACKED=0 wins
-    fused = os.environ.get("BENCH_FUSED", "0") == "1" and packed
+    # the fused sampler rides on the packed substrate; BENCH_PACKED=0 wins.
+    # BENCH_FUSED=1 -> two-dispatch v1 fused path, BENCH_FUSED=2 -> the
+    # whole-pipeline fused v2 program (sample->syndrome->BP->residual in
+    # one kernel per megabatch tile, ISSUE 9)
+    fused = ({"1": True, "2": "v2"}.get(os.environ.get("BENCH_FUSED", "0"),
+                                        False) if packed else False)
     run_ab = os.environ.get("BENCH_AB", "1") != "0"
     dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=50)
     dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=50)
@@ -466,7 +470,9 @@ def mode_bp():
             # round-trip)
             scan_chunk=n_batches,
             packed=packed_arm,
-            fused_sampler=fused and packed_arm,
+            # NOTE: not `fused and packed_arm` — fused may be the string
+            # "v2", and `"v2" and True` evaluates to True (the v1 path)
+            fused_sampler=fused if packed_arm else False,
         )
 
     sim = make_sim(packed)
@@ -640,6 +646,112 @@ def mode_bp():
     else:
         diag_block = {"skipped": "BENCH_DIAG=0"}
 
+    # --- BP kernel v1/v2 A/B arm (ISSUE 9): same sim config + key, the
+    # decoders pinned to each Pallas generation (dense one-hot stack vs
+    # sparse index-gather incidence).  The two kernels share one arithmetic
+    # (ops/bp_pallas._minsum_plane_loop), so WER must be bit-exact across
+    # arms.  Order-alternating min-of-4 per the BASELINE.md A/B protocol.
+    # Meaningful only where the kernels actually serve (TPU): when both
+    # arms resolve to the same variant (CPU -> xla_twin) the arm is skipped
+    # with the resolved variant recorded.  BENCH_KERNEL_AB=0 skips.
+    from qldpc_fault_tolerance_tpu.sim.common import joint_kernel_variant
+
+    def make_kernel_sim(bp_kernel, quantize=None):
+        dx = BPDecoder(code.hz, np.full(code.N, p), max_iter=50,
+                       bp_kernel=bp_kernel, quantize=quantize)
+        dz = BPDecoder(code.hx, np.full(code.N, p), max_iter=50,
+                       bp_kernel=bp_kernel, quantize=quantize)
+        # A/B arms pin the NON-fused substrate: under BENCH_FUSED=2 the
+        # fused-v2 program runs BP inside the kernel and only lifts
+        # (max_iter, msf, quantize) off the statics — a bp_kernel pin
+        # would not change the executed program and the arm would
+        # benchmark noise as a kernel delta
+        return CodeSimulator_DataError(
+            code=code, decoder_x=dx, decoder_z=dz,
+            pauli_error_probs=[p / 3, p / 3, p / 3], batch_size=batch,
+            seed=0, scan_chunk=n_batches, packed=packed,
+            fused_sampler=False), dx, dz
+
+    def ab_min4(sim_a, sim_b):
+        """Order-alternating min-of-4 of two sims on the main key; returns
+        (rate_a, rate_b, wer_a, wer_b)."""
+        sim_a.WordErrorRate(shots, key=jax.random.fold_in(key, 0))  # warm
+        sim_b.WordErrorRate(shots, key=jax.random.fold_in(key, 0))
+        times_a, times_b, wers = [], [], [None, None]
+
+        def run_arm(s, times, slot):
+            t0 = time.perf_counter()
+            wers[slot] = s.WordErrorRate(shots,
+                                         key=jax.random.fold_in(key, 1))
+            times.append(time.perf_counter() - t0)
+
+        for rep in range(4):
+            order = [(sim_a, times_a, 0), (sim_b, times_b, 1)]
+            if rep % 2:
+                order.reverse()
+            for s, t, slot in order:
+                run_arm(s, t, slot)
+        return (shots / min(times_a), shots / min(times_b),
+                wers[0], wers[1])
+
+    bp_kernel_variant = joint_kernel_variant(dec_x, dec_z,
+                                             batch_size=batch)
+    if os.environ.get("BENCH_KERNEL_AB", "1") != "0":
+        sim_v1, d1x, d1z = make_kernel_sim("v1")
+        sim_v2, d2x, d2z = make_kernel_sim("v2")
+        var_v1 = joint_kernel_variant(d1x, d1z, batch_size=batch)
+        var_v2 = joint_kernel_variant(d2x, d2z, batch_size=batch)
+        if var_v1 == var_v2:
+            kernel_ab = {"skipped": f"both arms resolve to {var_v1} "
+                                    "(kernels only serve on TPU)"}
+        else:
+            try:
+                r_v1, r_v2, wer_v1, wer_v2 = ab_min4(sim_v1, sim_v2)
+                kernel_ab = {
+                    "v1_shots_per_s": round(r_v1, 1),
+                    "v2_shots_per_s": round(r_v2, 1),
+                    "v2_speedup_vs_v1": round(r_v2 / r_v1, 2),
+                    "v1_variant": var_v1,
+                    "v2_variant": var_v2,
+                    "wer_bitexact_v1_vs_v2": bool(
+                        wer_v1[0] == wer_v2[0] and wer_v1[1] == wer_v2[1]),
+                }
+            except Exception as e:  # an arm failing must not kill the round
+                kernel_ab = {"error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        kernel_ab = {"skipped": "BENCH_KERNEL_AB=0"}
+
+    # --- int8 quantization A/B arm (BENCH_QUANT=1): quantize="int8"
+    # decoders against the main arm, WER gated by the documented
+    # quantization contract (ops/bp_pallas.int8_parity_tolerance) instead
+    # of bit-exactness — int8 is a different numeric decoder by design.
+    if os.environ.get("BENCH_QUANT", "0") == "1":
+        from qldpc_fault_tolerance_tpu.ops.bp_pallas import (
+            INT8_WER_RTOL, int8_parity_tolerance)
+
+        try:
+            sim_f32, _, _ = make_kernel_sim(None)
+            sim_q, dqx, dqz = make_kernel_sim(None, quantize="int8")
+            r_f32, r_q, wer_f32, wer_q = ab_min4(sim_f32, sim_q)
+            tol = int8_parity_tolerance(wer_f32[0], shots)
+            quant_ab = {
+                "f32_shots_per_s": round(r_f32, 1),
+                "int8_shots_per_s": round(r_q, 1),
+                "int8_speedup_vs_f32": round(r_q / r_f32, 2),
+                "int8_variant": joint_kernel_variant(dqx, dqz,
+                                                     batch_size=batch),
+                "wer_f32": wer_f32[0],
+                "wer_int8": wer_q[0],
+                "wer_abs_delta": abs(wer_q[0] - wer_f32[0]),
+                "wer_tolerance": tol,
+                "wer_rtol": INT8_WER_RTOL,
+                "wer_parity_ok": bool(abs(wer_q[0] - wer_f32[0]) <= tol),
+            }
+        except Exception as e:  # an arm failing must not kill the round
+            quant_ab = {"error": f"{type(e).__name__}: {e}"[:300]}
+    else:
+        quant_ab = {"skipped": "BENCH_QUANT!=1"}
+
     out_ab = {}
     if run_ab:
         # dense-uint8 A/B arm: same shapes, same key, same median-of-3
@@ -656,7 +768,8 @@ def mode_bp():
         rate_other = shots / sorted(times_other)[1]
         # label the main arm by what actually ran: the fused sampler is a
         # different substrate (own PRNG stream), not the packed layer
-        main = "fused" if fused else ("packed" if packed else "dense")
+        main = (("fused_v2" if fused == "v2" else "fused") if fused
+                else ("packed" if packed else "dense"))
         ab_other = "dense" if packed else "packed"
         out_ab = {
             f"{main}_shots_per_s": round(rate, 1),
@@ -689,6 +802,12 @@ def mode_bp():
         "vs_baseline": round(rate / baseline_rate, 1),
         "packed": packed,
         "fused_sampler": fused,
+        # ISSUE 9: which BP kernel served the headline arm (the decoders'
+        # resolved routing — dense_onehot/sparse_gather/sparse_int8/
+        # xla_twin), plus the kernel and quantization A/B blocks
+        "bp_kernel_variant": bp_kernel_variant,
+        "kernel_ab": kernel_ab,
+        "quant_ab": quant_ab,
         "dispatches_per_run": int(sim.last_dispatches),
         "shots_per_dispatch": batch * min(n_batches, sim._scan_chunk),
         "sample_synd_bytes_per_shot_dense": dense_bps,

@@ -27,6 +27,7 @@ from .osd import osd_postprocess
 
 __all__ = [
     "device_syndrome_width",
+    "kernel_variant",
     "BPDecoder",
     "BPOSD_Decoder",
     "FirstMinBPDecoder",
@@ -154,7 +155,10 @@ def decode_device(static, state, syndromes):
         )
         return corr, {"final_weight": w}
     assert kind == "bp", kind
-    _, max_iter, method, msf, two_phase, _has_pallas = static
+    # head_tag routes the kernel variant: "none" (plain XLA), "v1" (dense
+    # one-hot Pallas), "v2" (sparse index-gather), "v2_int8" (quantized
+    # v2); the array side of the head rides in state["pallas"]
+    _, max_iter, method, msf, two_phase, head_tag = static
     if (two_phase and syndromes.ndim == 2
             and syndromes.shape[0] >= bp.TWO_PHASE_MIN_BATCH
             and max_iter >= bp.TWO_PHASE_MIN_ITER):
@@ -162,6 +166,7 @@ def decode_device(static, state, syndromes):
             state["graph"], syndromes, state["llr0"],
             max_iter=max_iter, method=method, ms_scaling_factor=msf,
             pallas_head=state["pallas"],
+            quantize="int8" if head_tag == "v2_int8" else None,
         )
     else:
         res = bp.bp_decode(
@@ -190,24 +195,130 @@ def device_syndrome_width(static, state) -> int:
     return int(state["graph"].chk_mask.shape[0])
 
 
-def _maybe_pallas_head(bp_method: str, graph_host):
-    """VMEM-resident Pallas head when the backend/method/size allow it —
-    the construction-time gate shared by ``BPDecoder.__init__`` and the
-    factory classes' ``GetDecoderState`` fast path (one definition, so the
-    two can never disagree about what program a decoder runs)."""
+def _maybe_pallas_head(bp_method: str, graph_host, quantize=None,
+                       kernel: str | None = None):
+    """Resolve the decoder's BP head: ``(head_object, head_tag)`` — the
+    construction-time gate shared by ``BPDecoder.__init__`` and the factory
+    classes' ``GetDecoderState`` fast path (one definition, so the two can
+    never disagree about what program a decoder runs).
+
+    ``kernel`` (default env ``QLDPC_BP_KERNEL``, "v2") selects the Pallas
+    generation: "v2" = sparse index-gather incidence (ops/bp_pallas
+    SparseHeadGraph — the only head honoring ``quantize``), "v1" = the
+    dense one-hot stack, "xla" = no head.  Tags: "none"/"v1"/"v2"/
+    "v2_int8" — the tag rides in ``device_static`` so the traced program
+    (and every jit cache key) names its kernel.
+
+    A ``quantize`` request builds the v2 head on ANY backend: off-TPU the
+    head routes to the bit-exact XLA twin, so the int8 numerics (and their
+    WER-parity contract) are testable on CPU."""
     if bp_method != "minimum_sum" or os.environ.get("QLDPC_PALLAS",
                                                     "1") == "0":
-        return None
+        if quantize:
+            raise ValueError(
+                "quantize='int8' needs the min-sum v2 head (QLDPC_PALLAS=0 "
+                "or a non-min-sum method disables it)")
+        return None, "none"
+    kernel = kernel or os.environ.get("QLDPC_BP_KERNEL", "v2")
+    if kernel not in ("v1", "v2", "xla"):
+        raise ValueError(f"unknown QLDPC_BP_KERNEL {kernel!r}")
     try:
         on_tpu = jax.default_backend() == "tpu"
     except Exception:
         on_tpu = False
-    if not on_tpu:
-        return None
-    from ..ops.bp_pallas import build_pallas_head
+    from ..ops.bp_pallas import build_pallas_head, build_sparse_head
 
+    if quantize:
+        if kernel == "v1":
+            raise ValueError("quantize='int8' requires the v2 kernel")
+        from ..ops.bp_pallas import v2_mosaic_supported
+
+        sg = build_sparse_head(graph_host)
+        if not sg.fits_vmem():
+            raise ValueError(
+                f"quantize='int8' head infeasible for this shape "
+                f"(fixed VMEM overhead {sg.fixed_overhead_bytes})")
+        # fail FAST here rather than on every decode: int8 was explicitly
+        # requested, so a toolchain whose mosaic lowering rejects the v2
+        # kernel shape should surface at construction (off-TPU the probe
+        # is trivially True — the twin serves)
+        if not v2_mosaic_supported(quantize="int8"):
+            raise ValueError(
+                "quantize='int8' requested but this TPU toolchain fails "
+                "the one-time v2/int8 mosaic probe "
+                "(ops.bp_pallas.v2_mosaic_supported)")
+        return sg, "v2_int8"
+    if not on_tpu or kernel == "xla":
+        return None, "none"
+    if kernel == "v2":
+        from ..ops.bp_pallas import v2_mosaic_supported
+
+        sg = build_sparse_head(graph_host)
+        if sg.fits_vmem() and v2_mosaic_supported():
+            return sg, "v2"
+        # v2's gate admits everything v1's does, but stay honest: fall
+        # through to v1's own gate (or, when the one-time mosaic probe
+        # failed, to the proven v1 kernel) rather than silently going XLA
     pg = build_pallas_head(graph_host)
-    return pg if pg.fits_vmem() else None
+    if pg.fits_vmem():
+        return pg, "v1"
+    return None, "none"
+
+
+def _head_engages(static, state, batch_size: int) -> bool:
+    """Whether a "bp" decode of ``batch_size`` shots actually enters the
+    Pallas-head path (mirrors the gates in ``decode_device`` /
+    ``bp.bp_decode_two_phase``): two-phase eligibility plus the per-batch
+    tile gates.  Used by ``kernel_variant`` so a decode the head
+    disengages from (sub-TWO_PHASE_MIN_BATCH, non-dividing bucket, no
+    feasible tile) reports the f32 XLA path it really runs, not the
+    kernel its head tag names."""
+    _, max_iter, _method, _msf, two_phase, _tag = static
+    if not (two_phase and batch_size >= bp.TWO_PHASE_MIN_BATCH
+            and max_iter >= bp.TWO_PHASE_MIN_ITER):
+        return False
+    head = (state or {}).get("pallas")
+    if head is None:
+        return False
+    pallas_block = 256  # bp_decode_two_phase's default
+    return (batch_size % pallas_block == 0
+            and head.max_block_b(batch_size, want=pallas_block) > 0)
+
+
+def kernel_variant(static, state, batch_size: int | None = None) -> str:
+    """Which BP kernel a value-based decode with this (static, state) pair
+    actually routes to — one of ``ops.bp_pallas.KERNEL_VARIANTS``
+    (dense_onehot / sparse_gather / sparse_int8 / xla_twin).  Resolves
+    through the bposd/space-time wrappers; decoders without a BP stage
+    (FirstMin) report "xla_twin".  With ``batch_size`` the per-batch
+    engage gates apply too, so e.g. a quantized decoder serving a
+    sub-``TWO_PHASE_MIN_BATCH`` request reports the exact-f32 "xla_twin"
+    path it really runs.  This is what the engines publish as the
+    ``bp.kernel_variant`` gauge and the ``wer_run`` event field, and what
+    serve sessions record per compiled bucket — silent routing to the XLA
+    twin is no longer traceless."""
+    kind = static[0]
+    if kind == "bposd_dev":
+        return kernel_variant(static[1], state, batch_size)
+    if kind == "st_syndrome":
+        return kernel_variant(static[4], state, batch_size)
+    if kind != "bp":
+        return "xla_twin"
+    head_tag = static[5]
+    if head_tag in ("none", False, None):
+        return "xla_twin"
+    if batch_size is not None and not _head_engages(static, state,
+                                                    batch_size):
+        return "xla_twin"
+    if head_tag in ("v2", "v2_int8"):
+        from ..ops.bp_pallas import sparse_serves_pallas
+
+        if not sparse_serves_pallas():
+            return "xla_twin"
+        return "sparse_int8" if head_tag == "v2_int8" else "sparse_gather"
+    if head_tag == "v1" or head_tag is True:   # pre-v2 statics used a bool
+        return "dense_onehot"
+    return "xla_twin"
 
 
 class FusedBPPair:
@@ -267,7 +378,9 @@ class BPDecoder:
     """Plain BP decoder (reference BPDecoder, src/Decoders.py:77-90)."""
 
     def __init__(self, h, channel_probs, max_iter, bp_method="minimum_sum",
-                 ms_scaling_factor=0.625, two_phase: bool = True):
+                 ms_scaling_factor=0.625, two_phase: bool = True,
+                 quantize: str | None = None,
+                 bp_kernel: str | None = None):
         self.h = np.asarray(h)
         self._h01 = gf2.to_gf2(h)
         self._graph_host = bp.build_tanner_graph_host(self._h01)
@@ -283,12 +396,22 @@ class BPDecoder:
         # straggler compaction (ops/bp.bp_decode_two_phase): bit-identical
         # results, ~max_iter/head_iters less HBM traffic at low p
         self.two_phase = bool(two_phase)
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unknown quantize mode {quantize!r}")
+        # int8 min-sum messages on the v2 head (ops/bp_pallas): NOT
+        # bit-exact with the f32/bf16 decoders — statistical WER parity
+        # within the documented tolerance (README "BP kernel v2")
+        self.quantize = quantize
         self.llr0 = bp.llr_from_probs(self.channel_probs)
         # VMEM-resident Pallas head (ops/bp_pallas): ~10x head throughput on
         # TPU; stragglers still go through the exact f32 XLA tail.  Gated on
-        # backend, method, and the incidence stack fitting VMEM.
-        self._pallas_head = _maybe_pallas_head(self.bp_method,
-                                               self._graph_host)
+        # backend, method, and the incidence data fitting VMEM.  v2 (sparse
+        # index-gather incidence) is the default; ``bp_kernel`` (or env
+        # QLDPC_BP_KERNEL) = "v1"|"xla" selects the dense one-hot stack /
+        # plain XLA for A/B work (bench.py kernel arms).
+        self._pallas_head, self._head_tag = _maybe_pallas_head(
+            self.bp_method, self._graph_host, quantize=self.quantize,
+            kernel=bp_kernel)
 
     needs_host_postprocess = False
 
@@ -298,7 +421,13 @@ class BPDecoder:
         """Hashable program config — goes into the jit cache key."""
         return ("bp", self.max_iter, self.bp_method,
                 float(self.ms_scaling_factor), self.two_phase,
-                self._pallas_head is not None)
+                self._head_tag)
+
+    @property
+    def kernel_variant(self) -> str:
+        """Which BP kernel this decoder's decodes route to (one of
+        ``ops.bp_pallas.KERNEL_VARIANTS``)."""
+        return kernel_variant(self.device_static, self.device_state)
 
     @property
     def device_state(self):
@@ -330,6 +459,7 @@ class BPDecoder:
                 method=self.bp_method,
                 ms_scaling_factor=self.ms_scaling_factor,
                 pallas_head=self._pallas_head,
+                quantize=self.quantize,
             )
         return bp.bp_decode(
             self.graph,
@@ -702,12 +832,15 @@ class BPOSD_Decoder_Class(DecoderClass):
 
 
 class BP_Decoder_Class(DecoderClass):
-    """src/Decoders.py:141-172."""
+    """src/Decoders.py:141-172.  ``quantize`` (extra, default None) builds
+    int8-min-sum decoders — the BENCH_QUANT A/B arm and int8 serve
+    sessions come through here."""
 
-    def __init__(self, max_iter_ratio, bp_method, ms_scaling_factor):
+    def __init__(self, max_iter_ratio, bp_method, ms_scaling_factor,
+                 quantize: str | None = None):
         self.decoder_default_params = {
             "max_iter_ratio": max_iter_ratio, "bp_method": bp_method,
-            "ms_scaling_factor": ms_scaling_factor,
+            "ms_scaling_factor": ms_scaling_factor, "quantize": quantize,
         }
 
     def GetDecoder(self, code_and_noise_channel_params):
@@ -721,6 +854,7 @@ class BP_Decoder_Class(DecoderClass):
             max_iter=num_qubits / d["max_iter_ratio"],
             bp_method=d["bp_method"],
             ms_scaling_factor=d["ms_scaling_factor"],
+            quantize=d.get("quantize"),
         )
 
     def GetDecoderState(self, code_and_noise_channel_params):
@@ -738,10 +872,10 @@ class BP_Decoder_Class(DecoderClass):
         graph_host = bp.build_tanner_graph_host(h01)
         graph = bp.build_tanner_graph(h01)
         method = _norm_method(d["bp_method"])
-        pallas = _maybe_pallas_head(method, graph_host)
+        pallas, head_tag = _maybe_pallas_head(method, graph_host,
+                                              quantize=d.get("quantize"))
         static = ("bp", max(1, int(num_qubits / d["max_iter_ratio"])),
-                  method, float(d["ms_scaling_factor"]), True,
-                  pallas is not None)
+                  method, float(d["ms_scaling_factor"]), True, head_tag)
         channel = np.broadcast_to(
             np.asarray(probs, np.float64), (h01.shape[1],)).copy()
         state = {"graph": graph, "llr0": bp.llr_from_probs(channel),

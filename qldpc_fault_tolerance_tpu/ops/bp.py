@@ -463,7 +463,7 @@ def two_phase_head2_iters(head_iters: int, max_iter: int) -> int:
     jax.jit,
     static_argnames=(
         "max_iter", "method", "head_iters", "tail_capacity", "sectors",
-        "pallas_block", "ms_scaling_factor",
+        "pallas_block", "ms_scaling_factor", "quantize",
     ),
 )
 def bp_decode_two_phase(
@@ -479,6 +479,7 @@ def bp_decode_two_phase(
     sectors: tuple | None = None,
     pallas_head=None,
     pallas_block: int = 256,
+    quantize: str | None = None,
 ) -> BPResult:
     """Straggler-compacted BP: run ``head_iters`` for the whole batch, then
     decode only the unconverged shots (gathered into a fixed-capacity
@@ -512,7 +513,13 @@ def bp_decode_two_phase(
     llr0 = jnp.broadcast_to(jnp.asarray(channel_llr, jnp.float32), (b, n))
 
     # Head and tail run in the VMEM-resident Pallas kernel when the caller
-    # provides its compiled incidence stack (decoders build it once per H).
+    # provides its compiled incidence data (decoders build it once per H):
+    # a v1 PallasHeadGraph (dense one-hot stack) or a v2 SparseHeadGraph
+    # (index-gather incidence, optional int8 messages — the only head type
+    # that honors ``quantize``).
+    from .bp_pallas import SparseHeadGraph, bp_head_pallas, bp_head_sparse
+
+    head_is_v2 = isinstance(pallas_head, SparseHeadGraph)
     use_pallas = (
         pallas_head is not None
         and sectors is None
@@ -521,15 +528,22 @@ def bp_decode_two_phase(
         and np.ndim(channel_llr) == 1
         and pallas_head.max_block_b(b, want=pallas_block) > 0
     )
+
+    def run_kernel(synd, iters, block, early_stop=False):
+        if head_is_v2:
+            return bp_head_sparse(
+                pallas_head, synd, jnp.asarray(channel_llr, jnp.float32),
+                head_iters=iters, ms_scaling_factor=float(ms_scaling_factor),
+                block_b=block, early_stop=early_stop, quantize=quantize)
+        return bp_head_pallas(
+            pallas_head, synd, jnp.asarray(channel_llr, jnp.float32),
+            head_iters=iters, ms_scaling_factor=float(ms_scaling_factor),
+            block_b=block, early_stop=early_stop)
+
     def run_head(iters):
         if use_pallas:
-            from .bp_pallas import bp_head_pallas
-
-            return bp_head_pallas(
-                pallas_head, syndromes, channel_llr, head_iters=iters,
-                ms_scaling_factor=float(ms_scaling_factor),
-                block_b=pallas_head.max_block_b(b, want=pallas_block),
-            )
+            return run_kernel(syndromes, iters,
+                              pallas_head.max_block_b(b, want=pallas_block))
         return bp_decode(
             graph, syndromes, channel_llr, max_iter=iters, method=method,
             ms_scaling_factor=ms_scaling_factor, sectors=sectors,
@@ -562,15 +576,9 @@ def bp_decode_two_phase(
                 # tail in the same VMEM-resident kernel, as one wide tile
                 # with early exit (the XLA while-loop pays ~0.15ms of
                 # sequential latency per iteration at straggler batch sizes)
-                from .bp_pallas import bp_head_pallas
-
-                tail = bp_head_pallas(
-                    pallas_head, synd_ext[idx],
-                    jnp.asarray(channel_llr, jnp.float32),
-                    head_iters=max_iter,
-                    ms_scaling_factor=float(ms_scaling_factor),
-                    block_b=pallas_head.max_block_b(capacity),
-                    early_stop=True,
+                tail = run_kernel(
+                    synd_ext[idx], max_iter,
+                    pallas_head.max_block_b(capacity), early_stop=True,
                 )
             else:
                 tail = bp_decode(

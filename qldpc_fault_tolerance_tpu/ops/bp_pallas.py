@@ -33,6 +33,31 @@ check is exact).  Use ``bp_decode`` for bit-exact f32 reference behavior.
 
 The kernel is used as the head phase of two-phase decoding
 (``decoders.BPDecoder``): stragglers are re-decoded by the exact XLA tail.
+
+BP kernel v2 (sparse incidence)
+-------------------------------
+The v1 stack above keeps the whole (rw, m, n) bf16 one-hot incidence
+RESIDENT in VMEM, which busts the 8 MB gate at N>=1225 and routes the
+paper's large HGP codes off the fast path entirely.  ``SparseHeadGraph``
+replaces it with the index-gather edge representation: slot-major
+``(rw, m)`` int32 column indices plus a validity mask — a few KB instead of
+MBs — and each slot's one-hot operand is SYNTHESIZED in-register from the
+indices (``idx[s][:, None] == iota_n``) at the moment the MXU needs it, so
+incidence data never occupies standing VMEM and never streams from HBM.
+The synthesized operand carries the exact same 0.0/1.0 bf16 values the v1
+stack loads, and the iteration loop is shared (``_minsum_plane_loop``), so
+the v2 kernel is bit-exact with v1 and with its own XLA twin
+(``bp_head_sparse(backend="xla")`` — the same body on plain jnp arrays).
+
+``quantize="int8"`` switches the loop to int8 min-sum
+(``_minsum_int8_loop``): messages are stored as int8 with one dynamic scale
+per iteration per batch tile, the scatter-accumulate runs as an exact
+int8xint8->int32 MXU product (order-independent — the XLA twin's
+index-scatter produces identical integers), and the posterior accumulates
+through bf16 totals.  The int8 path is NOT bit-exact with the f32/bf16
+decoders — its contract is statistical WER parity within
+``INT8_WER_RTOL`` (see README "BP kernel v2"); kernel vs twin stays
+bit-exact by integer exactness.
 """
 from __future__ import annotations
 
@@ -48,7 +73,40 @@ from jax.experimental.pallas import tpu as pltpu
 from ._pallas_compat import CompilerParams
 from .bp import TannerGraph, BPResult
 
-__all__ = ["PallasHeadGraph", "build_pallas_head", "bp_head_pallas"]
+__all__ = [
+    "PallasHeadGraph", "build_pallas_head", "bp_head_pallas",
+    "SparseHeadGraph", "build_sparse_head", "bp_head_sparse",
+    "KERNEL_VARIANTS", "INT8_WER_RTOL", "int8_parity_tolerance",
+]
+
+# the kernel-variant vocabulary the telemetry layer reports
+# (bp.kernel_variant gauge + wer_run event field): which BP program
+# actually serves a decode —
+#   dense_onehot  — v1 Pallas kernel (resident one-hot stack)
+#   sparse_gather — v2 Pallas kernel (index-synthesized incidence, bf16)
+#   sparse_int8   — v2 Pallas kernel, int8 min-sum messages
+#   xla_twin      — any XLA-served decode (plain f32 bp_decode or the v2
+#                   twin on non-TPU backends / VMEM-gated shapes)
+KERNEL_VARIANTS = ("dense_onehot", "sparse_gather", "sparse_int8",
+                   "xla_twin")
+
+# The int8 quantization contract (README "BP kernel v2", BASELINE.md): an
+# int8 decode's WER must match the unquantized decoder's within
+# INT8_WER_RTOL relative, with a floor of INT8_WER_NSIGMA combined
+# binomial standard errors (so near-zero-failure cells don't fail on
+# counting noise).  bench.py's BENCH_QUANT arm and the tier-1 parity test
+# both consume int8_parity_tolerance so the gate can never drift from the
+# documented contract.
+INT8_WER_RTOL = 0.1
+INT8_WER_NSIGMA = 4.0
+
+
+def int8_parity_tolerance(wer_ref: float, shots: int) -> float:
+    """Allowed |wer_int8 - wer_ref| per the quantization contract."""
+    import math
+
+    sigma = math.sqrt(max(wer_ref * (1.0 - wer_ref), 1e-12) / max(shots, 1))
+    return max(INT8_WER_RTOL * wer_ref, INT8_WER_NSIGMA * sigma)
 
 _BIG = 1e30  # python float: jnp.float32 here would be captured as a traced
              # constant inside the pallas kernel (disallowed)
@@ -166,27 +224,22 @@ def _build_pallas_head(chk_nbr, chk_mask, n: int) -> PallasHeadGraph:
     )
 
 
-def _head_kernel(synd_ref, scat_ref, mask_ref, llr0_ref,
-                 err_ref, conv_ref, llr_ref, iters_ref,
-                 *, rw: int, head_iters: int, scale: float,
-                 early_stop: bool = False):
-    """One batch tile: full iteration loop in VMEM.
+def _minsum_plane_loop(synd_sign, slot_mat, mask, llr0, *, rw: int,
+                       head_iters: int, scale: float, early_stop: bool):
+    """Slot-major scaled-min-sum iteration loop over VMEM planes — the ONE
+    body shared by the v1 dense-one-hot kernel, the v2 sparse-incidence
+    kernel and the v2 XLA twin, so the three can never drift numerically.
 
-    With ``early_stop`` the loop is a while that exits when every shot in
-    the tile has converged — used for the straggler tail, where typical
-    convergence is far below max_iter.
+    ``slot_mat(s)`` supplies slot s's (m, n) bf16 one-hot operand (loaded
+    in v1, synthesized from int32 indices in v2 — same 0.0/1.0 values);
+    ``mask`` is the per-slot (m, 1) f32 validity column list.  Returns
+    ``(err, done, llr, iters)`` batch-last planes with the same freeze-at-
+    convergence semantics as ``bp.bp_decode``.
     """
     f32 = jnp.float32
-    synd_sign = 1.0 - 2.0 * synd_ref[:]                        # (m, Bt) f32 in
-    llr0 = llr0_ref[:].astype(f32)                              # (n, 1)
     bt = synd_sign.shape[1]
     n = llr0.shape[0]
-
-    mask = [mask_ref[s][:, None] for s in range(rw)]            # (m, 1) each
     scale_f = f32(scale)
-
-    def slot_mat(s):
-        return scat_ref[s]                                      # (m, n) bf16
 
     # v2c init: channel LLRs broadcast onto edges; messages are carried in
     # bf16 (halves the VMEM working set — the limiter on tile width)
@@ -276,6 +329,26 @@ def _head_kernel(synd_ref, scat_ref, mask_ref, llr0_ref,
         v2c, err, llr, done, iters = jax.lax.fori_loop(
             0, head_iters, body, init
         )
+    return err, done, llr, iters
+
+
+def _head_kernel(synd_ref, scat_ref, mask_ref, llr0_ref,
+                 err_ref, conv_ref, llr_ref, iters_ref,
+                 *, rw: int, head_iters: int, scale: float,
+                 early_stop: bool = False):
+    """One batch tile: full iteration loop in VMEM (v1, loaded one-hots).
+
+    With ``early_stop`` the loop is a while that exits when every shot in
+    the tile has converged — used for the straggler tail, where typical
+    convergence is far below max_iter.
+    """
+    synd_sign = 1.0 - 2.0 * synd_ref[:]                        # (m, Bt) f32 in
+    llr0 = llr0_ref[:].astype(jnp.float32)                      # (n, 1)
+    mask = [mask_ref[s][:, None] for s in range(rw)]            # (m, 1) each
+
+    err, done, llr, iters = _minsum_plane_loop(
+        synd_sign, lambda s: scat_ref[s], mask, llr0,
+        rw=rw, head_iters=head_iters, scale=scale, early_stop=early_stop)
     # mosaic supports f32->i32 but not f32->u8; callers narrow outside
     err_ref[:] = err.astype(jnp.int32)
     conv_ref[:] = done.astype(jnp.int32)
@@ -364,3 +437,544 @@ def bp_head_pallas(
         posterior_llr=llr.T,
         iterations=iters[0],
     )
+
+
+# ===========================================================================
+# BP kernel v2: sparse (index-gather) incidence + optional int8 min-sum
+# ===========================================================================
+
+# conservative count of synthesized (m, n) bf16 one-hot operands the mosaic
+# scheduler may keep live simultaneously (current slot + transpose copy +
+# pipelining) — the transient that replaces the v1 RESIDENT (rw, m, n) stack
+_V2_ONEHOT_LIVE = 3
+
+# default cap on the v2 kernel's fixed (batch-independent) VMEM overhead:
+# index planes + live synthesized one-hots.  Overridden by a TPU-probed
+# ``gates.bp_head_v2_fixed_limit_bytes`` (scripts/vmem_calibrate.py).
+_V2_FIXED_LIMIT = 16 * 1024 * 1024
+
+
+class SparseHeadGraph(NamedTuple):
+    """v2 per-H data: slot-major edge indices instead of a one-hot stack.
+
+    ``chk_idx[s, i]`` is the variable index of check i's slot-s edge (0 for
+    padding; ``mask`` kills padded slots).  ``nvar`` is a zero-byte (0, n)
+    shape carrier so the tuple stays a plain array pytree while ``n`` rides
+    statically.  Incidence bytes drop from rw*m*n*2 (v1, 17.2 MB at n1600)
+    to rw*m*8 (21.5 KB) — the one-hot operand is synthesized in-register
+    per slot, so large HGP codes stay on the VMEM path.
+    """
+
+    chk_idx: jnp.ndarray   # (rw, m) int32
+    mask: jnp.ndarray      # (rw, m) f32 — 1.0 real edge, 0.0 padding
+    nvar: jnp.ndarray      # (0, n) int8 — static shape carrier only
+
+    @property
+    def rw(self) -> int:
+        return self.chk_idx.shape[0]
+
+    @property
+    def m(self) -> int:
+        return self.chk_idx.shape[1]
+
+    @property
+    def n(self) -> int:
+        return self.nvar.shape[1]
+
+    @property
+    def idx_bytes(self) -> int:
+        return int(np.prod(self.chk_idx.shape)) * 8  # idx i32 + mask f32
+
+    @property
+    def fixed_overhead_bytes(self) -> int:
+        """Batch-independent VMEM working set: the index/mask planes plus
+        the transient synthesized one-hot operands."""
+        return self.idx_bytes + _V2_ONEHOT_LIVE * self.m * self.n * 2
+
+    def fits_vmem(self) -> bool:
+        """v2 residency gate: the FIXED overhead must leave room for batch
+        tiles.  Calibrated via ``gates.bp_head_v2_fixed_limit_bytes``; the
+        conservative default admits n1225/n1600 (fixed ~4.4/7.4 MB), which
+        the v1 scat gate rejects."""
+        from ..utils import profiling
+
+        limit = profiling.vmem_table().get("gates", {}).get(
+            "bp_head_v2_fixed_limit_bytes")
+        if not isinstance(limit, (int, float)) or limit <= 0:
+            limit = _V2_FIXED_LIMIT
+        return self.fixed_overhead_bytes <= limit
+
+    @property
+    def analytic_per_shot_bytes(self) -> int:
+        """Same per-shot plane structure as v1 (bf16 message planes + f32
+        totals/outputs) with the 1.7x-mosaic + 2x-slack fudge; the int8
+        variant only shrinks it, so this is the conservative bound the
+        tile sizing uses for both."""
+        return 2 * (4 * self.rw * self.m + 20 * self.n + 16 * self.m)
+
+    def per_shot_bytes(self) -> float:
+        from ..utils import profiling
+
+        return profiling.calibrated_per_shot_bytes(
+            "bp_head_v2", {"rw": self.rw, "m": self.m, "n": self.n},
+            self.analytic_per_shot_bytes)
+
+    def max_block_b(self, b: int, want: int = 512) -> int:
+        """Largest batch tile <= ``want`` that divides ``b`` and fits the
+        scoped-VMEM budget after the fixed overhead; 0 = no feasible tile
+        (callers fall back to the XLA path)."""
+        per_shot = self.per_shot_bytes()
+        budget = 30 * 1024 * 1024 - self.fixed_overhead_bytes
+        top = min(want, b)
+        for bt in [top] + [1 << k for k in range(9, 2, -1)]:
+            if bt <= top and b % bt == 0 and bt * per_shot <= budget:
+                return bt
+        return 0
+
+
+_sparse_cache = _LruCache()
+
+
+def build_sparse_head(graph: TannerGraph) -> SparseHeadGraph:
+    """Build the slot-major index planes from a TannerGraph (memoized on
+    the adjacency contents, like ``build_pallas_head``)."""
+    chk_nbr = np.asarray(graph.chk_nbr)
+    chk_mask = np.asarray(graph.chk_mask)
+    n = graph.var_nbr.shape[0]
+    key = ("v2", chk_nbr.shape, n, chk_nbr.tobytes(), chk_mask.tobytes())
+
+    def make():
+        return SparseHeadGraph(
+            chk_idx=jax.device_put(
+                np.ascontiguousarray(chk_nbr.T.astype(np.int32))),
+            mask=jax.device_put(
+                np.ascontiguousarray(chk_mask.T.astype(np.float32))),
+            nvar=jax.device_put(np.zeros((0, n), np.int8)),
+        )
+
+    return _sparse_cache.get(key, make)
+
+
+def _synth_onehot(idx_col, mask_col, n: int, dtype):
+    """Slot s's one-hot operand synthesized from its index column:
+    ``(m, n)`` with exactly the 0/1 values the v1 stack stores (zero rows
+    for padding).  ``idx_col``/``mask_col`` are (m, 1)."""
+    m = idx_col.shape[0]
+    cols = jax.lax.broadcasted_iota(jnp.int32, (m, n), 1)
+    cond = (idx_col == cols) & (mask_col > 0)
+    return jnp.where(cond, 1.0, 0.0).astype(dtype)
+
+
+_BIG_I32 = np.int32(2 ** 30)
+
+
+def _minsum_int8_loop(synd_sign, gather_tot, scatter_i8, mask, llr0, *,
+                      rw: int, head_iters: int, scale: float,
+                      early_stop: bool):
+    """int8 min-sum loop shared by the v2 kernel and its XLA twin.
+
+    Messages are int8 with ONE dynamic scale per iteration per batch tile
+    (``qv`` for stored v2c, ``qc`` for the scattered c2v), so check-node
+    mins run on raw int magnitudes and the scatter-accumulate is exact
+    int32 — order-independent, which is what makes the MXU int8 product
+    (kernel) and the index scatter-add (twin) produce identical integers.
+    Only the quantization rounding itself is lossy; its WER contract is
+    ``int8_parity_tolerance``.
+
+    ``gather_tot(s, tot_b)``: exact per-edge read of (n, Bt) bf16 totals
+    -> (m, Bt) f32, zero at padded slots.  ``scatter_i8(c2v_i8_list)``:
+    exact int32 scatter-add of the per-slot int8 messages -> (n, Bt).
+    """
+    f32 = jnp.float32
+    bt = synd_sign.shape[1]
+    n = llr0.shape[0]
+    scale_f = f32(scale)
+    eps = f32(1e-30)
+
+    def tile_max(planes):
+        acc = jnp.zeros((1, 1), f32)
+        for p in planes:
+            acc = jnp.maximum(acc, jnp.max(jnp.abs(p), axis=(0, 1),
+                                           keepdims=True))
+        return acc
+
+    def quantize_planes(planes, q):
+        return [jnp.round(jnp.clip(p / q, -127.0, 127.0)).astype(jnp.int8)
+                for p in planes]
+
+    # init: channel prior gathered onto edges, quantized at a shared scale
+    llr0_tile = (llr0 * jnp.ones((1, bt), f32)).astype(jnp.bfloat16)
+    t0 = [gather_tot(s, llr0_tile) for s in range(rw)]
+    qv0 = jnp.maximum(tile_max(t0) / 127.0, eps)
+    v2c0 = quantize_planes(t0, qv0)
+
+    def body(it, carry):
+        v2c, qv, err, llr, done, iters = carry
+
+        # --- check update on raw int8 magnitudes (min order is scale-
+        # invariant: all planes share qv) ---
+        min1 = jnp.full((mask[0].shape[0], bt), _BIG_I32, jnp.int32)
+        min2 = min1
+        amin = jnp.zeros(min1.shape, jnp.int32)
+        sgn_tot = synd_sign
+        sgn = []
+        for s in range(rw):
+            v = v2c[s].astype(jnp.int32)
+            mag = jnp.where(mask[s] > 0, jnp.abs(v), _BIG_I32)
+            sg = jnp.where((mask[s] > 0) & (v < 0), -1.0, 1.0)
+            sgn.append(sg)
+            sgn_tot = sgn_tot * sg
+            is_new = mag < min1
+            min2 = jnp.where(is_new, min1, jnp.minimum(min2, mag))
+            amin = jnp.where(is_new, s, amin)
+            min1 = jnp.minimum(min1, mag)
+
+        # --- c2v in f32 (dequantized), then requantized at a fresh scale
+        # for the exact integer scatter ---
+        c2v_f = []
+        for s in range(rw):
+            excl = jnp.minimum(jnp.where(amin == s, min2, min1), _BIG_I32)
+            c2v_f.append(mask[s] * (scale_f * sgn_tot * sgn[s]
+                                    * (excl.astype(f32) * qv[0, 0])))
+        qc = jnp.maximum(tile_max(c2v_f) / 127.0, eps)
+        c2v_i8 = quantize_planes(c2v_f, qc)
+
+        tot_i = scatter_i8(c2v_i8)                              # (n, Bt) i32
+        totals = llr0 * jnp.ones((1, bt), f32) \
+            + qc[0, 0] * tot_i.astype(f32)
+
+        err_new = jnp.where(totals < 0.0, 1.0, 0.0)
+        tot_b = totals.astype(jnp.bfloat16)
+        parity = jnp.zeros((mask[0].shape[0], bt), f32)
+        v2c_new_f = []
+        for s in range(rw):
+            t_e = gather_tot(s, tot_b)
+            # subtract exactly what was scattered (the QUANTIZED message)
+            v2c_new_f.append(t_e - qc[0, 0] * c2v_i8[s].astype(f32))
+            parity = parity + jnp.where((t_e < 0.0) & (mask[s] > 0),
+                                        1.0, 0.0)
+
+        par_mod2 = parity - 2.0 * jnp.floor(parity * 0.5)
+        ok = jnp.where((1.0 - 2.0 * par_mod2) == synd_sign, 1.0, 0.0)
+        match = jnp.min(ok, axis=0, keepdims=True)
+
+        newly = match * (1.0 - done)
+        err = done * err + (1.0 - done) * err_new
+        llr = done * llr + (1.0 - done) * totals
+        iters = jnp.where(newly > 0, it + 1, iters)
+        done = jnp.maximum(done, match)
+        qv_new = jnp.maximum(tile_max(v2c_new_f) / 127.0, eps)
+        return (quantize_planes(v2c_new_f, qv_new), qv_new,
+                err, llr, done, iters)
+
+    init = (
+        v2c0,
+        qv0,
+        jnp.zeros((n, bt), f32),
+        llr0 * jnp.ones((1, bt), f32),
+        jnp.zeros((1, bt), f32),
+        jnp.full((1, bt), head_iters, jnp.int32),
+    )
+    if early_stop:
+        def w_cond(c):
+            it, carry = c
+            return (it < head_iters) & (jnp.min(carry[4]) < 0.5)
+
+        def w_body(c):
+            it, carry = c
+            return (it + 1, body(it, carry))
+
+        _, out = jax.lax.while_loop(w_cond, w_body, (jnp.int32(0), init))
+    else:
+        out = jax.lax.fori_loop(0, head_iters, body, init)
+    _, _, err, llr, done, iters = out
+    return err, done, llr, iters
+
+
+def _onehot_matmul_ops(onehot, rw: int):
+    """The MXU gather/scatter pair over synthesized one-hot operands —
+    ONE definition shared by the standalone v2 kernel and the fused-v2
+    pipeline kernel (gf2_pallas), because kernel/twin bit-exactness rests
+    on these bodies staying identical.  ``onehot(s, dtype)`` must return
+    slot s's (m, n) one-hot (mask included)."""
+
+    def gather_tot(s, tot_b):
+        return jnp.dot(onehot(s, jnp.bfloat16), tot_b,
+                       preferred_element_type=jnp.float32)
+
+    def scatter_i8(c2v_i8):
+        acc = None
+        for s in range(rw):
+            part = jax.lax.dot_general(
+                onehot(s, jnp.int8), c2v_i8[s],
+                (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)            # (n, Bt)
+            acc = part if acc is None else acc + part
+        return acc
+
+    return gather_tot, scatter_i8
+
+
+def _run_minsum_tile(idx_planes, mask_planes, synd_sign, llr0, *, rw: int,
+                     n: int, head_iters: int, scale: float,
+                     early_stop: bool, quantize):
+    """One v2 tile over index planes (shared by the standalone kernel and
+    the fused-v2 pipeline kernel): synthesizes the one-hot operands and
+    runs the bf16 or int8 loop.  ``idx_planes[s]`` is (m,)."""
+    mask = [mask_planes[s][:, None] for s in range(rw)]
+
+    def onehot(s, dtype):
+        return _synth_onehot(idx_planes[s][:, None], mask[s], n, dtype)
+
+    if quantize is None:
+        return _minsum_plane_loop(
+            synd_sign, lambda s: onehot(s, jnp.bfloat16), mask, llr0,
+            rw=rw, head_iters=head_iters, scale=scale,
+            early_stop=early_stop)
+    gather_tot, scatter_i8 = _onehot_matmul_ops(onehot, rw)
+    return _minsum_int8_loop(
+        synd_sign, gather_tot, scatter_i8, mask, llr0,
+        rw=rw, head_iters=head_iters, scale=scale, early_stop=early_stop)
+
+
+def _sparse_head_kernel(synd_ref, idx_ref, mask_ref, llr0_ref,
+                        err_ref, conv_ref, llr_ref, iters_ref,
+                        *, rw: int, n: int, head_iters: int, scale: float,
+                        early_stop: bool, quantize):
+    """v2 batch tile: same loop as v1, one-hot operands synthesized from
+    the resident (rw, m) int32 index planes at use time."""
+    synd_sign = 1.0 - 2.0 * synd_ref[:]                        # (m, Bt)
+    llr0 = llr0_ref[:].astype(jnp.float32)                      # (n, 1)
+
+    err, done, llr, iters = _run_minsum_tile(
+        [idx_ref[s] for s in range(rw)],
+        [mask_ref[s] for s in range(rw)],
+        synd_sign, llr0, rw=rw, n=n, head_iters=head_iters, scale=scale,
+        early_stop=early_stop, quantize=quantize)
+    err_ref[:] = err.astype(jnp.int32)
+    conv_ref[:] = done.astype(jnp.int32)
+    llr_ref[:] = llr
+    iters_ref[:] = iters
+
+
+def _sparse_twin_tile(chk_idx, mask_planes, synd_sign, llr0, *, rw: int,
+                      n: int, head_iters: int, scale: float,
+                      early_stop: bool, quantize):
+    """One (m, Bt) tile of the XLA twin — the SAME loop bodies on plain
+    jnp arrays.  The bf16 variant synthesizes the identical one-hot
+    operands; the int8 variant uses true index gathers / integer
+    scatter-adds, which match the kernel's int8 MXU products exactly
+    (integer arithmetic is order-independent)."""
+    if quantize is None:
+        return _run_minsum_tile(
+            [chk_idx[s] for s in range(rw)],
+            [mask_planes[s] for s in range(rw)],
+            synd_sign, llr0, rw=rw, n=n, head_iters=head_iters,
+            scale=scale, early_stop=early_stop, quantize=None)
+
+    mask = [mask_planes[s][:, None] for s in range(rw)]
+    bt = synd_sign.shape[1]
+
+    def gather_tot(s, tot_b):
+        t = jnp.take(tot_b, chk_idx[s], axis=0)                # (m, Bt)
+        return jnp.where(mask[s] > 0, t.astype(jnp.float32), 0.0)
+
+    # padded slots scatter into a scratch row n, sliced off below
+    flat_idx = jnp.concatenate([
+        jnp.where(mask_planes[s] > 0, chk_idx[s], n) for s in range(rw)])
+
+    def scatter_i8(c2v_i8):
+        vals = jnp.concatenate([c.astype(jnp.int32) for c in c2v_i8],
+                               axis=0)                          # (rw*m, Bt)
+        out = jnp.zeros((n + 1, bt), jnp.int32).at[flat_idx].add(vals)
+        return out[:n]
+
+    return _minsum_int8_loop(
+        synd_sign, gather_tot, scatter_i8, mask, llr0, rw=rw,
+        head_iters=head_iters, scale=scale, early_stop=early_stop)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("head_iters", "ms_scaling_factor", "block_b",
+                     "early_stop", "quantize"),
+)
+def _bp_head_sparse_xla(sgraph: SparseHeadGraph, syndromes, channel_llr, *,
+                        head_iters: int, ms_scaling_factor: float,
+                        block_b: int, early_stop: bool, quantize):
+    """XLA twin: the batch reshapes into the kernel's (B/block_b, block_b)
+    tiles and the tile body vmaps over them, so the int8 per-tile scales —
+    and therefore every output bit — match the Pallas kernel exactly."""
+    syndromes = jnp.asarray(syndromes)
+    b, m = syndromes.shape
+    n = sgraph.n
+    llr0 = jnp.asarray(channel_llr, jnp.float32).reshape(n, 1)
+    synd_sign = 1.0 - 2.0 * syndromes.T.astype(jnp.float32)     # (m, B)
+    tiles = b // block_b
+    ss = synd_sign.reshape(m, tiles, block_b).swapaxes(0, 1)
+
+    def tile(s_tile):
+        return _sparse_twin_tile(
+            sgraph.chk_idx, sgraph.mask, s_tile, llr0, rw=sgraph.rw, n=n,
+            head_iters=head_iters, scale=float(ms_scaling_factor),
+            early_stop=early_stop, quantize=quantize)
+
+    err, done, llr, iters = jax.vmap(tile)(ss)
+
+    def unfold(x):
+        return x.swapaxes(0, 1).reshape(x.shape[1], b)
+
+    return BPResult(
+        error=unfold(err).T.astype(jnp.uint8),
+        converged=unfold(done)[0] > 0.5,
+        posterior_llr=unfold(llr).T,
+        iterations=unfold(iters)[0],
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("head_iters", "ms_scaling_factor", "block_b",
+                     "interpret", "early_stop", "quantize"),
+)
+def _bp_head_sparse_pallas(sgraph: SparseHeadGraph, syndromes, channel_llr,
+                           *, head_iters: int, ms_scaling_factor: float,
+                           block_b: int, interpret: bool, early_stop: bool,
+                           quantize):
+    syndromes = jnp.asarray(syndromes)
+    b, m = syndromes.shape
+    assert m == sgraph.m and b % block_b == 0, (b, m, sgraph.m, block_b)
+    n = sgraph.n
+    llr0 = jnp.asarray(channel_llr, jnp.float32).reshape(n, 1)
+
+    kernel = functools.partial(
+        _sparse_head_kernel,
+        rw=sgraph.rw, n=n,
+        head_iters=head_iters,
+        scale=float(ms_scaling_factor),
+        early_stop=early_stop,
+        quantize=quantize,
+    )
+    grid = (b // block_b,)
+    kname = (f"bp_head_v2_{m}x{n}r{sgraph.rw}_i{head_iters}_b{b}x{block_b}"
+             f"{'_es' if early_stop else ''}"
+             f"{'_q8' if quantize else ''}")
+    err, conv, llr, iters = pl.pallas_call(
+        kernel,
+        name=kname,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((m, block_b), lambda t: (0, t)),       # syndromes.T
+            pl.BlockSpec((sgraph.rw, m), lambda t: (0, 0)),     # indices
+            pl.BlockSpec((sgraph.rw, m), lambda t: (0, 0)),     # mask
+            pl.BlockSpec((n, 1), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((n, block_b), lambda t: (0, t)),
+            pl.BlockSpec((1, block_b), lambda t: (0, t)),
+            pl.BlockSpec((n, block_b), lambda t: (0, t)),
+            pl.BlockSpec((1, block_b), lambda t: (0, t)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, b), jnp.int32),
+            jax.ShapeDtypeStruct((1, b), jnp.int32),
+            jax.ShapeDtypeStruct((n, b), jnp.float32),
+            jax.ShapeDtypeStruct((1, b), jnp.int32),
+        ],
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=32 * 1024 * 1024,
+        ),
+        interpret=interpret,
+    )(syndromes.T.astype(jnp.float32), sgraph.chk_idx, sgraph.mask, llr0)
+
+    return BPResult(
+        error=err.T.astype(jnp.uint8),
+        converged=conv[0].astype(jnp.bool_),
+        posterior_llr=llr.T,
+        iterations=iters[0],
+    )
+
+
+def sparse_serves_pallas() -> bool:
+    """True when ``bp_head_sparse(backend="auto")`` routes to the mosaic
+    kernel (the telemetry variant resolver keys on this)."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+_V2_MOSAIC_PROBE: dict = {}
+
+
+def v2_mosaic_supported(quantize: str | None = None) -> bool:
+    """One-time per-process probe that the v2 kernel's mosaic lowering
+    (in-register one-hot synthesis: broadcasted_iota + eq + select; plus
+    the int8 MXU product for ``quantize="int8"``) holds on this
+    toolchain: compiles one small real kernel the first time the v2 head
+    is selected on TPU.  A bf16 failure routes the process's default
+    kernel selection back to v1 (``_maybe_pallas_head``) instead of
+    crashing every decode — the variant telemetry then shows
+    ``dense_onehot``, so the fallback is visible, not silent; an int8
+    failure makes ``quantize="int8"`` construction fail fast.  Off-TPU
+    (twin path) this is trivially True and compiles nothing."""
+    if quantize in _V2_MOSAIC_PROBE:
+        return _V2_MOSAIC_PROBE[quantize]
+    if not sparse_serves_pallas():
+        ok = True
+    else:
+        try:
+            from .bp import build_tanner_graph_host, llr_from_probs
+
+            h = np.zeros((6, 13), np.uint8)  # hgp_rep3's hx shape
+            h[:, :6] += np.eye(6, dtype=np.uint8)
+            h[:, 6:12] += np.eye(6, dtype=np.uint8)
+            h[:, 12] = 1
+            sg = build_sparse_head(build_tanner_graph_host(h))
+            synd = jnp.zeros((128, 6), jnp.uint8)
+            _bp_head_sparse_pallas.lower(
+                sg, synd, llr_from_probs(np.full(13, 0.01)),
+                head_iters=2, ms_scaling_factor=0.625, block_b=128,
+                interpret=False, early_stop=False, quantize=quantize,
+            ).compile()
+            ok = True
+        except Exception:
+            ok = False
+    _V2_MOSAIC_PROBE[quantize] = ok
+    return ok
+
+
+def bp_head_sparse(
+    sgraph: SparseHeadGraph,
+    syndromes,
+    channel_llr,
+    *,
+    head_iters: int,
+    ms_scaling_factor: float = 0.625,
+    block_b: int = 256,
+    interpret: bool = False,
+    early_stop: bool = False,
+    quantize: str | None = None,
+    backend: str = "auto",
+) -> BPResult:
+    """v2 decode of a (B, m) syndrome batch; B must divide by block_b.
+
+    Same BPResult contract as ``bp_head_pallas``.  ``backend`` routes:
+    "auto" = Pallas kernel on TPU, XLA twin elsewhere (bit-exact with the
+    kernel — shared bodies, matching batch tiles); "pallas"/"xla" force a
+    path (tests, probes).  ``quantize="int8"`` selects the int8 min-sum
+    loop on either path.
+    """
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unknown quantize mode {quantize!r}")
+    use_kernel = interpret or backend == "pallas" or (
+        backend == "auto" and sparse_serves_pallas())
+    if use_kernel:
+        return _bp_head_sparse_pallas(
+            sgraph, syndromes, channel_llr, head_iters=head_iters,
+            ms_scaling_factor=float(ms_scaling_factor), block_b=block_b,
+            interpret=interpret, early_stop=early_stop, quantize=quantize)
+    return _bp_head_sparse_xla(
+        sgraph, syndromes, channel_llr, head_iters=head_iters,
+        ms_scaling_factor=float(ms_scaling_factor), block_b=block_b,
+        early_stop=early_stop, quantize=quantize)

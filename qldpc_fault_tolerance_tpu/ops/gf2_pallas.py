@@ -59,6 +59,12 @@ __all__ = [
     "pallas_feasible",
     "estimate_vmem_bytes",
     "vmem_feasible",
+    "FusedDecodeSpec",
+    "build_fused_decode_spec",
+    "fused_decode_stats",
+    "fused_decode_block_w",
+    "estimate_fused_decode_bytes",
+    "fused_decode_feasible",
 ]
 
 
@@ -534,3 +540,352 @@ def residual_check_stats(spec: FusedSpec, key, batch_size: int,
                                       eval_type, block_w, interpret)
     return _residual_check_xla(spec, key, batch_size, corx_p, corz_p,
                                eval_type)
+
+
+# ===========================================================================
+# Fused v2: sample -> syndrome -> BP -> residual check, ONE Pallas program
+# per megabatch tile.  The v1 fused path (above) still round-trips the
+# packed syndromes and BP corrections through HBM between its two kernels
+# and the XLA BP program; here the whole per-shot pipeline lives and dies
+# in VMEM — HBM traffic per shot drops to the per-tile stats scalars plus
+# (optional) 8 bytes of convergence/iteration telemetry.  BP runs the v2
+# sparse-incidence loop (ops/bp_pallas) at full depth with per-tile early
+# exit; ``quantize="int8"`` composes.  The XLA twin chains the existing
+# twins (counter draws -> packed SpMV -> v2 BP twin -> packed residual
+# stats) and is bit-exact with the kernel by shared bodies + exact GF(2).
+# ===========================================================================
+from .bp_pallas import (  # noqa: E402  (acyclic: bp_pallas imports only bp)
+    _run_minsum_tile,
+)
+
+
+class FusedDecodeSpec(NamedTuple):
+    """Per-(code, channel, decoder-priors) device data for the fused v2
+    pipeline: the v1 FusedSpec plus both sectors' sparse BP incidence and
+    channel-LLR priors.  A plain array pytree (rides through jit as a
+    value; all static dims derive from shapes)."""
+
+    base: FusedSpec
+    zg_idx: jnp.ndarray     # (rw_z, mx) int32 — graph of hx (decodes synd_z)
+    zg_mask: jnp.ndarray    # (rw_z, mx) f32
+    xg_idx: jnp.ndarray     # (rw_x, mz) int32 — graph of hz (decodes synd_x)
+    xg_mask: jnp.ndarray    # (rw_x, mz) f32
+    llr_z: jnp.ndarray      # (n, 1) f32
+    llr_x: jnp.ndarray      # (n, 1) f32
+
+    @property
+    def n(self) -> int:
+        return self.base.hx_t.shape[0]
+
+    @property
+    def mx(self) -> int:
+        return self.base.hx_t.shape[1]
+
+    @property
+    def mz(self) -> int:
+        return self.base.hz_t.shape[1]
+
+
+_decode_spec_cache = _LruCache()
+
+
+def build_fused_decode_spec(hx, hz, lx, lz, pauli_error_probs,
+                            llr_x, llr_z) -> FusedDecodeSpec:
+    """Build (memoized) the fused-decode spec.  ``llr_x``/``llr_z`` are the
+    decoders' channel-LLR priors ((n,) f32 — ``BPDecoder.llr0``); the BP
+    incidence comes from the per-H Tanner memos (ops/bp)."""
+    from .bp import build_tanner_graph_host
+
+    hx = (np.asarray(hx) != 0).astype(np.uint8)
+    hz = (np.asarray(hz) != 0).astype(np.uint8)
+    llr_x = np.asarray(llr_x, np.float32).reshape(-1)
+    llr_z = np.asarray(llr_z, np.float32).reshape(-1)
+    base = build_fused_spec(hx, hz, lx, lz, pauli_error_probs)
+    key = ("v2", hx.shape, hz.shape, hx.tobytes(), hz.tobytes(),
+           np.asarray(base.cuts).tobytes(), llr_x.tobytes(),
+           llr_z.tobytes())
+
+    def make():
+        gz = build_tanner_graph_host(hx)
+        gx = build_tanner_graph_host(hz)
+        return FusedDecodeSpec(
+            base=base,
+            zg_idx=jnp.asarray(np.ascontiguousarray(
+                np.asarray(gz.chk_nbr).T.astype(np.int32))),
+            zg_mask=jnp.asarray(np.ascontiguousarray(
+                np.asarray(gz.chk_mask).T.astype(np.float32))),
+            xg_idx=jnp.asarray(np.ascontiguousarray(
+                np.asarray(gx.chk_nbr).T.astype(np.int32))),
+            xg_mask=jnp.asarray(np.ascontiguousarray(
+                np.asarray(gx.chk_mask).T.astype(np.float32))),
+            llr_z=jnp.asarray(llr_z).reshape(-1, 1),
+            llr_x=jnp.asarray(llr_x).reshape(-1, 1),
+        )
+
+    return _decode_spec_cache.get(key, make)
+
+
+def _fused_decode_kernel(par_ref, hx_t_ref, hz_t_ref, lx_t_ref, lz_t_ref,
+                         zg_idx_ref, zg_mask_ref, xg_idx_ref, xg_mask_ref,
+                         llrz_ref, llrx_ref,
+                         cnt_ref, minw_ref, convz_ref, iterz_ref,
+                         convx_ref, iterx_ref,
+                         *, block_w: int, n: int, mx: int, mz: int,
+                         rwz: int, rwx: int, max_iter_z: int,
+                         max_iter_x: int, scale: float, quantize,
+                         eval_code: int):
+    """One megabatch tile, whole pipeline in VMEM: counter-PRNG sample,
+    both syndrome SpMVs, both sectors' full BP decodes, residual
+    stabilizer/logical checks — only the per-tile stats (and the 8-byte
+    convergence/iteration planes the telemetry vector folds) reach HBM."""
+    f32 = jnp.float32
+    ex, ez = _sample_block(par_ref, block_w, n)
+    bt = block_w * LANE
+    ex2 = ex.reshape(bt, n)
+    ez2 = ez.reshape(bt, n)
+    synd_z = _gf2_dense(ez2.astype(f32), hx_t_ref[:])           # (bt, mx)
+    synd_x = _gf2_dense(ex2.astype(f32), hz_t_ref[:])           # (bt, mz)
+
+    def decode(idx_ref, mask_ref, synd, llr0, rw, max_iter):
+        synd_sign = (1.0 - 2.0 * synd).T                        # (m, bt)
+        err, done, _llr, iters = _run_minsum_tile(
+            [idx_ref[s] for s in range(rw)],
+            [mask_ref[s] for s in range(rw)],
+            synd_sign, llr0.astype(f32), rw=rw, n=n,
+            head_iters=max_iter, scale=scale, early_stop=True,
+            quantize=quantize)
+        return err.T.astype(jnp.int32), done, iters             # (bt, n)
+
+    cor_z, done_z, iters_z = decode(zg_idx_ref, zg_mask_ref, synd_z,
+                                    llrz_ref[:], rwz, max_iter_z)
+    cor_x, done_x, iters_x = decode(xg_idx_ref, xg_mask_ref, synd_x,
+                                    llrx_ref[:], rwx, max_iter_x)
+
+    res_x = (ex2 ^ cor_x).astype(f32)
+    res_z = (ez2 ^ cor_z).astype(f32)
+    x_stab = jnp.max(_gf2_dense(res_x, hz_t_ref[:]), axis=1)    # (bt,)
+    x_log = jnp.max(_gf2_dense(res_x, lz_t_ref[:]), axis=1)
+    z_stab = jnp.max(_gf2_dense(res_z, hx_t_ref[:]), axis=1)
+    z_log = jnp.max(_gf2_dense(res_z, lx_t_ref[:]), axis=1)
+    x_fail = jnp.maximum(x_stab, x_log)
+    z_fail = jnp.maximum(z_stab, z_log)
+    if eval_code == 0:
+        fail = x_fail
+    elif eval_code == 1:
+        fail = z_fail
+    else:
+        fail = jnp.maximum(x_fail, z_fail)
+    cnt_ref[0, 0] = jnp.sum(fail, dtype=f32).astype(jnp.int32)
+    big = f32(n)
+    wx = jnp.where(x_log > 0, jnp.sum(res_x, axis=1), big)
+    wz = jnp.where(z_log > 0, jnp.sum(res_z, axis=1), big)
+    minw_ref[0, 0] = jnp.minimum(jnp.min(wx), jnp.min(wz)).astype(jnp.int32)
+    convz_ref[:] = done_z.astype(jnp.int32)
+    iterz_ref[:] = iters_z
+    convx_ref[:] = done_x.astype(jnp.int32)
+    iterx_ref[:] = iters_x
+
+
+def _decode_statics(spec: FusedDecodeSpec):
+    return dict(n=spec.n, mx=spec.mx, mz=spec.mz,
+                rwz=spec.zg_idx.shape[0], rwx=spec.xg_idx.shape[0])
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "batch_size", "eval_type", "max_iter_z", "max_iter_x", "scale",
+    "quantize", "block_w", "interpret"))
+def _fused_decode_pallas(spec: FusedDecodeSpec, key, batch_size: int,
+                         eval_type: str, max_iter_z: int, max_iter_x: int,
+                         scale: float, quantize, block_w: int,
+                         interpret: bool):
+    d = _decode_statics(spec)
+    n, mx, mz = d["n"], d["mx"], d["mz"]
+    rwz, rwx = d["rwz"], d["rwx"]
+    assert batch_size % (block_w * LANE) == 0, (batch_size, block_w)
+    bt = block_w * LANE
+    grid = (batch_size // bt,)
+    kernel = functools.partial(
+        _fused_decode_kernel, block_w=block_w, n=n, mx=mx, mz=mz,
+        rwz=rwz, rwx=rwx, max_iter_z=max_iter_z, max_iter_x=max_iter_x,
+        scale=scale, quantize=quantize,
+        eval_code={"X": 0, "Z": 1}.get(eval_type, 2))
+    kname = (f"gf2_fused_decode_{n}x{mx}x{mz}_i{max_iter_z}_w{block_w}"
+             f"{'_q8' if quantize else ''}")
+    cnt, minw, convz, iterz, convx, iterx = pl.pallas_call(
+        kernel,
+        name=kname,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 8), lambda t: (0, 0)),
+            pl.BlockSpec((n, mx), lambda t: (0, 0)),
+            pl.BlockSpec((n, mz), lambda t: (0, 0)),
+            pl.BlockSpec(spec.base.lx_t.shape, lambda t: (0, 0)),
+            pl.BlockSpec(spec.base.lz_t.shape, lambda t: (0, 0)),
+            pl.BlockSpec((rwz, mx), lambda t: (0, 0)),
+            pl.BlockSpec((rwz, mx), lambda t: (0, 0)),
+            pl.BlockSpec((rwx, mz), lambda t: (0, 0)),
+            pl.BlockSpec((rwx, mz), lambda t: (0, 0)),
+            pl.BlockSpec((n, 1), lambda t: (0, 0)),
+            pl.BlockSpec((n, 1), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1), lambda t: (t, 0)),
+            pl.BlockSpec((1, 1), lambda t: (t, 0)),
+            pl.BlockSpec((1, bt), lambda t: (0, t)),
+            pl.BlockSpec((1, bt), lambda t: (0, t)),
+            pl.BlockSpec((1, bt), lambda t: (0, t)),
+            pl.BlockSpec((1, bt), lambda t: (0, t)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((grid[0], 1), jnp.int32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+            jax.ShapeDtypeStruct((1, batch_size), jnp.int32),
+        ],
+        compiler_params=CompilerParams(
+            vmem_limit_bytes=_KERNEL_VMEM_LIMIT,
+        ),
+        interpret=interpret,
+    )(_pack_params(spec.base, key), spec.base.hx_t, spec.base.hz_t,
+      spec.base.lx_t, spec.base.lz_t, spec.zg_idx, spec.zg_mask,
+      spec.xg_idx, spec.xg_mask, spec.llr_z, spec.llr_x)
+    aux_z = {"converged": convz[0] > 0, "iterations": iterz[0]}
+    aux_x = {"converged": convx[0] > 0, "iterations": iterx[0]}
+    return cnt.sum(dtype=jnp.int32), minw.min(), aux_x, aux_z
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "batch_size", "eval_type", "max_iter_z", "max_iter_x", "scale",
+    "quantize", "block_w"))
+def _fused_decode_xla(spec: FusedDecodeSpec, key, batch_size: int,
+                      eval_type: str, max_iter_z: int, max_iter_x: int,
+                      scale: float, quantize, block_w: int):
+    """XLA twin: counter draws -> packed syndrome SpMV -> v2 BP twin (same
+    batch tiles as the kernel, so int8 per-tile scales match) -> packed
+    residual stats.  Bit-exact with the Pallas program word for word."""
+    from .bp_pallas import SparseHeadGraph, _bp_head_sparse_xla
+
+    from .gf2_packed import unpack_shots
+
+    base = spec.base
+    n = base.hx_t.shape[0]
+    k0, k1 = _key_words(key)
+    r = counter_draws(k0, k1, batch_size, n)
+    ex, ez = _errors_from_draws(r, base.cuts)
+    exp = pack_shots(ex.astype(jnp.uint8))
+    ezp = pack_shots(ez.astype(jnp.uint8))
+    sz = unpack_shots(packed_parity_apply(base.hx_nbr, base.hx_mask, ezp),
+                      batch_size)
+    sx = unpack_shots(packed_parity_apply(base.hz_nbr, base.hz_mask, exp),
+                      batch_size)
+
+    def decode(idx, mask, synd, llr0, max_iter):
+        sg = SparseHeadGraph(
+            chk_idx=idx, mask=mask,
+            nvar=jnp.zeros((0, n), jnp.int8))
+        return _bp_head_sparse_xla(
+            sg, synd, llr0.reshape(-1), head_iters=max_iter,
+            ms_scaling_factor=scale, block_b=block_w * LANE,
+            early_stop=True, quantize=quantize)
+
+    res_z = decode(spec.zg_idx, spec.zg_mask, sz, spec.llr_z, max_iter_z)
+    res_x = decode(spec.xg_idx, spec.xg_mask, sx, spec.llr_x, max_iter_x)
+    rx_p = exp ^ pack_shots(res_x.error)
+    rz_p = ezp ^ pack_shots(res_z.error)
+    cnt, minw = packed_residual_stats(
+        rx_p, rz_p, (base.hz_nbr, base.hz_mask),
+        (base.hx_nbr, base.hx_mask), base.lz_t != 0, base.lx_t != 0,
+        eval_type, batch_size, n)
+    aux_z = {"converged": res_z.converged,
+             "iterations": res_z.iterations}
+    aux_x = {"converged": res_x.converged,
+             "iterations": res_x.iterations}
+    return cnt, minw, aux_x, aux_z
+
+
+def estimate_fused_decode_bytes(n: int, mx: int, mz: int, rwz: int,
+                                rwx: int, block_w: int = 4, *,
+                                quantize=None) -> float:
+    """Per-block VMEM working-set estimate for the fused v2 program: the
+    sampling/syndrome planes, the resident dense transposes, the sparse BP
+    incidence + synthesized one-hot transients, and the per-shot BP plane
+    stack of the wider sector — scaled by the calibrated ratio for kernel
+    ``"fused_decode"`` (2x prior until a TPU probe lands)."""
+    from ..utils import profiling
+
+    bt = block_w * LANE
+    draws = bt * n * 4
+    errs = 2 * bt * n * 4
+    mxu = bt * n * 4
+    synd = bt * (mx + mz) * 4
+    mats = (n * mx + n * mz + 2 * n * 8) * 4
+    idx = (rwz * mx + rwx * mz) * 8
+    onehot = 3 * max(mx, mz) * n * 2
+    msg_elem = 1 if quantize else 2
+    per_shot = max(
+        (2 + msg_elem) * rwz * mx + 16 * n + 8 * mx,
+        (2 + msg_elem) * rwx * mz + 16 * n + 8 * mz)
+    analytic = draws + errs + mxu + synd + mats + idx + onehot \
+        + bt * per_shot
+    return analytic * profiling.calibration_ratio("fused_decode", 2.0)
+
+
+def fused_decode_block_w(spec: FusedDecodeSpec, batch_size: int, *,
+                         quantize=None) -> int:
+    """Largest block_w from the ladder whose estimated working set fits the
+    scoped cap and divides the batch; 0 = infeasible (callers fall back to
+    the two-dispatch v1 fused path)."""
+    d = _decode_statics(spec)
+    for bw in (8, 4, 2, 1):
+        if batch_size % (bw * LANE):
+            continue
+        est = estimate_fused_decode_bytes(
+            d["n"], d["mx"], d["mz"], d["rwz"], d["rwx"], bw,
+            quantize=quantize)
+        if est <= _KERNEL_VMEM_LIMIT:
+            return bw
+    return 0
+
+
+def fused_decode_feasible(spec: FusedDecodeSpec, batch_size: int, *,
+                          quantize=None) -> bool:
+    return fused_decode_block_w(spec, batch_size, quantize=quantize) > 0
+
+
+def fused_decode_stats(spec: FusedDecodeSpec, key, batch_size: int, *,
+                       eval_type: str = "Total", max_iter_z: int,
+                       max_iter_x: int, ms_scaling_factor: float = 0.625,
+                       quantize: str | None = None, backend: str = "auto",
+                       block_w: int | None = None,
+                       interpret: bool = False):
+    """Whole-pipeline fused stats batch: returns device values
+    ``(failure_count, min_weight, aux_x, aux_z)`` where the aux dicts carry
+    per-shot ``converged``/``iterations`` (the telemetry vector's inputs).
+
+    The Pallas program serves on TPU when the calibrated estimate fits the
+    scoped-VMEM cap; everywhere else the bit-exact XLA twin runs (same
+    counter-PRNG stream, same BP bodies, same batch tiles)."""
+    if block_w is None:
+        block_w = fused_decode_block_w(spec, batch_size,
+                                       quantize=quantize) or 1
+    if batch_size % (block_w * LANE):
+        raise ValueError(
+            f"fused v2 needs batch_size divisible by {block_w * LANE}, "
+            f"got {batch_size}")
+    scale = float(ms_scaling_factor)
+    try:
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    use_kernel = interpret or backend == "pallas" or (
+        backend == "auto" and not FORCE_XLA_TWIN and on_tpu
+        and fused_decode_feasible(spec, batch_size, quantize=quantize))
+    if use_kernel:
+        return _fused_decode_pallas(
+            spec, key, batch_size, eval_type, int(max_iter_z),
+            int(max_iter_x), scale, quantize, int(block_w), interpret)
+    return _fused_decode_xla(
+        spec, key, batch_size, eval_type, int(max_iter_z),
+        int(max_iter_x), scale, quantize, int(block_w))

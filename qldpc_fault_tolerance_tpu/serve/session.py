@@ -37,6 +37,7 @@ from ..decoders.bp_decoders import (
     DecoderClass,
     _decode_device_jit,
     device_syndrome_width,
+    kernel_variant,
 )
 from ..utils import resilience, telemetry
 
@@ -143,6 +144,12 @@ class DecodeSession:
     def _resolve_state(self) -> None:
         self.static, self.state = self._rebuild()
         self.syndrome_width = device_syndrome_width(self.static, self.state)
+        # which BP kernel the AOT programs will route to (the decode
+        # program is compiled from the SAME (static, state) pair the
+        # offline path uses, so the warm serving path picks up the v2
+        # sparse-incidence routing automatically) — recorded so serving
+        # dashboards can name the kernel behind a session
+        self.kernel_variant = kernel_variant(self.static, self.state)
         telemetry.count("serve.session.builds")
 
     # ------------------------------------------------------------------
@@ -187,7 +194,13 @@ class DecodeSession:
             telemetry.event("serve_session", session=self.name,
                             event="compile", bucket=int(bucket),
                             compile_s=round(dt, 4),
-                            syndrome_width=self.syndrome_width)
+                            syndrome_width=self.syndrome_width,
+                            # per-BUCKET resolution: small buckets can
+                            # disengage the head path (batch gates), so
+                            # the compiled program's variant may differ
+                            # from the session-level one
+                            kernel_variant=kernel_variant(
+                                self.static, self.state, int(bucket)))
             return prog
 
     def warm(self, max_shots: int | None = None) -> list[int]:
@@ -217,7 +230,8 @@ class DecodeSession:
             telemetry.count("serve.session.invalidations")
             telemetry.event("serve_session", session=self.name,
                             event="invalidate",
-                            syndrome_width=self.syndrome_width)
+                            syndrome_width=self.syndrome_width,
+                            kernel_variant=self.kernel_variant)
 
     # ------------------------------------------------------------------
     # serving

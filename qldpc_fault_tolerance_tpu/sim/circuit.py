@@ -465,5 +465,10 @@ class CodeSimulator_Circuit:
             with telemetry.span("wer.circuit"):
                 count, total = self._count_failures(num_samples, key)
             wer = wer_per_cycle(count, total, self.K, self.num_cycles)
-            record_wer_run("circuit", count, total, wer[0])
+            from .common import joint_kernel_variant
+
+            record_wer_run("circuit", count, total, wer[0],
+                           kernel_variant=joint_kernel_variant(
+                               self.decoder1_z, self.decoder2_z,
+                               batch_size=self.batch_size))
         return wer

@@ -684,7 +684,30 @@ def fused_cell_adaptive(prog: FusedCellProgram, *, target_failures: int,
     return failures, shots, min_w
 
 
-def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
+def joint_kernel_variant(*decoders, batch_size: int | None = None) -> str:
+    """The BP kernel variant serving a simulator's decoders (the
+    ``bp.kernel_variant`` satellite): resolves each decoder's
+    ``(device_static, device_state)`` through
+    ``decoders.bp_decoders.kernel_variant`` (with the engine's batch size
+    so per-batch engage gates apply) and joins — all equal gives that
+    variant, a disagreement reports ``"mixed"`` (still a named trace,
+    never silence)."""
+    from ..decoders.bp_decoders import kernel_variant
+
+    vs = set()
+    for dec in decoders:
+        try:
+            vs.add(kernel_variant(dec.device_static, dec.device_state,
+                                  batch_size))
+        except Exception:
+            vs.add("xla_twin")
+    if not vs:
+        return "xla_twin"
+    return vs.pop() if len(vs) == 1 else "mixed"
+
+
+def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
+                   kernel_variant=None):
     """Shared per-run telemetry bookkeeping for every engine's
     WordErrorRate path: the sim.* counters plus one ``wer_run`` event with
     a uniform schema (``dispatches`` is included only when the path tracks
@@ -707,6 +730,17 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None):
               "failures": int(failures), "wer": float(wer)}
     if dispatches is not None:
         fields["dispatches"] = int(dispatches)
+    if kernel_variant is not None:
+        # which BP kernel actually served this run (the silent-XLA-twin
+        # routing trace): the event names it, the gauge encodes it as the
+        # variant's index in ops.bp_pallas.KERNEL_VARIANTS (-1 = mixed)
+        from ..ops.bp_pallas import KERNEL_VARIANTS
+
+        fields["kernel_variant"] = str(kernel_variant)
+        code = (KERNEL_VARIANTS.index(kernel_variant)
+                if kernel_variant in KERNEL_VARIANTS else -1)
+        telemetry.set_gauge("bp.kernel_variant", code)
+        telemetry.count(f"bp.kernel_variant.{kernel_variant}")
     ci = {}
     if diagnostics.active():
         ci = diagnostics.ci_fields(failures, shots)

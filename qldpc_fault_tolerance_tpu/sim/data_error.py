@@ -159,6 +159,36 @@ def _stats_fused(cfg, state, key):
     return stats, aux_x, aux_z
 
 
+def _bp_loop_params(static):
+    """(max_iter, ms_scaling_factor, quantize) off a plain-BP decoder
+    static — the fused v2 program runs the decode INSIDE the kernel, so it
+    consumes the decoder's loop parameters rather than its traced decode
+    program."""
+    kind, max_iter, method, msf, _two_phase, head_tag = static
+    assert kind == "bp" and method == "minimum_sum", static
+    return int(max_iter), float(msf), (
+        "int8" if head_tag == "v2_int8" else None)
+
+
+def _stats_fused_v2(cfg, state, key):
+    """Whole-pipeline fused stats batch (ops/gf2_pallas fused v2): ONE
+    Pallas program per megabatch tile runs counter-PRNG sample -> both
+    syndrome SpMVs -> both sectors' full sparse-incidence BP decodes ->
+    residual checks, so neither the packed GF(2) words nor the BP messages
+    ever round-trip through HBM between stages.  Same counter-PRNG stream
+    as the v1 fused path (``fused_sampler=True``); opt-in via
+    ``fused_sampler="v2"``.  The degradation ladder steps v2 back to the
+    two-dispatch v1 fused path (``fused_v2 -> fused_pallas``)."""
+    batch_size = cfg[0]
+    it_x, msf, quant = _bp_loop_params(cfg[3])
+    it_z, _msf_z, _q_z = _bp_loop_params(cfg[4])
+    cnt, mw, aux_x, aux_z = gf2_pallas.fused_decode_stats(
+        state["fspec2"], key, batch_size, eval_type=cfg[2],
+        max_iter_z=it_z, max_iter_x=it_x, ms_scaling_factor=msf,
+        quantize=quant)
+    return (cnt, mw), aux_x, aux_z
+
+
 def _tele_on(cfg) -> bool:
     return len(cfg) > 7 and cfg[7]
 
@@ -170,7 +200,10 @@ def _stats_one_batch(cfg, state, key):
     statistics vector (utils.telemetry) summed through the megabatch carry,
     so BP convergence / iteration / OSD-routing counts reach the host at
     the run's one existing sync instead of adding one."""
-    if len(cfg) > 6 and cfg[6]:
+    if len(cfg) > 6 and cfg[6] == "v2":
+        (cnt, mw), aux_x, aux_z = _stats_fused_v2(cfg, state, key)
+        cx_aux, cz_aux = aux_x, aux_z
+    elif len(cfg) > 6 and cfg[6]:
         (cnt, mw), aux_x, aux_z = _stats_fused(cfg, state, key)
         cx_aux, cz_aux = aux_x, aux_z
     elif cfg[5]:
@@ -509,8 +542,15 @@ class CodeSimulator_DataError:
         self._packed = bool(packed)
         # fused counter-PRNG sampler (ops/gf2_pallas): its own PRNG stream,
         # so WER is NOT seed-for-seed comparable with the default sampler —
-        # strictly opt-in for throughput work (bench.py BENCH_FUSED=1)
-        self._fused_sampler = bool(fused_sampler)
+        # strictly opt-in for throughput work (bench.py BENCH_FUSED=1).
+        # ``"v2"`` selects the whole-pipeline fused program (sample ->
+        # syndrome -> BP -> residual in ONE kernel per megabatch tile);
+        # True selects the two-dispatch v1 fused path.
+        if fused_sampler not in (False, True, "v2"):
+            raise ValueError(
+                f"fused_sampler must be False, True or 'v2', "
+                f"got {fused_sampler!r}")
+        self._fused_sampler = fused_sampler
         if self._fused_sampler and not self._packed:
             raise ValueError(
                 "fused_sampler=True runs on the packed substrate; it cannot "
@@ -550,6 +590,44 @@ class CodeSimulator_DataError:
         if self._fused_sampler:
             self._dev_state["fspec"] = gf2_pallas.build_fused_spec(
                 code.hx, code.hz, code.lx, code.lz, self.channel_probs)
+        if self._fused_sampler == "v2":
+            # the whole-pipeline program runs the decode IN the kernel:
+            # it needs plain min-sum BP decoders whose loop parameters
+            # (max_iter, scale, quantize) it can lift off the statics
+            for dec in (decoder_x, decoder_z):
+                static = dec.device_static
+                if static[0] != "bp" or static[2] != "minimum_sum":
+                    raise ValueError(
+                        "fused_sampler='v2' runs min-sum BP inside the "
+                        f"fused kernel; decoder static {static[:3]} is "
+                        "not a plain min-sum BP program")
+            sx, sz = decoder_x.device_static, decoder_z.device_static
+            if sx[3] != sz[3] or \
+                    (sx[5] == "v2_int8") != (sz[5] == "v2_int8"):
+                raise ValueError(
+                    "fused_sampler='v2' needs both sector decoders to "
+                    "share ms_scaling_factor and quantize mode "
+                    f"(got {sx[3]}/{sx[5]} vs {sz[3]}/{sz[5]})")
+            self._dev_state["fspec2"] = gf2_pallas.build_fused_decode_spec(
+                code.hx, code.hz, code.lx, code.lz, self.channel_probs,
+                decoder_x.llr0, decoder_z.llr0)
+            # on TPU an infeasible whole-pipeline working set falls back
+            # to the two-dispatch v1 fused path HERE (same counter-PRNG
+            # stream), not to a silent whole-pipeline XLA twin that would
+            # masquerade as fused-v2 throughput; the fused_fallback event
+            # names the downgrade
+            try:
+                on_tpu = jax.default_backend() == "tpu"
+            except Exception:
+                on_tpu = False
+            if on_tpu and not gf2_pallas.fused_decode_feasible(
+                    self._dev_state["fspec2"], self.batch_size,
+                    quantize=_bp_loop_params(
+                        decoder_x.device_static)[2]):
+                telemetry.event("fused_fallback",
+                                reason="fused_v2_vmem_infeasible", cells=1)
+                telemetry.count("sim.fused_v2_infeasible")
+                self._fused_sampler = True
         # Optionally fuse the two sector decodes into one kernel call when
         # both are plain BP with identical settings (bit-identical results,
         # one iteration loop / straggler tail instead of two).  Off by
@@ -656,14 +734,19 @@ class CodeSimulator_DataError:
 
     def _degrade_once(self):
         """One rung down the graceful-degradation ladder (utils.resilience):
-        fused-Pallas -> XLA twin -> packed -> dense -> CPU.  Every rung
-        below the opt-in fused sampler is bit-exact with the one above, so
-        a degraded run still reproduces the fault-free result seed-for-seed
-        (the fused sampler's own stream is already non-comparable).  Config
-        flags feed ``_cfg``, so the next attempt memoizes a fresh driver
-        and compiles the degraded program."""
+        fused_v2 -> fused_pallas -> fused_xla -> packed -> dense -> CPU.
+        Every rung below the opt-in fused sampler is bit-exact with the one
+        above, so a degraded run still reproduces the fault-free result
+        seed-for-seed (the fused sampler's own stream is already
+        non-comparable; v2 and v1 fused share that stream but not BP
+        numerics).  Config flags feed ``_cfg``, so the next attempt
+        memoizes a fresh driver and compiles the degraded program."""
         fused_rungs = []
         if self._fused_sampler:
+            if self._fused_sampler == "v2":
+                fused_rungs.append((
+                    "fused_v2->fused_pallas",
+                    lambda: setattr(self, "_fused_sampler", True)))
             if not gf2_pallas.FORCE_XLA_TWIN:
                 fused_rungs.append((
                     "fused_pallas->fused_xla",
@@ -715,9 +798,14 @@ class CodeSimulator_DataError:
 
     def _wer_result(self, failures: int, shots: int):
         """WER + telemetry bookkeeping shared by every WordErrorRate path."""
+        from .common import joint_kernel_variant
+
         wer = wer_single_shot(int(failures), int(shots), self.K)
         record_wer_run("data", failures, shots, wer[0],
-                       dispatches=self.last_dispatches)
+                       dispatches=self.last_dispatches,
+                       kernel_variant=joint_kernel_variant(
+                           self.decoder_x, self.decoder_z,
+                           batch_size=self.batch_size))
         return wer
 
     def _word_error_rate(self, num_run, key, target_failures, progress=None):

@@ -756,8 +756,14 @@ class CodeSimulator_Phenon:
         return count, batcher.total
 
     def _record_run(self, count: int, total: int, wer: float) -> None:
+        from .common import joint_kernel_variant
+
         record_wer_run("phenl", count, total, wer,
-                       dispatches=self.last_dispatches)
+                       dispatches=self.last_dispatches,
+                       kernel_variant=joint_kernel_variant(
+                           self.decoder1_x, self.decoder1_z,
+                           self.decoder2_x, self.decoder2_z,
+                           batch_size=self.batch_size))
 
     def WordErrorRate(self, num_rounds: int, num_samples: int, key=None,
                       progress=None, target_failures=None):

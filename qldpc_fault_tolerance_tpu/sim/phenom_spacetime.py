@@ -285,7 +285,13 @@ class CodeSimulator_Phenon_SpaceTime:
             with telemetry.span("wer.phenl_st"):
                 wer, count, total = self._word_error_rate(
                     num_cycles, num_samples, key)
-            record_wer_run("phenl_st", count, total, wer[0])
+            from .common import joint_kernel_variant
+
+            record_wer_run("phenl_st", count, total, wer[0],
+                           kernel_variant=joint_kernel_variant(
+                               self.decoder1_z, self.decoder1_x,
+                               self.decoder2_z, self.decoder2_x,
+                               batch_size=self.batch_size))
         return wer
 
     def _word_error_rate(self, num_cycles: int, num_samples: int, key=None):

@@ -380,7 +380,11 @@ EVENT_SCHEMAS: dict[str, dict] = {
     "wer_run": {
         "required": {"engine": str, "shots": int, "failures": int,
                      "wer": _NUM},
-        "optional": {"dispatches": int, **_CI_FIELDS},
+        # kernel_variant: which BP kernel served the run (one of
+        # ops.bp_pallas.KERNEL_VARIANTS, or "mixed") — silent routing to
+        # the XLA twin now leaves a named trace (ISSUE 9 satellite)
+        "optional": {"dispatches": int, "kernel_variant": str,
+                     **_CI_FIELDS},
     },
     "heartbeat": {
         "required": {"engine": str, "shots": int},
@@ -459,7 +463,7 @@ EVENT_SCHEMAS: dict[str, dict] = {
     "serve_session": {
         "required": {"session": str, "event": str},
         "optional": {"bucket": int, "compile_s": _NUM,
-                     "syndrome_width": int},
+                     "syndrome_width": int, "kernel_variant": str},
     },
     "serve_request": {
         "required": {"session": str, "tenant": str, "shots": int},

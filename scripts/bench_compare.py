@@ -48,6 +48,17 @@ GATED_FIELDS = (
     # INCREASES (LOWER_IS_BETTER_FIELDS)
     "shots_per_s",
     "p99_ms",
+    # BP kernel v2 (ISSUE 9): the kernel A/B arms and the MEASURED
+    # utilization must not regress once recorded.  The measured keys gate
+    # under their cost_model.* names — the legacy top-level "hbm_util" was
+    # a hand model whose r03->r04 roofline correction (0.257 -> 0.012) is
+    # a semantic change, not a regression, so it stays ungated; r01-r05
+    # lack every key below and the checked-in history gates unchanged.
+    "kernel_ab.v1_shots_per_s",
+    "kernel_ab.v2_shots_per_s",
+    "quant_ab.int8_shots_per_s",
+    "cost_model.mfu",
+    "cost_model.hbm_util",
 )
 
 # gated fields where a RISE is the regression (latencies)

@@ -1,8 +1,9 @@
 """Generate the VMEM calibration table (calibration/vmem_table.json).
 
 For every shipped code shape (codes_lib_tpu/*.npz plus small HGP shapes)
-and every VMEM-gated Pallas kernel — the BP head (ops/bp_pallas) and the
-fused GF(2) sample/residual kernels (ops/gf2_pallas) — the harness:
+and every VMEM-gated Pallas kernel — the v1/v2 BP heads (ops/bp_pallas)
+and the fused GF(2) sample/residual/whole-pipeline kernels
+(ops/gf2_pallas) — the harness:
 
   1. records the ANALYTIC per-shot / per-block VMEM estimate (the numbers
      the gates used through round 5, known to undercount mosaic
@@ -136,6 +137,121 @@ def _bp_head_probe(hx, on_tpu: bool, batch: int):
     return entry
 
 
+def _bp_head_v2_probe(hx, on_tpu: bool, batch: int):
+    """Calibration entries for the v2 sparse-incidence head: the fixed
+    (index + synthesized-one-hot) overhead plus the probed per-shot
+    budget, with an int8-variant lowering check at the best block."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.ops import bp, bp_pallas
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    graph = bp.build_tanner_graph_host(hx)
+    sg = bp_pallas.build_sparse_head(graph)
+    m, n, rw = sg.m, sg.n, sg.rw
+    analytic = sg.analytic_per_shot_bytes
+    budget = 30 * 1024 * 1024 - sg.fixed_overhead_bytes
+    llr0 = bp.llr_from_probs(np.full(n, 0.01))
+    synd = jnp.zeros((batch, m), jnp.uint8)
+
+    def lower(block_b, quantize):
+        return bp_pallas._bp_head_sparse_pallas.lower(
+            sg, synd, llr0, head_iters=3, ms_scaling_factor=0.625,
+            block_b=block_b, interpret=not on_tpu, early_stop=False,
+            quantize=quantize)
+
+    def try_compile(block_b: int) -> bool:
+        if batch % block_b:
+            return False
+        if not on_tpu:
+            lower(block_b, None)
+            return block_b * analytic <= budget
+        lower(block_b, None).compile()
+        return True
+
+    candidates = [bt for bt in (512, 256, 128, 64, 32, 16, 8)
+                  if bt <= batch]
+    best, attempts = profiling.probe_max_block(try_compile, candidates)
+    entry = {
+        "kernel": "bp_head_v2", "rw": rw, "m": m, "n": n,
+        "fixed_overhead_bytes": sg.fixed_overhead_bytes,
+        "analytic_per_shot_bytes": analytic,
+        "probe_batch": batch,
+        "max_block_b": best,
+        "measured": bool(on_tpu),
+        "attempts": [{"block": b, "ok": ok, **({"error": e} if e else {})}
+                     for b, ok, e in attempts],
+    }
+    if best:
+        if on_tpu:
+            entry["per_shot_bytes"] = round(budget / best, 1)
+            entry["ratio_vs_analytic"] = round(budget / best / analytic, 3)
+        else:
+            entry["implied_per_shot_bytes_upper"] = round(budget / best, 1)
+        # the int8 variant shares the estimator; record that it lowers
+        # (and on TPU, compiles) at the probed block
+        try:
+            lowered = lower(best, "int8")
+            if on_tpu:
+                lowered.compile()
+            entry["int8_ok"] = True
+        except Exception as e:
+            entry["int8_ok"] = False
+            entry["int8_error"] = f"{type(e).__name__}: {e}"[:200]
+    return entry
+
+
+def _fused_decode_probe(name, hx, hz, lx, lz, on_tpu: bool, batch: int):
+    """Calibration entry for the whole-pipeline fused v2 program."""
+    import jax
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.ops import bp, gf2_pallas
+    from qldpc_fault_tolerance_tpu.ops.gf2_packed import LANE
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    n = hx.shape[1]
+    llr = bp.llr_from_probs(np.full(n, 0.01))
+    spec2 = gf2_pallas.build_fused_decode_spec(
+        hx, hz, lx, lz, (0.003,) * 3, llr, llr)
+    d = gf2_pallas._decode_statics(spec2)
+    key = jax.random.PRNGKey(0)
+
+    def try_compile(block_w: int) -> bool:
+        if batch % (block_w * LANE):
+            return False
+        lowered = gf2_pallas._fused_decode_pallas.lower(
+            spec2, key, batch, "Total", 3, 3, 0.625, None, block_w,
+            not on_tpu)
+        if on_tpu:
+            lowered.compile()
+            return True
+        return gf2_pallas.estimate_fused_decode_bytes(
+            d["n"], d["mx"], d["mz"], d["rwz"], d["rwx"], block_w
+        ) <= gf2_pallas._KERNEL_VMEM_LIMIT
+
+    candidates = [bw for bw in (8, 4, 2, 1) if bw * LANE <= batch]
+    best, attempts = profiling.probe_max_block(try_compile, candidates)
+    analytic = gf2_pallas.estimate_fused_decode_bytes(
+        d["n"], d["mx"], d["mz"], d["rwz"], d["rwx"], 4) / 2.0
+    entry = {
+        "kernel": "fused_decode", "n": d["n"], "mx": d["mx"], "mz": d["mz"],
+        "analytic_block_bytes": round(analytic, 1),
+        "probe_batch": batch,
+        "max_block_w": best,
+        "measured": bool(on_tpu),
+        "attempts": [{"block": b, "ok": ok, **({"error": e} if e else {})}
+                     for b, ok, e in attempts],
+    }
+    if on_tpu and best:
+        raw = gf2_pallas.estimate_fused_decode_bytes(
+            d["n"], d["mx"], d["mz"], d["rwz"], d["rwx"], best) / 2.0
+        entry["ratio_vs_analytic"] = round(
+            gf2_pallas._KERNEL_VMEM_LIMIT / raw, 3)
+    return entry
+
+
 def _gf2_probe(name, hx, hz, lx, lz, on_tpu: bool, batch: int):
     """Calibration entries for the fused sample/residual kernels."""
     import jax.numpy as jnp
@@ -208,17 +324,18 @@ def build_table(code_names, quick: bool = False) -> dict:
     entries = []
     for name, hx, hz, lx, lz in _code_shapes(code_names):
         print(f"probing {name} (hx {hx.shape})...", file=sys.stderr)
-        e = _bp_head_probe(hx, on_tpu, batch)
-        e["code"] = name
-        entries.append(e)
-        for e in _gf2_probe(name, hx, hz, lx, lz, on_tpu, batch):
+        for e in (_bp_head_probe(hx, on_tpu, batch),
+                  _bp_head_v2_probe(hx, on_tpu, batch),
+                  _fused_decode_probe(name, hx, hz, lx, lz, on_tpu, batch),
+                  *_gf2_probe(name, hx, hz, lx, lz, on_tpu, batch)):
             e["code"] = name
             entries.append(e)
     # kernel-wide measured/analytic ratios: only TPU probes are evidence;
     # the 1.8x bp_head prior comes from the round-4 n1225 measurement
     # (README "Known frontiers") and stands until a TPU run replaces it
     ratios = {}
-    for kernel in ("bp_head", "gf2_sample_synd", "gf2_residual"):
+    for kernel in ("bp_head", "bp_head_v2", "fused_decode",
+                   "gf2_sample_synd", "gf2_residual"):
         rs = [e["ratio_vs_analytic"] for e in entries
               if e["kernel"] == kernel and e.get("measured")
               and e.get("ratio_vs_analytic")]
@@ -228,6 +345,18 @@ def build_table(code_names, quick: bool = False) -> dict:
         ratios["bp_head_prior"] = 1.8
     import jax
 
+    from qldpc_fault_tolerance_tpu.ops import bp_pallas
+
+    # explicit gate values: the CONSUMED keys always exist in a generated
+    # table so consumers (and the tier-1 consistency test) never depend on
+    # fallback constants silently; a CPU run records the conservative
+    # defaults (gates_measured=false), a TPU run may raise them with
+    # try-compile evidence
+    gates = {
+        "bp_head_scat_limit_bytes": 8 * 1024 * 1024,
+        "bp_head_v2_fixed_limit_bytes": bp_pallas._V2_FIXED_LIMIT,
+    }
+
     return {
         "schema": TABLE_SCHEMA,
         "generated_by": "scripts/vmem_calibrate.py",
@@ -236,7 +365,8 @@ def build_table(code_names, quick: bool = False) -> dict:
         "measured": on_tpu,
         "probe_batch": batch,
         "ratios": ratios,
-        "gates": {},  # bp_head_scat_limit_bytes lands here from a TPU run
+        "gates": gates,
+        "gates_measured": on_tpu,
         "entries": entries,
     }
 

@@ -1,0 +1,478 @@
+"""BP kernel v2 (ISSUE 9): sparse index-gather incidence, int8 min-sum,
+whole-pipeline fusion, kernel-variant telemetry, VMEM gate consistency.
+
+Kernels run in interpret mode (CPU); the real mosaic path is gated by the
+calibrated VMEM table and exercised by bench.py / the driver on TPU.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.noise import depolarizing_xz
+from qldpc_fault_tolerance_tpu.ops import bp, bp_pallas, gf2_pallas
+from qldpc_fault_tolerance_tpu.ops.linalg import ParityOp
+from qldpc_fault_tolerance_tpu.utils import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _irregular_h(seed=0, m=24, n=48):
+    """A parity-check matrix with IRREGULAR row weights (2..6) so the
+    slot-major layout has genuinely padded slots on most rows."""
+    rng = np.random.default_rng(seed)
+    h = np.zeros((m, n), np.uint8)
+    for i in range(m):
+        w = int(rng.integers(2, 7))
+        h[i, rng.choice(n, size=w, replace=False)] = 1
+    # every column needs at least one check (keeps the graph connected
+    # enough for BP to make progress)
+    for j in np.nonzero(h.sum(0) == 0)[0]:
+        h[rng.integers(0, m), j] = 1
+    return h
+
+
+def _synd_batch(h, b=128, p=0.05, seed=3):
+    key = jax.random.PRNGKey(seed)
+    _, ez = depolarizing_xz(key, (b, h.shape[1]), (p / 3, p / 3, p / 3))
+    return ParityOp(h)(ez)
+
+
+def _results_equal(a, b):
+    for fa, fb in zip(a, b):
+        np.testing.assert_array_equal(np.asarray(fa), np.asarray(fb))
+
+
+# ---------------------------------------------------------------------------
+# bit-exactness: sparse kernel vs dense v1 kernel vs XLA twin
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("hseed", [0, 1])
+def test_sparse_bitexact_vs_dense_and_twin_irregular(hseed):
+    """The v2 kernel synthesizes the SAME one-hot operands v1 loads and
+    shares its loop body, so across irregular row weights (padded slots)
+    every output plane is bit-exact: v1 kernel == v2 kernel == v2 twin."""
+    h = _irregular_h(seed=hseed)
+    graph = bp.build_tanner_graph_host(h)
+    pg = bp_pallas.build_pallas_head(graph)
+    sg = bp_pallas.build_sparse_head(graph)
+    assert sg.rw == pg.rw and sg.m == pg.m and sg.n == pg.n
+    # the v2 incidence is orders of magnitude smaller than the v1 stack
+    assert sg.idx_bytes < pg.scat_bytes
+    llr0 = bp.llr_from_probs(np.full(h.shape[1], 0.05))
+    synd = _synd_batch(h)
+
+    v1 = bp_pallas.bp_head_pallas(pg, synd, llr0, head_iters=6,
+                                  block_b=64, interpret=True)
+    v2k = bp_pallas.bp_head_sparse(sg, synd, llr0, head_iters=6,
+                                   block_b=64, interpret=True)
+    v2t = bp_pallas.bp_head_sparse(sg, synd, llr0, head_iters=6,
+                                   block_b=64, backend="xla")
+    _results_equal(v1, v2k)
+    _results_equal(v2k, v2t)
+
+
+def test_sparse_early_stop_freeze_semantics():
+    h = _irregular_h(seed=2)
+    sg = bp_pallas.build_sparse_head(bp.build_tanner_graph_host(h))
+    llr0 = bp.llr_from_probs(np.full(h.shape[1], 0.05))
+    synd = _synd_batch(h)
+    fixed = bp_pallas.bp_head_sparse(sg, synd, llr0, head_iters=12,
+                                     block_b=64, backend="xla")
+    early = bp_pallas.bp_head_sparse(sg, synd, llr0, head_iters=12,
+                                     block_b=64, backend="xla",
+                                     early_stop=True)
+    np.testing.assert_array_equal(np.asarray(fixed.converged),
+                                  np.asarray(early.converged))
+    conv = np.asarray(fixed.converged)
+    np.testing.assert_array_equal(np.asarray(fixed.error)[conv],
+                                  np.asarray(early.error)[conv])
+
+
+def test_int8_kernel_vs_twin_bitexact_and_valid():
+    """int8 kernel (MXU int8 product) and twin (index scatter-add) share
+    exact integer accumulation, so they are bit-exact; converged int8
+    shots must still satisfy their syndrome exactly (the parity check is
+    computed on the dequantized totals, exact GF(2))."""
+    h = _irregular_h(seed=4)
+    sg = bp_pallas.build_sparse_head(bp.build_tanner_graph_host(h))
+    llr0 = bp.llr_from_probs(np.full(h.shape[1], 0.05))
+    synd = _synd_batch(h)
+    k = bp_pallas.bp_head_sparse(sg, synd, llr0, head_iters=16,
+                                 block_b=64, interpret=True,
+                                 quantize="int8", early_stop=True)
+    t = bp_pallas.bp_head_sparse(sg, synd, llr0, head_iters=16,
+                                 block_b=64, backend="xla",
+                                 quantize="int8", early_stop=True)
+    _results_equal(k, t)
+    conv = np.asarray(k.converged)
+    assert conv.mean() > 0.5  # int8 still decodes this easy cell
+    par = np.asarray(k.error) @ h.T % 2
+    np.testing.assert_array_equal(par[conv], np.asarray(synd)[conv])
+
+
+# ---------------------------------------------------------------------------
+# int8 WER parity on the hgp_rep3 / hgp_rep4 parity cells
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("d", [3, 4])
+def test_int8_wer_parity_contract(d):
+    """A quantize='int8' BPDecoder's WER matches the f32 decoder's within
+    the documented contract (ops/bp_pallas.int8_parity_tolerance) on the
+    hgp_rep parity cells — the tier-1 half of the quantization contract
+    (bench.py BENCH_QUANT=1 is the perf half, same tolerance helper)."""
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    code = hgp(rep_code(d), rep_code(d))
+    p = 0.06
+    shots = 4096
+
+    def run(quantize):
+        dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=20,
+                          quantize=quantize)
+        dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=20,
+                          quantize=quantize)
+        sim = CodeSimulator_DataError(
+            code=code, decoder_x=dec_x, decoder_z=dec_z,
+            pauli_error_probs=[p / 3] * 3, batch_size=512, seed=11,
+            scan_chunk=4)
+        return sim.WordErrorRate(shots)[0]
+
+    wer_f32 = run(None)
+    wer_int8 = run("int8")
+    tol = bp_pallas.int8_parity_tolerance(wer_f32, shots)
+    assert abs(wer_int8 - wer_f32) <= tol, (
+        f"int8 WER {wer_int8} vs f32 {wer_f32}: delta "
+        f"{abs(wer_int8 - wer_f32)} exceeds the contract tolerance {tol}")
+
+
+# ---------------------------------------------------------------------------
+# whole-pipeline fused v2
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def small_code():
+    return hgp(rep_code(3), rep_code(3))
+
+
+def _fspec2(code, p):
+    llr = bp.llr_from_probs(np.full(code.N, p))
+    return gf2_pallas.build_fused_decode_spec(
+        code.hx, code.hz, code.lx, code.lz, (p / 3,) * 3, llr, llr)
+
+
+@pytest.mark.parametrize("quantize", [None, "int8"])
+def test_fused_v2_kernel_vs_twin_bitexact(small_code, quantize):
+    spec2 = _fspec2(small_code, 0.05)
+    key = jax.random.PRNGKey(9)
+    kw = dict(eval_type="Total", max_iter_z=20, max_iter_x=20,
+              quantize=quantize)
+    cnt_t, mw_t, ax_t, az_t = gf2_pallas.fused_decode_stats(
+        spec2, key, 256, backend="xla", **kw)
+    cnt_k, mw_k, ax_k, az_k = gf2_pallas.fused_decode_stats(
+        spec2, key, 256, interpret=True, **kw)
+    assert int(cnt_t) == int(cnt_k)
+    assert int(mw_t) == int(mw_k)
+    for a, b in ((ax_t, ax_k), (az_t, az_k)):
+        np.testing.assert_array_equal(np.asarray(a["converged"]),
+                                      np.asarray(b["converged"]))
+        np.testing.assert_array_equal(np.asarray(a["iterations"]),
+                                      np.asarray(b["iterations"]))
+
+
+def test_fused_v2_zero_noise_zero_failures(small_code):
+    spec2 = _fspec2(small_code, 1e-9)
+    cnt, mw, ax, az = gf2_pallas.fused_decode_stats(
+        spec2, jax.random.PRNGKey(1), 256, eval_type="Total",
+        max_iter_z=20, max_iter_x=20, backend="xla")
+    assert int(cnt) == 0
+    assert np.asarray(ax["converged"]).all()
+
+
+def test_fused_v2_engine_matches_direct_call(small_code):
+    """The engine's fused_sampler="v2" unit returns exactly what the
+    dispatcher returns for the same key (the megabatch carry folds these
+    device scalars)."""
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim import data_error as de
+
+    code = small_code
+    p = 0.05
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=20)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=20)
+    sim = de.CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=256, seed=0,
+        fused_sampler="v2")
+    key = jax.random.PRNGKey(5)
+    cfg = sim._cfg(256)
+    cnt, mw = de._stats_one_batch(cfg, sim._dev_state, key)
+    cnt_d, mw_d, _ax, _az = gf2_pallas.fused_decode_stats(
+        sim._dev_state["fspec2"], key, 256, eval_type="Total",
+        max_iter_z=20, max_iter_x=20, backend="xla")
+    assert int(cnt) == int(cnt_d)
+    assert int(mw) == int(mw_d)
+
+
+def test_fused_v2_ladder_rungs(small_code):
+    """fused_v2 -> fused_pallas -> fused_xla -> packed are the first
+    rungs of the v2 engine's degradation ladder, in order."""
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+
+    code = small_code
+    p = 0.05
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=20)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=20)
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=256, seed=0,
+        fused_sampler="v2")
+    try:
+        assert sim._degrade_once() == "fused_v2->fused_pallas"
+        assert sim._fused_sampler is True
+        assert sim._degrade_once() == "fused_pallas->fused_xla"
+        assert gf2_pallas.FORCE_XLA_TWIN
+        assert sim._degrade_once() == "fused->packed"
+        assert sim._fused_sampler is False
+    finally:
+        gf2_pallas.FORCE_XLA_TWIN = False
+
+
+def test_fused_v2_warm_p_sweep_adds_zero_retraces(small_code):
+    """Retrace-budget guard (PR-2 tracker): a warm fused-v2 run at NEW
+    p-values must add zero retraces — every p-dependent array (cuts, LLR
+    priors) rides the traced FusedDecodeSpec, so baking a p into the
+    program would recompile per point."""
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    code = small_code
+
+    def run(p):
+        dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=20)
+        dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=20)
+        sim = CodeSimulator_DataError(
+            code=code, decoder_x=dec_x, decoder_z=dec_z,
+            pauli_error_probs=[p / 3] * 3, batch_size=256, seed=0,
+            scan_chunk=2, fused_sampler="v2")
+        sim.WordErrorRate(512)
+
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for p in (0.03, 0.05):
+            run(p)
+        before = telemetry.compile_stats().get("jax.retraces", 0)
+        for p in (0.04, 0.06):
+            run(p)
+        after = telemetry.compile_stats().get("jax.retraces", 0)
+    finally:
+        telemetry.disable()
+    assert after - before == 0, (
+        f"{after - before} retraces on a warm fused-v2 p-sweep")
+
+
+# ---------------------------------------------------------------------------
+# VMEM calibration: v2 gate keys + estimator-vs-probe consistency
+# ---------------------------------------------------------------------------
+def _table():
+    with open(os.path.join(REPO, "calibration", "vmem_table.json")) as fh:
+        return json.load(fh)
+
+
+def test_v2_gate_keys_exist_in_checked_in_table():
+    table = _table()
+    gates = table.get("gates", {})
+    for key in ("bp_head_scat_limit_bytes", "bp_head_v2_fixed_limit_bytes"):
+        assert isinstance(gates.get(key), (int, float)) and gates[key] > 0, (
+            f"gates.{key} missing from the checked-in calibration table")
+    kernels = {e["kernel"] for e in table["entries"]}
+    assert {"bp_head_v2", "fused_decode"} <= kernels
+    # every shipped shape (incl. the n1225/n1600 unlock targets) is probed
+    v2_n = {e["n"] for e in table["entries"] if e["kernel"] == "bp_head_v2"}
+    assert {1225, 1600} <= v2_n
+
+
+def test_v2_estimator_never_exceeds_probed_failure_point():
+    """For every bp_head_v2 entry: the estimator must not claim a block
+    the probe recorded as FAILING, and must admit the probed max block
+    (the table and the runtime gate agree about the feasible frontier)."""
+    table = _table()
+    for e in table["entries"]:
+        if e["kernel"] != "bp_head_v2":
+            continue
+        per_shot = e["analytic_per_shot_bytes"]
+        budget = 30 * 1024 * 1024 - e["fixed_overhead_bytes"]
+        assert budget > 0, f"{e['code']}: fixed overhead busts the budget"
+        if e["max_block_b"]:
+            assert e["max_block_b"] * per_shot <= budget, (
+                f"{e['code']}: probed block {e['max_block_b']} exceeds "
+                "the estimator budget — estimator and probe disagree")
+        for att in e["attempts"]:
+            if not att["ok"] and att["block"] * per_shot <= budget \
+                    and e["probe_batch"] % att["block"] == 0:
+                raise AssertionError(
+                    f"{e['code']}: estimator admits block {att['block']} "
+                    "that the probe recorded as failing")
+
+
+def test_n1225_n1600_route_onto_v2_vmem_path():
+    """The tentpole unlock: shapes the v1 scat gate rejects (>8 MB
+    resident stack) fit the v2 gate and get a feasible batch tile."""
+    from qldpc_fault_tolerance_tpu.codes import load_code
+
+    for name in ("hgp_34_n1225", "hgp_34_n1600"):
+        path = os.path.join(REPO, "codes_lib_tpu", f"{name}.npz")
+        if not os.path.exists(path):
+            pytest.skip(f"{name} not shipped")
+        c = load_code(path)
+        g = bp.build_tanner_graph_host(c.hx)
+        v1 = bp_pallas.build_pallas_head(g)
+        sg = bp_pallas.build_sparse_head(g)
+        assert not v1.fits_vmem(), f"{name}: v1 gate unexpectedly admits"
+        assert sg.fits_vmem(), f"{name}: v2 gate rejects"
+        assert sg.max_block_b(16384) > 0, f"{name}: no feasible v2 tile"
+
+
+# ---------------------------------------------------------------------------
+# kernel-variant telemetry
+# ---------------------------------------------------------------------------
+def test_kernel_variant_resolution(small_code):
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.decoders.bp_decoders import (
+        kernel_variant,
+    )
+
+    code = small_code
+    dec = BPDecoder(code.hx, np.full(code.N, 0.05), max_iter=20)
+    # CPU: no head -> xla_twin; the static still names its routing tag
+    assert dec.kernel_variant == "xla_twin"
+    assert dec.device_static[5] == "none"
+    dec8 = BPDecoder(code.hx, np.full(code.N, 0.05), max_iter=20,
+                     quantize="int8")
+    assert dec8.device_static[5] == "v2_int8"
+    # off-TPU the int8 head serves through the twin -> xla_twin variant
+    assert dec8.kernel_variant == "xla_twin"
+    # synthetic statics: TPU routing names the kernels
+    import qldpc_fault_tolerance_tpu.ops.bp_pallas as bpp
+
+    orig = bpp.sparse_serves_pallas
+    bpp.sparse_serves_pallas = lambda: True
+    try:
+        st = ("bp", 20, "minimum_sum", 0.625, True, "v2")
+        assert kernel_variant(st, {}) == "sparse_gather"
+        st8 = ("bp", 20, "minimum_sum", 0.625, True, "v2_int8")
+        assert kernel_variant(st8, {}) == "sparse_int8"
+        # per-batch engage gates: decodes the head disengages from report
+        # the exact-f32 path they really run, not the head's tag —
+        # sub-TWO_PHASE_MIN_BATCH request, non-dividing bucket, vs an
+        # engaged full batch
+        state8 = dec8.device_state
+        st8_real = dec8.device_static
+        assert kernel_variant(st8_real, state8, 8) == "xla_twin"
+        assert kernel_variant(st8_real, state8, 96) == "xla_twin"
+        assert kernel_variant(st8_real, state8, 512) == "sparse_int8"
+    finally:
+        bpp.sparse_serves_pallas = orig
+    assert kernel_variant(("bp", 20, "minimum_sum", 0.625, True, "v1"),
+                          {}) == "dense_onehot"
+    # bposd/space-time wrappers resolve through to the inner BP static
+    inner = ("bp", 20, "minimum_sum", 0.625, True, "none")
+    assert kernel_variant(("bposd_dev", inner, 13, 6, 10, "pallas"),
+                          {}) == "xla_twin"
+    assert kernel_variant(("st_syndrome", 2, 6, 13, inner), {}) == "xla_twin"
+
+
+def test_wer_run_event_carries_kernel_variant(small_code):
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    code = small_code
+    p = 0.05
+    dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=20)
+    dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=20)
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[p / 3] * 3, batch_size=256, seed=0)
+    telemetry.reset()
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        sim.WordErrorRate(512)
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+    evs = [e for e in sink.records if e.get("kind") == "wer_run"]
+    assert evs and evs[-1]["kernel_variant"] == "xla_twin"
+    assert not telemetry.validate_event(evs[-1])
+    snap = telemetry.snapshot()
+    assert snap["bp.kernel_variant"]["value"] == \
+        bp_pallas.KERNEL_VARIANTS.index("xla_twin")
+    assert snap["bp.kernel_variant.xla_twin"]["value"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# serve integration: sessions record (and match) the offline variant
+# ---------------------------------------------------------------------------
+def test_serve_session_variant_matches_offline(small_code):
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.serve.session import DecodeSession
+
+    code = small_code
+    dec = BPDecoder(code.hx, np.full(code.N, 0.05), max_iter=20)
+    sess = DecodeSession("s-v2", decoder=dec)
+    # the AOT programs compile from the SAME (static, state) pair, so the
+    # warm serving path's kernel routing equals the offline decode's
+    assert sess.kernel_variant == dec.kernel_variant
+    telemetry.reset()
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        synd = np.asarray(_synd_batch(np.asarray(code.hx), b=8))
+        out = sess.decode(synd)
+        np.testing.assert_array_equal(out.corrections,
+                                      dec.decode_batch(synd))
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+    compiles = [e for e in sink.records
+                if e.get("kind") == "serve_session"
+                and e.get("event") == "compile"]
+    assert compiles
+    assert compiles[-1]["kernel_variant"] == dec.kernel_variant
+    assert not telemetry.validate_event(compiles[-1])
+
+
+def test_factory_state_matches_built_decoder_with_quantize(small_code):
+    """GetDecoderState fast path stays pinned to the full build under the
+    new static layout (head tag + quantize)."""
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+
+    code = small_code
+    params = {"h": np.asarray(code.hx), "p_data": 0.05}
+    for quant in (None, "int8"):
+        cls = BP_Decoder_Class(max_iter_ratio=10, bp_method="minimum_sum",
+                               ms_scaling_factor=0.625, quantize=quant)
+        static, state = cls.GetDecoderState(dict(params))
+        dec = cls.GetDecoder(dict(params))
+        assert static == dec.device_static
+        np.testing.assert_allclose(np.asarray(state["llr0"]),
+                                   np.asarray(dec.device_state["llr0"]))
+        if quant:
+            assert static[5] == "v2_int8"
+            assert state["pallas"] is not None

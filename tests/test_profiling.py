@@ -453,7 +453,8 @@ def test_checked_in_calibration_table_is_consistent():
     assert table["generated_by"] == "scripts/vmem_calibrate.py"
     assert table["entries"], "table has no entries"
     for e in table["entries"]:
-        assert e["kernel"] in ("bp_head", "gf2_sample_synd", "gf2_residual")
+        assert e["kernel"] in ("bp_head", "bp_head_v2", "fused_decode",
+                               "gf2_sample_synd", "gf2_residual")
         assert "measured" in e and "attempts" in e
         if not e["measured"]:
             assert "per_shot_bytes" not in e
