@@ -1364,6 +1364,164 @@ def mode_serve():
     }
 
 
+def mode_rare():
+    """Rare-event estimation (ISSUE 10): variance-reduction factor of the
+    importance-sampled (tilted) WER estimator vs direct Monte-Carlo on a
+    DEEP sub-threshold cell — the regime where the effective-distance fit
+    needs points direct MC cannot produce (a 1e-10 WER needs ~1e12 direct
+    shots).
+
+    Cell: hgp_rep3 data noise at p = BENCH_RARE_P (default 0.005 —
+    well under p_c/3 for this family's ~0.06 nominal threshold), pure-device
+    min-sum BP, tilt from ``rare.auto_tilt`` (proposal mean error weight
+    aimed at d_eff/2 flips).  Both arms run the SAME shot budget through
+    the same sample->syndrome->decode->check pipeline (the weighted arm
+    additionally carries the per-shot log-weight plane and weight-moment
+    folds), order-alternating min-of-N wall clock per BASELINE.md.
+
+    Headline: variance-reduction factor at FIXED WALL CLOCK — the
+    equal-shot-budget factor ``(r(1-r)/n) / Var[weighted]`` scaled by the
+    measured throughput ratio (estimator variance is ∝ 1/t for both arms).
+    Gates: vrf_equal_shots >= 10 (the acceptance floor), weighted-vs-direct
+    WER consistency within 3 combined sigma on the same cell, and zero-tilt
+    bit-exactness seed-for-seed against BOTH the data and phenom direct
+    engines.  Env knobs: BENCH_RARE_SAMPLES / BENCH_RARE_BATCH /
+    BENCH_RARE_P / BENCH_RARE_REPS.
+    """
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+    from qldpc_fault_tolerance_tpu.rare import (
+        auto_tilt,
+        tilt_channel,
+        variance_reduction,
+    )
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+    from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+
+    samples = int(os.environ.get("BENCH_RARE_SAMPLES", "32768"))
+    batch = int(os.environ.get("BENCH_RARE_BATCH", "4096"))
+    p = float(os.environ.get("BENCH_RARE_P", "0.005"))
+    reps = int(os.environ.get("BENCH_RARE_REPS", "5"))
+    p_c_nominal = 0.06  # this family's data-noise threshold scale
+    code = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+
+    def mk(seed=5):
+        dec_x = BPDecoder(code.hz, np.full(code.N, p), max_iter=12)
+        dec_z = BPDecoder(code.hx, np.full(code.N, p), max_iter=12)
+        return CodeSimulator_DataError(
+            code=code, decoder_x=dec_x, decoder_z=dec_z,
+            pauli_error_probs=[p / 3] * 3, batch_size=batch, seed=seed)
+
+    q_total = auto_tilt(p, n=code.N, d_eff=3.0)
+    tilt = tilt_channel([p / 3] * 3, q_total)
+
+    # warmup/compile both arms
+    mk().WordErrorRate(batch)
+    mk().WeightedWordErrorRate(batch, tilt_probs=tilt)
+
+    # order-alternating min-of-N (BASELINE.md): same shot budget both arms
+    t_direct, t_weighted = [], []
+    direct_wer = weighted_stats = None
+    for rep in range(reps):
+        arms = [("d", t_direct), ("w", t_weighted)]
+        if rep % 2:
+            arms = arms[::-1]
+        for which, sink in arms:
+            sim = mk()
+            t0 = time.perf_counter()
+            if which == "d":
+                direct_wer = sim.WordErrorRate(samples)
+                direct_sim = sim
+            else:
+                sim.WeightedWordErrorRate(samples, tilt_probs=tilt)
+                weighted_stats = sim.last_weighted
+            sink.append(time.perf_counter() - t0)
+    td, tw = min(t_direct), min(t_weighted)
+
+    ws = weighted_stats
+    vrf = variance_reduction(ws)
+    # fixed-wall-clock factor: variance ∝ 1/t for both estimators, so the
+    # equal-shot factor scales by the throughput ratio
+    vrf_wall = vrf * (td / tw) if vrf is not None else None
+
+    # WER consistency on the SAME cell: weighted rate vs direct binomial
+    # rate within 3 combined sigma (both estimate the same physical rate;
+    # the direct failure rate comes back through the exact inverse of the
+    # wer_single_shot transform)
+    rate_d = 1.0 - (1.0 - direct_wer[0]) ** direct_sim.K
+    var_d = rate_d * (1.0 - rate_d) / samples
+    sigma = (ws.variance + var_d) ** 0.5
+    consistent = (abs(ws.rate - rate_d) <= 3.0 * sigma) if sigma > 0 \
+        else ws.rate == rate_d
+
+    # zero-tilt bit-exactness, seed-for-seed, both engines
+    za, zb = mk(seed=9), mk(seed=9)
+    wd = za.WordErrorRate(4 * batch)
+    wz = zb.WeightedWordErrorRate(4 * batch)
+    zt_data = (wd[0] == wz[0]
+               and zb.last_weighted.s1 == zb.last_weighted.failures
+               and zb.last_weighted.w1 == zb.last_weighted.shots)
+
+    pp, qq = 0.02, 0.02
+    hx_ext = np.hstack([code.hx, np.eye(code.hx.shape[0], dtype=np.uint8)])
+    hz_ext = np.hstack([code.hz, np.eye(code.hz.shape[0], dtype=np.uint8)])
+
+    def mk_ph(seed=9):
+        pz = np.concatenate([np.full(code.N, pp),
+                             np.full(code.hx.shape[0], qq)])
+        px = np.concatenate([np.full(code.N, pp),
+                             np.full(code.hz.shape[0], qq)])
+        return CodeSimulator_Phenon(
+            code=code,
+            decoder1_x=BPDecoder(hz_ext, px, max_iter=10),
+            decoder1_z=BPDecoder(hx_ext, pz, max_iter=10),
+            decoder2_x=BPDecoder(code.hz, np.full(code.N, pp), max_iter=10),
+            decoder2_z=BPDecoder(code.hx, np.full(code.N, pp), max_iter=10),
+            pauli_error_probs=[pp / 3] * 3, q=qq, batch_size=batch,
+            seed=seed)
+
+    pd = mk_ph().WordErrorRate(num_rounds=3, num_samples=batch)
+    pw = mk_ph().WeightedWordErrorRate(num_rounds=3, num_samples=batch)
+    zt_phenl = pd[0] == pw[0]
+
+    return {
+        "metric": "rare-event variance-reduction factor, tilted IS vs "
+                  f"direct MC (hgp_rep3 data p={p:g}, equal wall clock)",
+        "value": round(vrf_wall, 1) if vrf_wall is not None else None,
+        "unit": "x",
+        # direct MC at equal budget IS the baseline (factor 1)
+        "vs_baseline": round(vrf_wall, 1) if vrf_wall is not None else None,
+        "cell": {"code": "hgp_rep3", "p": p, "tilt": round(q_total, 6),
+                 "p_c_nominal": p_c_nominal,
+                 "sub_threshold_ratio": round(p / p_c_nominal, 4),
+                 "samples": samples, "batch": batch},
+        "vrf_equal_shots": round(vrf, 1) if vrf is not None else None,
+        "vrf_fixed_wallclock": (round(vrf_wall, 1)
+                                if vrf_wall is not None else None),
+        "direct_s": round(td, 3),
+        "weighted_s": round(tw, 3),
+        "weighted_shots_per_s": round(samples / tw, 1),
+        "weighted": {
+            "rate": ws.rate, "failures": ws.failures, "shots": ws.shots,
+            "ess": round(ws.ess, 1), "rse": (round(ws.rse, 4)
+                                             if ws.rse is not None else None),
+        },
+        "direct": {"rate": rate_d,
+                   "failures": int(round(rate_d * samples)),
+                   "shots": samples, "wer": direct_wer[0]},
+        "gates": {
+            "vrf_ge_10": bool(vrf is not None and vrf >= 10.0),
+            "wer_consistent_3sigma": bool(consistent),
+            "zero_tilt_bitexact_data": bool(zt_data),
+            "zero_tilt_bitexact_phenl": bool(zt_phenl),
+        },
+    }
+
+
 MODES = {
     "bp": mode_bp,
     "bposd": mode_bposd,
@@ -1372,6 +1530,7 @@ MODES = {
     "circuit_cell": mode_circuit_cell,
     "sweep": mode_sweep,
     "serve": mode_serve,
+    "rare": mode_rare,
 }
 
 
@@ -1383,7 +1542,7 @@ def main():
         # TPU chip, so they must run before this process's own JAX
         # initialization claims it for the other modes
         for name in ("phenl_cell", "circuit_cell", "bp", "bposd",
-                     "st_circuit", "sweep", "serve"):
+                     "st_circuit", "sweep", "serve", "rare"):
             results[name] = MODES[name]()
             print(json.dumps(results[name]))
         here = os.path.dirname(os.path.abspath(__file__))

@@ -17,6 +17,8 @@ Layers (bottom to top):
   sim/       Monte-Carlo engines (data / phenom / phenom-ST / circuit / circuit-ST)
   parallel/  device-mesh sharding of the shot/grid axes
   sweep/     code-family orchestration, threshold & distance fits
+  rare/      rare-event estimation: importance-sampled (tilted / stratified)
+             WER for deep sub-threshold cells, weighted fused sweeps
   serve/     decode-as-a-service: persistent AOT sessions, continuous
              batching, asyncio front-end
   compat/    drop-in shims for the reference module/API names

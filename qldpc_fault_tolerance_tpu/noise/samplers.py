@@ -9,6 +9,19 @@ stream from a fold-in of the shot index.
 Convention: ``pauli_error_probs = [px, py, pz]`` with the reference's binning
 order — u < pz -> Z; pz <= u < pz+px -> X; pz+px <= u < pz+px+py -> Y
 (src/Simulators.py:102-113).
+
+Weighted (importance-sampled) samplers for the rare-event subsystem
+(``qldpc_fault_tolerance_tpu.rare``): the ``*_tilted`` variants draw from a
+TILTED channel (tilt probabilities ``q`` larger than the physical ``p``) and
+return a per-shot log importance weight ``log dP_p/dP_q`` alongside the error
+planes.  They consume the SAME uniform draws as the direct samplers with the
+tilt probabilities in the thresholds, so the zero-tilt configuration
+(``tilt == p``) reproduces the direct samplers' error planes bit for bit with
+an exactly-zero log weight — the contract the engines' zero-tilt bit-exactness
+tests pin.  The ``*_stratum`` samplers draw fixed-Hamming-weight error
+patterns uniformly within a stratum (the subset-splitting substrate); their
+importance weight is CONSTANT per stratum and returned as the per-shot
+log-weight plane for uniformity.
 """
 from __future__ import annotations
 
@@ -16,7 +29,11 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["depolarizing_xz", "bit_flips",
-           "depolarizing_xz_packed", "bit_flips_packed"]
+           "depolarizing_xz_packed", "bit_flips_packed",
+           "depolarizing_xz_tilted", "bit_flips_tilted",
+           "depolarizing_xz_tilted_packed", "bit_flips_tilted_packed",
+           "fixed_weight_flips", "depolarizing_xz_stratum",
+           "stratum_log_weight"]
 
 
 def depolarizing_xz(key, shape, pauli_error_probs):
@@ -57,3 +74,140 @@ def bit_flips_packed(key, shape, p):
     from ..ops.gf2_packed import pack_shots
 
     return pack_shots(bit_flips(key, shape, p))
+
+
+# ---------------------------------------------------------------------------
+# Importance-sampled (tilted) channels
+# ---------------------------------------------------------------------------
+def _shot_sum(per_site):
+    """Per-shot reduction of a (batch, ...) per-site plane -> (batch,)."""
+    return per_site.reshape(per_site.shape[0], -1).sum(axis=-1)
+
+
+def depolarizing_xz_tilted(key, shape, pauli_error_probs, tilt_probs):
+    """Depolarizing sample from the TILTED channel ``tilt_probs`` with the
+    per-shot log importance weight toward the target ``pauli_error_probs``.
+
+    Returns ``(error_x, error_z, log_weight)`` with ``log_weight`` float32
+    ``(batch,)``: sum over sites of ``log P_p(outcome) - log P_q(outcome)``.
+    The uniform draw, binning order and dtype discipline match
+    ``depolarizing_xz`` exactly, so ``tilt_probs == pauli_error_probs``
+    yields bit-identical error planes and an exactly-zero log weight (every
+    per-outcome term is ``log(p) - log(q)`` with ``p == q``).  A target
+    component that is zero while its tilt is positive weights those shots
+    to exactly zero via ``-inf`` log terms — the mathematically correct
+    limit for an outcome the physical channel cannot produce.
+    """
+    px, py, pz = (jnp.asarray(p, jnp.float32) for p in pauli_error_probs)
+    qx, qy, qz = (jnp.asarray(q, jnp.float32) for q in tilt_probs)
+    u = jax.random.uniform(key, shape, dtype=jnp.float32)
+    is_z = u < qz
+    is_x = (u >= qz) & (u < qz + qx)
+    is_y = (u >= qz + qx) & (u < qz + qx + qy)
+    error_x = (is_x | is_y).astype(jnp.uint8)
+    error_z = (is_z | is_y).astype(jnp.uint8)
+    # per-site log ratio selected by outcome (where-select, not multiply:
+    # an impossible branch's NaN/-inf must not leak into taken branches)
+    lr_i = jnp.log1p(-(px + py + pz)) - jnp.log1p(-(qx + qy + qz))
+    lw = jnp.where(
+        is_z, jnp.log(pz) - jnp.log(qz),
+        jnp.where(is_x, jnp.log(px) - jnp.log(qx),
+                  jnp.where(is_y, jnp.log(py) - jnp.log(qy), lr_i)))
+    return error_x, error_z, _shot_sum(lw)
+
+
+def bit_flips_tilted(key, shape, p, q):
+    """Bernoulli flips drawn at the TILTED rate ``q`` with the per-shot log
+    importance weight toward the target rate ``p``.
+
+    Returns ``(flips, log_weight)``; same uniform draw as ``bit_flips``, so
+    ``q == p`` is bit-identical with exactly-zero log weight."""
+    p = jnp.asarray(p, jnp.float32)
+    q = jnp.asarray(q, jnp.float32)
+    u = jax.random.uniform(key, shape, dtype=jnp.float32)
+    flipped = u < q
+    lw = jnp.where(flipped, jnp.log(p) - jnp.log(q),
+                   jnp.log1p(-p) - jnp.log1p(-q))
+    return flipped.astype(jnp.uint8), _shot_sum(lw)
+
+
+def depolarizing_xz_tilted_packed(key, shape, pauli_error_probs, tilt_probs):
+    """Bit-packed ``depolarizing_xz_tilted``: identical draws and log
+    weights, error planes packed 32 shots per uint32 lane word.  Returns
+    ``(error_x_packed, error_z_packed, log_weight)`` with the log-weight
+    plane staying per-shot ``(batch,)`` float32 (weights don't pack)."""
+    from ..ops.gf2_packed import pack_shots
+
+    error_x, error_z, logw = depolarizing_xz_tilted(
+        key, shape, pauli_error_probs, tilt_probs)
+    return pack_shots(error_x), pack_shots(error_z), logw
+
+
+def bit_flips_tilted_packed(key, shape, p, q):
+    """Bit-packed ``bit_flips_tilted`` (same draws/weights, packed plane)."""
+    from ..ops.gf2_packed import pack_shots
+
+    flips, logw = bit_flips_tilted(key, shape, p, q)
+    return pack_shots(flips), logw
+
+
+# ---------------------------------------------------------------------------
+# Fixed-weight strata (subset-splitting substrate)
+# ---------------------------------------------------------------------------
+def fixed_weight_flips(key, shape, k):
+    """Uniformly-random weight-``k`` bit patterns, one per shot.
+
+    ``shape = (batch, n)``; ``k`` may be TRACED (one compiled program
+    serves every stratum of a sweep).  Each row is a uniform draw from the
+    ``C(n, k)`` weight-k strings: a per-shot random permutation assigns
+    ranks and the ``k`` smallest ranks flip — exact (no ties), at
+    O(n log n) per shot."""
+    batch, n = shape
+    ranks = jax.vmap(lambda kk: jax.random.permutation(kk, n))(
+        jax.random.split(key, batch))
+    return (ranks < jnp.asarray(k, jnp.int32)).astype(jnp.uint8)
+
+
+def stratum_log_weight(n, k, p_total):
+    """Log importance weight of a uniform weight-``k`` stratum sample
+    toward an i.i.d. total-error-rate-``p_total`` channel:
+    ``log C(n,k) + k log p + (n-k) log(1-p)`` — constant across the
+    stratum (proposal ``1/C(n,k)`` per pattern, target
+    ``(p/3-ish per type)^k (1-p)^(n-k)`` with the per-type factors handled
+    by the type draw in ``depolarizing_xz_stratum``).  Traced-``k`` safe
+    via ``gammaln``."""
+    from jax.scipy.special import gammaln
+
+    n = jnp.asarray(n, jnp.float32)
+    k = jnp.asarray(k, jnp.float32)
+    p = jnp.asarray(p_total, jnp.float32)
+    log_comb = gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
+    return log_comb + k * jnp.log(p) + (n - k) * jnp.log1p(-p)
+
+
+def depolarizing_xz_stratum(key, shape, pauli_error_probs, k):
+    """Depolarizing sample conditioned on TOTAL error weight ``k``: ``k``
+    uniformly-chosen sites get a Pauli drawn from the renormalized
+    ``(px, py, pz)`` type distribution; the rest are identity.
+
+    Returns ``(error_x, error_z, log_weight)`` with ``log_weight`` the
+    per-shot ``(batch,)`` log importance weight toward the unconditioned
+    channel — constant ``stratum_log_weight(n, k, px+py+pz)`` (the type
+    draw cancels exactly between proposal and target, leaving the
+    position/weight factor).  ``k`` may be traced."""
+    batch, n = shape
+    k_pos, k_type = jax.random.split(key)
+    px, py, pz = (jnp.asarray(p, jnp.float32) for p in pauli_error_probs)
+    total = px + py + pz
+    sites = fixed_weight_flips(k_pos, shape, k)
+    # type draw with the reference's binning order on renormalized probs
+    u = jax.random.uniform(k_type, shape, dtype=jnp.float32)
+    tz, tx = pz / total, px / total
+    is_z = u < tz
+    is_x = (u >= tz) & (u < tz + tx)
+    is_y = ~(is_z | is_x)
+    on = sites.astype(bool)
+    error_x = (on & (is_x | is_y)).astype(jnp.uint8)
+    error_z = (on & (is_z | is_y)).astype(jnp.uint8)
+    logw = jnp.broadcast_to(stratum_log_weight(n, k, total), (batch,))
+    return error_x, error_z, logw

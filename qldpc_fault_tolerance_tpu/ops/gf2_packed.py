@@ -47,6 +47,7 @@ __all__ = [
     "packed_count",
     "packed_per_shot_weight",
     "packed_residual_stats",
+    "packed_residual_flags",
 ]
 
 LANE = 32  # shots per uint32 lane word
@@ -176,10 +177,8 @@ def packed_residual_stats(res_x, res_z, hz_par, hx_par, lz_t, lx_t,
     the cell-fused sweep path picks per cell with a traced index, so one
     compiled program serves cells of any logical type.
     """
-    x_stab = packed_any(packed_parity_apply(hz_par[0], hz_par[1], res_x))
-    x_log = packed_any(packed_gf2_matmul(res_x, lz_t))
-    z_stab = packed_any(packed_parity_apply(hx_par[0], hx_par[1], res_z))
-    z_log = packed_any(packed_gf2_matmul(res_z, lx_t))
+    x_stab, x_log, z_stab, z_log = _residual_flag_words(
+        res_x, res_z, hz_par, hx_par, lz_t, lx_t)
     x_fail = x_stab | x_log
     z_fail = z_stab | z_log
     if eval_type == "X":
@@ -199,6 +198,39 @@ def packed_residual_stats(res_x, res_z, hz_par, hx_par, lz_t, lx_t,
                    packed_per_shot_weight(res_z, batch_size), n)
     min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
     return cnt, min_w
+
+
+def _residual_flag_words(res_x, res_z, hz_par, hx_par, lz_t, lx_t):
+    """Shared flag-word core of the packed residual checks: per-shot
+    stabilizer / logical failure flag words ``(x_stab, x_log, z_stab,
+    z_log)``, each (W,) uint32."""
+    x_stab = packed_any(packed_parity_apply(hz_par[0], hz_par[1], res_x))
+    x_log = packed_any(packed_gf2_matmul(res_x, lz_t))
+    z_stab = packed_any(packed_parity_apply(hx_par[0], hx_par[1], res_z))
+    z_log = packed_any(packed_gf2_matmul(res_z, lx_t))
+    return x_stab, x_log, z_stab, z_log
+
+
+def packed_residual_flags(res_x, res_z, hz_par, hx_par, lz_t, lx_t,
+                          batch_size: int, n: int, *,
+                          z_weight_excludes_stab: bool = False):
+    """Per-SHOT residual failure flags from packed planes: ``(x_fail,
+    z_fail, min_w)`` with the flags as (batch_size,) uint8 — the unit the
+    weighted (importance-sampled) pipelines multiply by per-shot weights.
+    Same flag-word algebra as ``packed_residual_stats`` (the two share
+    ``_residual_flag_words``), so a popcount over these flags equals that
+    function's counts bit for bit."""
+    x_stab, x_log, z_stab, z_log = _residual_flag_words(
+        res_x, res_z, hz_par, hx_par, lz_t, lx_t)
+    x_fail = unpack_shots(x_stab | x_log, batch_size)
+    z_fail = unpack_shots(z_stab | z_log, batch_size)
+    wz_flags = z_log & ~z_stab if z_weight_excludes_stab else z_log
+    wx = jnp.where(unpack_shots(x_log, batch_size).astype(bool),
+                   packed_per_shot_weight(res_x, batch_size), n)
+    wz = jnp.where(unpack_shots(wz_flags, batch_size).astype(bool),
+                   packed_per_shot_weight(res_z, batch_size), n)
+    min_w = jnp.minimum(wx.min(), wz.min()).astype(jnp.int32)
+    return x_fail, z_fail, min_w
 
 
 def packed_per_shot_weight(packed_bits, batch_size: int) -> jnp.ndarray:

@@ -263,35 +263,51 @@ class MegabatchDriver:
 
 
 def count_min_driver(tag: str, cfg, k_inner: int, stats_fn,
-                     min_init: int, tele_len: int = 0) -> MegabatchDriver:
+                     min_init: int, tele_len: int = 0,
+                     weighted: bool = False) -> MegabatchDriver:
     """Memoized MegabatchDriver for the engines' shared stats shape: a
     ``(failure count, min logical weight)`` fold.  Keyed on
-    ``(tag, cfg, k_inner, tele_len)`` so same-structure simulator instances
-    (p- and cycle-sweeps: state values change, program doesn't) reuse one
-    compiled scan.  ``stats_fn(key, *extra) -> (i32 count, i32 min_w)``;
-    ``min_init`` seeds the min-weight track (the code length N).
+    ``(tag, cfg, k_inner, tele_len, weighted)`` so same-structure simulator
+    instances (p- and cycle-sweeps: state values change, program doesn't)
+    reuse one compiled scan.  ``stats_fn(key, *extra) -> (i32 count,
+    i32 min_w)``; ``min_init`` seeds the min-weight track (the code
+    length N).
 
-    ``tele_len > 0``: the stats tuple carries a third element — a
+    ``tele_len > 0``: the stats tuple carries a trailing element — a
     ``(tele_len,)`` int32 device telemetry vector (utils.telemetry slot
     layout) summed across batches alongside the counts, so per-shot decoder
-    statistics reach the host at the run's one existing sync."""
+    statistics reach the host at the run's one existing sync.
+
+    ``weighted``: the importance-sampled carry — ``stats_fn`` returns
+    ``(count, min_w, s1, s2, w1, w2[, tele])`` with the four float32
+    weight moments (Σw·I, Σw²·I, Σw, Σw²) summed through the fold exactly
+    like the counts, so a weighted run keeps the engines'
+    one-sync-per-megabatch discipline."""
 
     def make():
-        if tele_len:
-            combine = lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1]),
-                                    c[2] + o[2])
-            init = lambda: (jnp.zeros((), jnp.int32),
-                            jnp.asarray(min_init, jnp.int32),
-                            jnp.zeros((tele_len,), jnp.int32))
-        else:
-            combine = lambda c, o: (c[0] + o[0], jnp.minimum(c[1], o[1]))
-            init = lambda: (jnp.zeros((), jnp.int32),
-                            jnp.asarray(min_init, jnp.int32))
+        n_w = 4 if weighted else 0
+
+        def combine(c, o):
+            out = [c[0] + o[0], jnp.minimum(c[1], o[1])]
+            out += [c[2 + i] + o[2 + i] for i in range(n_w)]
+            if tele_len:
+                out.append(c[2 + n_w] + o[2 + n_w])
+            return tuple(out)
+
+        def init():
+            carry = [jnp.zeros((), jnp.int32),
+                     jnp.asarray(min_init, jnp.int32)]
+            carry += [jnp.zeros((), jnp.float32)] * n_w
+            if tele_len:
+                carry.append(jnp.zeros((tele_len,), jnp.int32))
+            return tuple(carry)
+
         driver = MegabatchDriver(stats_fn, combine, init, k_inner=k_inner)
         driver.cost_label = f"megabatch.{tag}"
         return driver
 
-    return _engine_driver_cache.get((tag, cfg, k_inner, tele_len), make)
+    return _engine_driver_cache.get(
+        (tag, cfg, k_inner, tele_len, weighted), make)
 
 
 # ---------------------------------------------------------------------------
@@ -333,25 +349,36 @@ class CellFusedDriver(MegabatchDriver):
     (``fold_in(key_lane, axis_index)``, matching the serial mesh path's
     per-device streams) and the per-lane counts psum-reduce over ICI.
     Shots per lane-batch then scale by the device count.
+
+    ``weighted``: the importance-sampled cell fold — ``stats_fn``
+    additionally returns four (L,) float32 per-lane weight moments
+    ``(s1, s2, w1, w2)`` after ``(count, min_w)``, accumulated into
+    per-CELL planes through the same lane-plan scatter as the counts, so
+    rare-event cells ride the adaptive lane reallocation unchanged.  Carry
+    becomes ``(failures, shots, min_w, s1, s2, w1, w2[, tele])``.
     """
 
     def __init__(self, stats_fn, n_cells: int, batch_size: int,
-                 k_inner: int, min_init: int, tele_len: int = 0, mesh=None):
+                 k_inner: int, min_init: int, tele_len: int = 0, mesh=None,
+                 weighted: bool = False):
         self.k_inner = max(1, int(k_inner))
         self.n_cells = int(n_cells)
         self.batch_size = int(batch_size)
         self.tele_len = int(tele_len)
+        self.weighted = bool(weighted)
         self._mesh = mesh
         self.dispatches = 0
         self.cost_label = "fused_cells"
         n_dev = 1 if mesh is None else mesh.devices.size
         shots_inc = jnp.int32(self.batch_size * n_dev)
         big = jnp.int32(np.iinfo(np.int32).max)
+        n_w = 4 if weighted else 0
 
         def init_fn():
             carry = (jnp.zeros((self.n_cells,), jnp.int32),
                      jnp.zeros((self.n_cells,), jnp.int32),
                      jnp.full((self.n_cells,), min_init, jnp.int32))
+            carry += (jnp.zeros((self.n_cells,), jnp.float32),) * n_w
             if tele_len:
                 carry += (jnp.zeros((tele_len,), jnp.int32),)
             return carry
@@ -367,8 +394,10 @@ class CellFusedDriver(MegabatchDriver):
                 out = stats_fn(dev_keys, lane_cell, active, *extra)
                 res = (jax.lax.psum(out[0], SHOT_AXIS),
                        jax.lax.pmin(out[1], SHOT_AXIS))
+                res += tuple(jax.lax.psum(out[2 + i], SHOT_AXIS)
+                             for i in range(n_w))
                 if tele_len:
-                    res += (jax.lax.psum(out[2], SHOT_AXIS),)
+                    res += (jax.lax.psum(out[2 + n_w], SHOT_AXIS),)
                 return res
 
             # all inputs replicated, outputs reduced -> replicated; the
@@ -376,7 +405,8 @@ class CellFusedDriver(MegabatchDriver):
             return _shard_map(
                 local, mesh=mesh,
                 in_specs=(P(),) * (3 + len(extra)),
-                out_specs=(P(), P()) + ((P(),) if tele_len else ()),
+                out_specs=(P(), P()) + (P(),) * n_w
+                + ((P(),) if tele_len else ()),
                 check_vma=False,
             )(keys, lane_cell, active, *extra)
 
@@ -395,8 +425,12 @@ class CellFusedDriver(MegabatchDriver):
                 mws = c[2].at[lane_cell].min(
                     jnp.where(active, mw, big), mode="drop")
                 new = (fail, shots, mws)
+                new += tuple(
+                    c[3 + i].at[lane_cell].add(
+                        jnp.where(active, out[2 + i], 0.0), mode="drop")
+                    for i in range(n_w))
                 if tele_len:
-                    new += (c[3] + out[2],)
+                    new += (c[3 + n_w] + out[2 + n_w],)
                 return new, None
 
             carry, _ = jax.lax.scan(body, carry, jnp.arange(self.k_inner))
@@ -459,23 +493,25 @@ class CellFusedDriver(MegabatchDriver):
 
 def cell_fused_driver(tag: str, cfg, n_cells: int, k_inner: int, stats_fn,
                       *, min_init: int, batch_size: int, tele_len: int = 0,
-                      mesh=None, state_key=()) -> CellFusedDriver:
+                      mesh=None, state_key=(),
+                      weighted: bool = False) -> CellFusedDriver:
     """Memoized CellFusedDriver, keyed on the fused program identity:
     engine tag + hashable cfg + cell count + chunk + telemetry length +
     mesh + ``state_key`` (the bucket's state-stacking layout — which leaves
-    are per-cell vs shared changes the traced program).  Same-shape buckets
-    (another code of equal shape, the next p-grid over the same code) reuse
-    one compiled scan."""
+    are per-cell vs shared changes the traced program) + the weighted-carry
+    flag.  Same-shape buckets (another code of equal shape, the next p-grid
+    over the same code) reuse one compiled scan."""
 
     def make():
         driver = CellFusedDriver(stats_fn, n_cells, batch_size, k_inner,
-                                 min_init, tele_len=tele_len, mesh=mesh)
+                                 min_init, tele_len=tele_len, mesh=mesh,
+                                 weighted=weighted)
         driver.cost_label = f"fused_cells.{tag}"
         return driver
 
     return _engine_driver_cache.get(
         ("cells", tag, cfg, n_cells, k_inner, tele_len, mesh, state_key,
-         batch_size), make)
+         batch_size, weighted), make)
 
 
 def drain_double_buffered(launch, finish, items, depth: int = 2):

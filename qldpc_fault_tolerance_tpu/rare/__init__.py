@@ -1,0 +1,60 @@
+"""Rare-event estimation: on-device importance sampling for deep
+sub-threshold WER (ROADMAP item 4).
+
+Direct Monte-Carlo dies exactly where the effective-distance story needs
+points: at p ≪ p_c a WER of 1e-10 needs ~1e12 shots.  This subsystem
+samples errors from TILTED channels (``noise.samplers`` ``*_tilted``) and
+fixed-weight strata, carries the per-shot log importance weight through the
+existing packed/fused device pipelines as an extra carry plane, and
+accumulates weighted failure counts plus second moments on device — WER and
+its variance come back in the engines' one-sync-per-megabatch discipline.
+
+Entry points, bottom to top:
+
+  * ``sim.*.WeightedWordErrorRate`` — one importance-sampled cell on the
+    data / phenom engines (the engines own the device loop; this package
+    provides the tilt selection and result plumbing).
+  * ``tilted_wer`` / ``stratified_wer`` — single-cell conveniences
+    returning sigma-weighted fit points.
+  * ``eval_weighted_cells`` — a whole rare-event rung ladder as ONE fused
+    device program (per-cell tilts on the cell axis), with ESS-aware
+    adaptive lane donation from converged rungs and v2-checkpoint
+    kill+resume.  ``eval_rare_grid`` is its factory-driven sweep-layer
+    entry (same decoder-factory and cell-key conventions as
+    ``CodeFamily.EvalWER``).
+  * ``fit_rare_distance`` — sigma-weighted ``fit_distance_report`` over
+    the resulting points.
+
+The zero-tilt configuration (tilt == channel probs) is bit-exact with the
+direct engines seed-for-seed — the anchor tier-1 pins.
+"""
+from .estimator import stratified_wer, tilted_wer
+from .sweep import (
+    eval_rare_grid,
+    eval_weighted_cells,
+    fit_rare_distance,
+    weighted_cell_adaptive,
+    weighted_cell_stream,
+)
+from .tilt import (
+    auto_tilt,
+    rare_fit_points,
+    tilt_channel,
+    variance_reduction,
+    weighted_fit_point,
+)
+
+__all__ = [
+    "auto_tilt",
+    "eval_rare_grid",
+    "eval_weighted_cells",
+    "fit_rare_distance",
+    "rare_fit_points",
+    "stratified_wer",
+    "tilt_channel",
+    "tilted_wer",
+    "variance_reduction",
+    "weighted_cell_adaptive",
+    "weighted_cell_stream",
+    "weighted_fit_point",
+]

@@ -1,0 +1,174 @@
+"""Rare-event estimators: tilted-channel convenience entry and the
+fixed-weight stratum (subset) estimator.
+
+Two complementary schemes over the same device pipelines:
+
+  * **tilted**: draw every shot from a boosted channel and reweight
+    (``sim.WeightedWordErrorRate`` — the engines own the device loop); best
+    when the failure set is diffuse in weight.
+  * **stratified**: condition on exact error weight ``k`` and measure the
+    per-stratum failure rate ``r_k`` directly, combining with the binomial
+    weight-distribution masses ``P(W=k)`` on the host:
+    ``p̂ = Σ_k P(W=k)·r_k``.  Within a stratum every shot has the SAME
+    importance weight, so the per-stratum estimate is a plain binomial
+    count — no weight degeneracy at any depth — at the cost of covering
+    strata one by one.  Uncovered tail mass is reported, not silently
+    dropped: ``P(W > k_max)`` bounds the truncation error (failure rate
+    within a stratum is at most 1).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tilt import auto_tilt, tilt_channel, weighted_fit_point
+
+__all__ = ["tilted_wer", "stratified_wer"]
+
+
+def tilted_wer(sim, num_samples: int, q_total: float | None = None,
+               d_eff: float | None = None, p: float | None = None,
+               key=None, progress=None, target_rse=None) -> dict:
+    """Run one importance-sampled WER cell on a data-error simulator and
+    return its sigma-weighted fit point (``rare.tilt.weighted_fit_point``).
+    ``q_total`` defaults to ``auto_tilt`` from the channel's total rate
+    (and ``d_eff`` when the caller has a near-threshold distance fit);
+    ``p`` is the fit-axis value (defaults to the channel's total rate)."""
+    p_total = float(sum(float(np.asarray(x)) for x in sim.channel_probs))
+    if q_total is None:
+        q_total = auto_tilt(p_total, n=sim.N, d_eff=d_eff)
+    tilt = tilt_channel(sim.channel_probs, q_total)
+    sim.WeightedWordErrorRate(num_samples, tilt_probs=tilt, key=key,
+                              progress=progress, target_rse=target_rse)
+    return weighted_fit_point(p_total if p is None else p,
+                              sim.last_weighted, sim.K, tilt=q_total)
+
+
+# ---------------------------------------------------------------------------
+# Fixed-weight stratum estimator
+# ---------------------------------------------------------------------------
+def _log_binom_pmf(n: int, k: int, p: float) -> float:
+    return (math.lgamma(n + 1) - math.lgamma(k + 1)
+            - math.lgamma(n - k + 1)
+            + k * math.log(p) + (n - k) * math.log1p(-p))
+
+
+def _stratum_stats_one_batch(cfg, state, key):
+    """One fixed-weight batch -> (failure count, min weight) scalars: the
+    stratum sampler feeding the data engine's dense decode/check tail.
+    ``state["stratum_k"]`` is TRACED, so one compiled program serves every
+    stratum of a run."""
+    from ..decoders.bp_decoders import decode_device
+    from ..noise import depolarizing_xz_stratum
+    from ..sim.data_error import _check, _parity
+
+    batch_size, n = cfg[0], cfg[1]
+    ex, ez, _logw = depolarizing_xz_stratum(
+        key, (batch_size, n), state["probs"], state["stratum_k"])
+    synd_z = _parity(state["hx_par"], ez)
+    synd_x = _parity(state["hz_par"], ex)
+    cor_z, _ = decode_device(cfg[4], state["dz"], synd_z)
+    cor_x, _ = decode_device(cfg[3], state["dx"], synd_x)
+    fail, mw = _check(cfg, state, ex, ez, cor_x, cor_z)
+    return fail.sum(dtype=jnp.int32), mw
+
+
+def stratified_wer(sim, strata, samples_per_stratum: int,
+                   key=None) -> dict:
+    """Fixed-weight subset estimator on a data-error simulator.
+
+    ``strata``: iterable of error weights ``k`` to measure (e.g.
+    ``range(ceil(d/2), d+3)`` around the decoder's failure shell).  Each
+    stratum runs ``samples_per_stratum`` shots of exactly-weight-``k``
+    errors through the standard decode/check pipeline (one compiled
+    program, ``k`` traced) and emits one ``rare_stratum`` telemetry event.
+
+    Returns ``{rate, variance, wer, wer_eb, strata: [...], covered_mass,
+    head_mass, tail_mass, stats}`` — ``rate`` is the stratified estimate
+    ``Σ P(W=k) r_k`` over the covered strata, ``variance`` its exact
+    stratified variance ``Σ P(W=k)² r_k(1-r_k)/n_k``, ``tail_mass`` the
+    ``P(W > k_max)`` truncation bound (failure rate within a stratum is at
+    most 1, so it bounds the missing contribution), ``head_mass`` the
+    ``P(W < k_min)`` mass of the skipped low-weight shell (NOT a truncation
+    error when those strata are decoder-correctable — the caller skipped
+    them because their r_k is 0), and ``stats`` a WeightedStats view of the
+    same run (conservative variance) that plugs into the shared
+    ``wer_run`` / fit plumbing."""
+    from ..parallel.shots import count_min_driver
+    from ..sim.common import (
+        ShotBatcher,
+        WeightedStats,
+        record_wer_run,
+        wer_single_shot_weighted,
+    )
+    from ..utils import telemetry
+
+    if sim._needs_host or sim._mesh is not None or sim._fused_sampler:
+        raise ValueError(
+            "stratified estimation requires the pure-device single-chip "
+            "path (no host-postprocess decoders, no mesh, default sampler)")
+    strata = sorted({int(k) for k in strata})
+    if not strata or strata[0] < 1:
+        raise ValueError("strata must be positive error weights")
+    if key is None:
+        sim._base_key, key = jax.random.split(sim._base_key)
+    p_total = float(sum(float(np.asarray(x)) for x in sim.channel_probs))
+    n = sim.N
+    cfg = sim._cfg(sim.batch_size, packed=False, tele=False)
+    batcher = ShotBatcher(samples_per_stratum, sim.batch_size)
+    chunk = min(batcher.num_batches, sim._scan_chunk)
+    n_batches = -(-batcher.num_batches // chunk) * chunk
+    driver = count_min_driver(
+        "data-stratum", cfg, chunk,
+        lambda k, state: _stratum_stats_one_batch(cfg, state, k),
+        min_init=n)
+    rows = []
+    rate = var = covered = 0.0
+    s2 = w1 = w2 = 0.0
+    failures_total = shots_total = 0
+    for k in strata:
+        state = dict(sim._dev_state, stratum_k=jnp.asarray(k, jnp.int32))
+        carry, _ = driver.run(jax.random.fold_in(key, k), n_batches, state)
+        failures = int(carry[0])
+        sim.min_logical_weight = min(sim.min_logical_weight, int(carry[1]))
+        shots = n_batches * sim.batch_size
+        pmf = math.exp(_log_binom_pmf(n, k, p_total))
+        r_k = failures / shots
+        contribution = pmf * r_k
+        rate += contribution
+        var += pmf * pmf * r_k * (1.0 - r_k) / shots
+        covered += pmf
+        # WeightedStats view: per-shot weight pmf·N_total/n_k
+        failures_total += failures
+        shots_total += shots
+        rows.append({"stratum": k, "shots": shots, "failures": failures,
+                     "weight": pmf, "rate": r_k,
+                     "contribution": contribution})
+        telemetry.event("rare_stratum", stratum=k, shots=shots,
+                        failures=failures, weight=pmf, rate=r_k,
+                        contribution=contribution)
+        telemetry.count("rare.strata")
+    for row in rows:
+        w_shot = row["weight"] * shots_total / row["shots"]
+        s2 += w_shot * w_shot * row["failures"]
+        w1 += w_shot * row["shots"]
+        w2 += w_shot * w_shot * row["shots"]
+    stats = WeightedStats(failures=failures_total, shots=shots_total,
+                          s1=rate * shots_total, s2=s2, w1=w1, w2=w2)
+    # mass outside the covered strata, split by side: only the survival
+    # above k_max is a truncation ERROR bound (r_k <= 1); the head below
+    # k_min is the decoder-correctable shell the caller deliberately
+    # skipped, and lumping it in would overstate the bound by orders of
+    # magnitude at any sub-threshold p
+    head_mass = sum(math.exp(_log_binom_pmf(n, k, p_total))
+                    for k in range(strata[0]))
+    tail_mass = max(1.0 - covered - head_mass, 0.0)
+    wer, wer_eb = wer_single_shot_weighted(stats, sim.K)
+    record_wer_run("data", failures_total, shots_total, wer,
+                   weighted=stats, tilt=None)
+    return {"rate": rate, "variance": var, "wer": wer, "wer_eb": wer_eb,
+            "strata": rows, "covered_mass": covered,
+            "head_mass": head_mass, "tail_mass": tail_mass, "stats": stats}

@@ -1,0 +1,358 @@
+"""Weighted fused sweep execution: every p rung of a rare-event grid in ONE
+device program, with adaptive lane donation from converged rungs.
+
+Subset-splitting across the p rungs of a sweep grid: each rung is an
+importance-sampled cell (its own tilt, chosen per rung by ``rare.tilt``),
+all rungs fused on the cell axis of a ``CellFusedDriver(weighted=True)``
+program (sim/data_error.weighted_cells_program) so one dispatch advances the
+whole ladder and one host sync drains every rung's weight moments.  The
+adaptive loop reuses the fused driver's lane planner (sim/common.plan_lanes)
+with an ESS-aware convergence test: a rung whose weighted relative standard
+error reaches ``target_rse`` stops consuming lanes and DONATES them to the
+still-uncertain (deeper) rungs — exactly the converged-cells-feed-rare-cells
+scheduling ROADMAP item 4 calls for.  Per-cell cursors persist through the
+v2 checkpoint (weight-moment planes included), so a killed weighted grid
+resumes seed-for-seed.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "weighted_cell_stream",
+    "weighted_cell_adaptive",
+    "eval_weighted_cells",
+    "eval_rare_grid",
+    "fit_rare_distance",
+]
+
+
+def _weighted_host(carry):
+    """Host arrays from a fetched weighted fused carry:
+    ``(failures, shots, min_w, s1, s2, w1, w2, tele-or-None)``."""
+    host = [np.asarray(x) for x in carry]
+    return tuple(host[:7]) + ((host[7] if len(host) > 7 else None),)
+
+
+def _weighted_carry0(state, tele_on: bool):
+    """Rebuild a weighted fused device carry from a persisted per-cell
+    progress record."""
+    import jax.numpy as jnp
+
+    from ..utils import telemetry
+
+    wm = state.get("weighted") or {}
+    C = len(state["failures"])
+    carry = [jnp.asarray(state["failures"], jnp.int32),
+             jnp.asarray(state["shots"], jnp.int32),
+             jnp.asarray(state["min_w"], jnp.int32),
+             jnp.asarray(wm.get("s1", [0.0] * C), jnp.float32),
+             jnp.asarray(wm.get("s2", [0.0] * C), jnp.float32),
+             jnp.asarray(wm.get("w1", [0.0] * C), jnp.float32),
+             jnp.asarray(wm.get("w2", [0.0] * C), jnp.float32)]
+    if tele_on:
+        carry.append(jnp.asarray(
+            state.get("tele") or [0] * telemetry.TELE_LEN, jnp.int32))
+    return tuple(carry)
+
+
+def _save_cells(progress, signature, batches_done, host, cursors=None):
+    failures, shots, min_w, s1, s2, w1, w2, tele = host
+    progress.save_cells(
+        signature, batches_done=batches_done, failures=failures,
+        shots=shots, min_w=min_w, cursors=cursors, tele=tele,
+        extra={"weighted": {
+            "s1": [float(x) for x in s1], "s2": [float(x) for x in s2],
+            "w1": [float(x) for x in w1], "w2": [float(x) for x in w2]}})
+
+
+def _publish_progress(prog, host) -> None:
+    """Live per-cell ESS-aware intervals at a sync the stream already pays
+    (the weighted twin of sim/common._fused_cell_progress): gauges plus one
+    ``cell_progress`` event carrying the ess list — the dashboard's mark
+    for importance-sampled cells."""
+    from ..utils import diagnostics, telemetry
+
+    if not diagnostics.active():
+        return
+    failures, shots, _mw, s1, s2, w1, w2, _tele = host
+    if prog.cell_keys is not None:
+        cells = prog.cell_keys
+    elif prog.cell_tags is not None:
+        # weighted cell tags are (px, py, pz, qx, qy, qz) tripled pairs;
+        # the p total is the readable identity
+        cells = [{"p": round(float(sum(t[:3])), 12)}
+                 for t in prog.cell_tags]
+    else:
+        cells = [{"p": i} for i in range(len(failures))]
+    los, his, rses, esses = [], [], [], []
+    for i in range(len(failures)):
+        blk = diagnostics.weighted_ci_fields(
+            int(failures[i]), s1[i], s2[i], w1[i], w2[i], int(shots[i]))
+        los.append(blk["ci_low"])
+        his.append(blk["ci_high"])
+        rses.append(blk["rse"])
+        esses.append(blk["ess"])
+    telemetry.event(
+        "cell_progress", engine=prog.engine,
+        cells=[c if isinstance(c, dict) else {"p": c} for c in cells],
+        failures=[int(x) for x in failures],
+        shots=[int(x) for x in shots],
+        ci_low=los, ci_high=his, rse=rses, ess=esses)
+
+
+def weighted_cell_stream(prog, *, progress=None, tele_on: bool = False):
+    """Fixed-budget weighted fused run with per-cell progress persistence
+    (the weighted twin of sim/common.fused_cell_stream).  Returns the host
+    carry tuple ``(failures, shots, min_w, s1, s2, w1, w2, tele)``."""
+    from ..utils import telemetry
+
+    start, carry0 = 0, None
+    state = progress.load(prog.signature) if progress is not None else None
+    if state:
+        start = int(state["batches_done"])
+        carry0 = _weighted_carry0(state, tele_on)
+    k = prog.chunk
+    n_run = -(-int(prog.n_batches) // k) * k
+    if start >= n_run and state:
+        # resumed past the end: the persisted counters ARE the result
+        wm = state.get("weighted") or {}
+        C = len(state["failures"])
+        return (np.asarray(state["failures"]), np.asarray(state["shots"]),
+                np.asarray(state["min_w"]),
+                *(np.asarray(wm.get(key, [0.0] * C), np.float64)
+                  for key in ("s1", "s2", "w1", "w2")), None)
+    last = None
+    for host_carry, done in prog.driver.run_plan_keys(
+            prog.key, prog.n_batches, *prog.extras, start=start,
+            carry0=carry0):
+        host = _weighted_host(host_carry)
+        if progress is not None:
+            _save_cells(progress, prog.signature, done, host)
+        _publish_progress(prog, host)
+        last = host
+    if last[-1] is not None:
+        telemetry.publish_device_tele(last[-1])
+    return last
+
+
+def weighted_cell_adaptive(prog, *, target_rse: float,
+                           min_failures: int = 10, progress=None,
+                           tele_on: bool = False):
+    """ESS-aware adaptive lane reallocation over a weighted fused bucket:
+    one host sync per megabatch for the whole rung ladder; rungs whose
+    weighted relative standard error reached ``target_rse`` (with at least
+    ``min_failures`` raw failures — an rse from one lucky shot is noise)
+    are masked out and their lanes donate to the undecided rungs via the
+    shared lane planner.  Every rung keeps its serial positional key
+    stream, so estimates are seed-for-seed reproducible at any lane
+    assignment.  Returns the host carry tuple."""
+    import jax
+
+    from ..sim.common import WeightedStats, plan_lanes
+    from ..utils import profiling, resilience, telemetry
+
+    import time
+
+    driver, k = prog.driver, prog.chunk
+    C = prog.n_cells
+    n_run = -(-int(prog.n_batches) // k) * k
+    cursors = np.zeros(C, np.int64)
+    carry = driver._init_fn()
+    signature = (dict(prog.signature, adaptive=round(float(target_rse), 12))
+                 if progress is not None else None)
+    state = progress.load(signature) if progress is not None else None
+    if state:
+        cursors = np.asarray(
+            state.get("cursors") or [state["batches_done"]] * C, np.int64)
+        carry = _weighted_carry0(state, tele_on)
+    while True:
+        t0 = time.perf_counter()
+        host_carry = resilience.guarded_fetch(
+            lambda: jax.device_get(carry), label="weighted_adaptive_drain")
+        profiling.record_host_sync(time.perf_counter() - t0)
+        host = _weighted_host(host_carry)
+        failures, shots = host[0], host[1]
+        if progress is not None:
+            _save_cells(progress, signature, 0, host, cursors=cursors)
+        _publish_progress(prog, host)
+
+        def _converged(c):
+            if failures[c] < min_failures:
+                return False
+            ws = WeightedStats(
+                failures=int(failures[c]), shots=int(shots[c]),
+                s1=float(host[3][c]), s2=float(host[4][c]),
+                w1=float(host[5][c]), w2=float(host[6][c]))
+            rse = ws.rse
+            return rse is not None and rse <= target_rse
+
+        undecided = [c for c in range(C)
+                     if cursors[c] < n_run and not _converged(c)]
+        if not undecided:
+            break
+        base, stride, cell, active, advance, realloc = plan_lanes(
+            cursors, undecided, C, k, n_run)
+        if realloc:
+            telemetry.count("sweep.reallocated_shots",
+                            realloc * prog.batch_size)
+        carry = driver.dispatch_plan(carry, prog.key,
+                                     (base, stride, cell, active),
+                                     *prog.extras)
+        cursors += advance
+    stopped_early = sum(1 for c in range(C) if cursors[c] < n_run)
+    if stopped_early:
+        telemetry.count("driver.early_stops", stopped_early)
+    if host[-1] is not None:
+        telemetry.publish_device_tele(host[-1])
+    return host
+
+
+def eval_weighted_cells(sims, tilts, num_samples: int, *,
+                        target_rse: float | None = None,
+                        min_failures: int = 10, checkpoint=None,
+                        progress_every: int = 1, cell_keys=None,
+                        mesh=None) -> list[dict]:
+    """Run one rare-event rung ladder as a weighted fused bucket.
+
+    ``sims``: same-shape data-error simulators, one per p rung (equal seed
+    and K, pure-device decoders); ``tilts``: the per-rung (3,) tilt
+    triples (``rare.tilt.tilt_channel``; a rung tilted to its own channel
+    probs runs the zero-tilt configuration).  With ``target_rse`` the
+    adaptive loop donates converged rungs' lanes to the undecided ones;
+    otherwise every rung runs the fixed budget.  ``checkpoint``: a
+    utils.checkpoint.SweepCheckpoint for per-cell cursors (kill+resume
+    seed-for-seed).  Returns one dict per rung —
+    ``{index, p, tilt, wer, wer_eb, sigma, ess, stats}`` — ready for
+    ``fit_rare_distance``."""
+    from ..sim.common import WeightedStats, record_wer_run
+    from ..sim.data_error import weighted_cells_program
+    from ..utils import diagnostics, telemetry
+    from ..utils.checkpoint import CellProgress
+    from .tilt import weighted_fit_point
+
+    prog = weighted_cells_program(sims, tilts, num_samples, mesh=mesh)
+    if cell_keys is not None:
+        prog.cell_keys = list(cell_keys)
+    tele_on = telemetry.enabled()
+    progress = None
+    if checkpoint is not None and progress_every:
+        key_head = (dict(prog.cell_keys[0]) if prog.cell_keys
+                    else {"engine": "data-w"})
+        key_head["rare_cells"] = [list(t) for t in prog.cell_tags]
+        progress = CellProgress(checkpoint, key_head, every=progress_every)
+    if target_rse is not None:
+        host = weighted_cell_adaptive(
+            prog, target_rse=float(target_rse), min_failures=min_failures,
+            progress=progress, tele_on=tele_on)
+    else:
+        host = weighted_cell_stream(prog, progress=progress,
+                                    tele_on=tele_on)
+    failures, shots, min_w, s1, s2, w1, w2, _tele = host
+    results = []
+    for i, sim in enumerate(sims):
+        ws = WeightedStats(
+            failures=int(failures[i]), shots=int(shots[i]),
+            s1=float(s1[i]), s2=float(s2[i]),
+            w1=float(w1[i]), w2=float(w2[i]), min_w=int(min_w[i]))
+        sim.last_weighted = ws
+        sim.min_logical_weight = min(sim.min_logical_weight, ws.min_w)
+        p_total = float(sum(float(np.asarray(x))
+                            for x in sim.channel_probs))
+        q_total = float(sum(float(t) for t in tilts[i]))
+        # fit axis: the sweep cell key's p when the caller supplied one
+        # (the convention fit_distance_report sees from the direct grids);
+        # the channel's total rate otherwise
+        p_axis = p_total
+        if cell_keys is not None and "p" in prog.cell_keys[i]:
+            p_axis = float(prog.cell_keys[i]["p"])
+        point = weighted_fit_point(p_axis, ws, sim.K, tilt=q_total)
+        point["index"] = i
+        point["stats"] = ws
+        ci = record_wer_run("data", ws.failures, ws.shots, point["wer"],
+                            weighted=ws, tilt=q_total)
+        cell_key = (prog.cell_keys[i] if prog.cell_keys
+                    else {"p": p_total, "code": getattr(
+                        sim.code, "name", "?"), "noise": "data",
+                        "type": sim.eval_logical_type})
+        # dict-literal merge: the CI block and event_fields both carry
+        # "ess" (same value) — keyword expansion would raise on the dup
+        fields = {**cell_key, "wer": point["wer"], **ci,
+                  **ws.event_fields(tilt=q_total)}
+        telemetry.event("cell_done", **fields)
+        diagnostics.record_cell(cell_key, point["wer"], ci or None)
+        telemetry.count("sweep.cells")
+        telemetry.count("rare.cells")
+        results.append(point)
+    return results
+
+
+def eval_rare_grid(code, decoder_class, p_list, num_samples: int, *,
+                   eval_logical_type: str = "Total", d_eff=None,
+                   q_total=None, batch_size: int = 512, seed: int = 0,
+                   target_rse: float | None = None, checkpoint=None,
+                   **cells_kw) -> list[dict]:
+    """Sweep-layer entry for a rare-event p grid: the factory-driven twin
+    of ``CodeFamily.EvalWER``'s data path for rungs direct MC cannot
+    resolve.
+
+    Builds one data-error simulator per rung with the same decoder-factory
+    and channel conventions the sweep layer uses (``decoder_class`` is a
+    ``DecoderClass``; ``eval_p`` maps to ``pauli_error_probs`` exactly as
+    ``sweep/family.CodeFamily._data_sim`` does, so a rung's cell key lines
+    up with the serial/fused grids' keys), picks each rung's tilt with
+    ``auto_tilt`` (pass ``d_eff`` from a near-threshold
+    ``fit_distance_report`` to aim the proposal at the failure shell, or
+    ``q_total`` — scalar or per-rung list — to pin it), and runs the whole
+    ladder as one weighted fused bucket (``eval_weighted_cells``: adaptive
+    lane donation under ``target_rse``, v2-checkpoint kill+resume).
+    Returns the sigma-weighted fit points, ready for
+    ``fit_rare_distance``."""
+    from ..sim.data_error import CodeSimulator_DataError
+    from .tilt import auto_tilt, tilt_channel
+
+    p_list = [float(p) for p in p_list]
+    sims, tilts, cell_keys = [], [], []
+    for i, eval_p in enumerate(p_list):
+        p = eval_p * 3 / 2
+        decoder_x = decoder_class.GetDecoder({"h": code.hz,
+                                              "p_data": eval_p})
+        decoder_z = decoder_class.GetDecoder({"h": code.hx,
+                                              "p_data": eval_p})
+        sims.append(CodeSimulator_DataError(
+            code=code, decoder_x=decoder_x, decoder_z=decoder_z,
+            pauli_error_probs=[p / 3, p / 3, p / 3],
+            eval_logical_type=eval_logical_type,
+            batch_size=batch_size, seed=seed))
+        probs = sims[-1].channel_probs
+        p_total = float(sum(float(np.asarray(x)) for x in probs))
+        if q_total is None:
+            q = auto_tilt(p_total, n=code.N, d_eff=d_eff)
+        elif np.ndim(q_total):
+            q = float(q_total[i])
+        else:
+            q = float(q_total)
+        tilts.append(tilt_channel(probs, q))
+        cell_keys.append({"code": getattr(code, "name", "?"),
+                          "noise": "data", "type": eval_logical_type,
+                          "p": eval_p})
+    return eval_weighted_cells(sims, tilts, num_samples,
+                               target_rse=target_rse,
+                               checkpoint=checkpoint,
+                               cell_keys=cell_keys, **cells_kw)
+
+
+def fit_rare_distance(points: list[dict], **curve_fit_kw) -> dict:
+    """Sigma-weighted effective-distance fit over rare-event points: feeds
+    ``sweep.fits.fit_distance_report`` with each cell's delta-method WER
+    sigma, so deep sub-threshold points enter the fit at their honest
+    weight instead of being treated as exact."""
+    from ..sweep.fits import fit_distance_report
+    from .tilt import rare_fit_points
+
+    p, wer, sigma = rare_fit_points(points)
+    if len(p) < 2:
+        raise ValueError(
+            "need at least two rare-event points with defined sigma for a "
+            "distance fit")
+    return fit_distance_report(p, wer, sigma=sigma, **curve_fit_kw)

@@ -11,6 +11,7 @@ The estimator contracts follow the reference exactly:
 from __future__ import annotations
 
 import dataclasses
+import math
 import warnings
 
 import numpy as np
@@ -18,6 +19,13 @@ import numpy as np
 __all__ = [
     "wer_single_shot",
     "wer_per_cycle",
+    "WeightedStats",
+    "check_tilt_probs",
+    "weight_moments",
+    "wer_single_shot_weighted",
+    "wer_per_cycle_weighted",
+    "resumable_weighted_stream",
+    "drive_weighted_run",
     "ShotBatcher",
     "SimResult",
     "accumulate_device",
@@ -427,6 +435,10 @@ class FusedCellProgram:
     # interval gauges / cell_progress events under these names
     cell_tags: tuple = None
     cell_keys: list = None
+    # importance-sampled bucket: the driver's carry gains the per-cell
+    # weight-moment planes (s1, s2, w1, w2) and rare/sweep.py owns the
+    # drive loop (the direct fused_cell_* streams assume the 3-plane carry)
+    weighted: bool = False
 
     @property
     def signature(self) -> dict:
@@ -707,7 +719,7 @@ def joint_kernel_variant(*decoders, batch_size: int | None = None) -> str:
 
 
 def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
-                   kernel_variant=None):
+                   kernel_variant=None, weighted=None, tilt=None):
     """Shared per-run telemetry bookkeeping for every engine's
     WordErrorRate path: the sim.* counters plus one ``wer_run`` event with
     a uniform schema (``dispatches`` is included only when the path tracks
@@ -723,13 +735,21 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
     reported to the enclosing sweep cell scope — all host arithmetic on
     the two ints already fetched; the estimate itself is untouched.
     Returns the uncertainty block ({} when diagnostics are off) so cell
-    recorders can reuse it instead of recomputing."""
+    recorders can reuse it instead of recomputing.
+
+    ``weighted`` (a WeightedStats) marks an importance-sampled run: the
+    wer_run event gains the schema-v3 fields (log_weight_sum, ess, and the
+    caller's ``tilt``) and its uncertainty block comes from the ESS-aware
+    interval (utils.diagnostics.weighted_ci_fields) instead of Wilson on
+    raw counts — summed weights must never masquerade as shot counts."""
     from ..utils import diagnostics, profiling, telemetry
 
     fields = {"engine": engine, "shots": int(shots),
               "failures": int(failures), "wer": float(wer)}
     if dispatches is not None:
         fields["dispatches"] = int(dispatches)
+    if weighted is not None:
+        fields.update(weighted.event_fields(tilt=tilt))
     if kernel_variant is not None:
         # which BP kernel actually served this run (the silent-XLA-twin
         # routing trace): the event names it, the gauge encodes it as the
@@ -743,9 +763,14 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
         telemetry.count(f"bp.kernel_variant.{kernel_variant}")
     ci = {}
     if diagnostics.active():
-        ci = diagnostics.ci_fields(failures, shots)
+        if weighted is not None:
+            # ESS-aware block; the cell scope is NOT fed (its Wilson-on-
+            # counts math would be wrong for a weighted stream)
+            ci = weighted.ci_fields()
+        else:
+            ci = diagnostics.ci_fields(failures, shots)
+            diagnostics.note_run(failures, shots)
         fields.update(ci)
-        diagnostics.note_run(failures, shots)
     telemetry.count("sim.shots", int(shots))
     telemetry.count("sim.failures", int(failures))
     telemetry.count("sim.runs")
@@ -979,6 +1004,266 @@ def wer_per_cycle(error_count: int, num_samples: int, K: int, num_cycles: int):
     per_cycle_eb = np.sqrt(max((1 - per_cycle) * per_cycle, 0.0) / num_samples)
     wer_eb = per_cycle_eb * ((1 - per_cycle_eb) ** (1 / K - 1)) / K
     return wer, wer_eb
+
+
+# ---------------------------------------------------------------------------
+# Weighted-shot (importance-sampling) statistics — the rare-event subsystem's
+# host-side accumulator (qldpc_fault_tolerance_tpu.rare)
+# ---------------------------------------------------------------------------
+def check_tilt_probs(tilt_probs, channel_probs) -> list:
+    """Validate an importance-sampling tilt against its target channel and
+    return it as a plain float list.
+
+    The weighted estimator is unbiased ONLY when the proposal's support
+    covers the target's: a component the physical channel can produce
+    (``p_i > 0``) that the tilt never proposes (``q_i == 0``) silently
+    biases the estimate low — the worst failure mode for a subsystem whose
+    whole purpose is statistical honesty, so it is rejected loudly here
+    rather than producing a healthy-looking wrong number."""
+    tilt = [float(np.asarray(q)) for q in tilt_probs]
+    probs = [float(np.asarray(p)) for p in channel_probs]
+    if len(tilt) != len(probs):
+        raise ValueError(
+            f"tilt_probs must have {len(probs)} components (one per Pauli "
+            f"type), got {len(tilt)}")
+    if any(q < 0 for q in tilt) or not 0.0 <= sum(tilt) < 1.0:
+        raise ValueError(
+            f"tilt_probs must be a sub-probability triple (q_i >= 0, "
+            f"sum < 1), got {tilt}")
+    for i, (q, p) in enumerate(zip(tilt, probs)):
+        if p > 0 and q <= 0:
+            raise ValueError(
+                f"tilt component {i} is 0 but the channel's is {p}: the "
+                "proposal must cover the target's support (outcomes the "
+                "physical channel produces would never be drawn, biasing "
+                "the estimate low) — use rare.tilt_channel to scale the "
+                "channel, or give every p>0 component a q>0")
+    return tilt
+
+
+def weight_moments(fail, w):
+    """(count, s1, s2) of one weighted batch: the raw failure count plus
+    the first two failure-weight moments ``Σ w·I`` / ``Σ w²·I`` — the
+    per-batch unit every weighted engine folds into its carry."""
+    import jax.numpy as jnp
+
+    fail_f = fail.astype(jnp.float32)
+    wf = w * fail_f
+    return (fail.astype(jnp.int32).sum(dtype=jnp.int32),
+            wf.sum(dtype=jnp.float32), (wf * w).sum(dtype=jnp.float32))
+
+
+@dataclasses.dataclass
+class WeightedStats:
+    """First/second weight moments of an importance-sampled failure stream.
+
+    The device carry accumulates, per cell, ``s1 = Σ wᵢ·Iᵢ`` and
+    ``s2 = Σ wᵢ²·Iᵢ`` over the failure indicators plus the full-stream
+    moments ``w1 = Σ wᵢ`` / ``w2 = Σ wᵢ²`` and the RAW failure count; this
+    dataclass is their host-side home.  The unbiased estimator of the
+    physical failure rate is ``rate = s1 / shots`` (weights are exact
+    channel likelihood ratios, so no self-normalization bias), its variance
+    estimate ``(s2/shots - rate²)/shots``, and the uniform-weight limit
+    (``wᵢ ≡ 1``) collapses every field onto the direct Monte-Carlo
+    counts — the bit-exactness anchor the engines' zero-tilt tests pin."""
+
+    failures: int
+    shots: int
+    s1: float
+    s2: float
+    w1: float
+    w2: float
+    min_w: int | None = None
+
+    @classmethod
+    def from_carry(cls, carry, shots: int) -> "WeightedStats":
+        """Host WeightedStats from a fetched weighted device carry
+        ``(count, min_w, s1, s2, w1, w2[, tele])``."""
+        return cls(failures=int(carry[0]), shots=int(shots),
+                   s1=float(carry[2]), s2=float(carry[3]),
+                   w1=float(carry[4]), w2=float(carry[5]),
+                   min_w=int(carry[1]))
+
+    def merge(self, other: "WeightedStats") -> "WeightedStats":
+        """Fold two disjoint weighted streams (moments add; counts add)."""
+        mins = [m for m in (self.min_w, other.min_w) if m is not None]
+        return WeightedStats(
+            failures=self.failures + other.failures,
+            shots=self.shots + other.shots,
+            s1=self.s1 + other.s1, s2=self.s2 + other.s2,
+            w1=self.w1 + other.w1, w2=self.w2 + other.w2,
+            min_w=min(mins) if mins else None)
+
+    @property
+    def rate(self) -> float:
+        return self.s1 / self.shots if self.shots else 0.0
+
+    @property
+    def variance(self) -> float:
+        """Variance estimate of ``rate`` (population form of the sample
+        variance of the per-shot ``w·I`` terms, over ``shots``)."""
+        if not self.shots:
+            return 0.0
+        r = self.rate
+        return max(self.s2 / self.shots - r * r, 0.0) / self.shots
+
+    @property
+    def rse(self) -> float | None:
+        r = self.rate
+        return math.sqrt(self.variance) / r if r > 0 else None
+
+    @property
+    def ess(self) -> float:
+        from ..utils.diagnostics import effective_sample_size
+
+        return effective_sample_size(self.w1, self.w2)
+
+    @property
+    def log_weight_sum(self) -> float | None:
+        """``log Σ wᵢ`` — the v3 ``wer_run`` diagnostic field.  Exactly
+        ``log(shots)`` in the uniform-weight limit; None when the stream
+        carries no weight (nothing ran)."""
+        return math.log(self.w1) if self.w1 > 0 else None
+
+    def ci_fields(self, z: float | None = None) -> dict:
+        """The ESS-aware uncertainty block (utils.diagnostics
+        ``weighted_ci_fields``) of this stream."""
+        from ..utils import diagnostics
+
+        kw = {} if z is None else {"z": z}
+        return diagnostics.weighted_ci_fields(
+            self.failures, self.s1, self.s2, self.w1, self.w2, self.shots,
+            **kw)
+
+    def event_fields(self, tilt=None) -> dict:
+        """The weighted ``wer_run`` schema-v3 fields."""
+        out = {"log_weight_sum": self.log_weight_sum, "ess": self.ess}
+        if tilt is not None:
+            out["tilt"] = float(tilt)
+        return out
+
+
+def wer_single_shot_weighted(stats: WeightedStats, K: int):
+    """Weighted twin of ``wer_single_shot``: the same ``1-(1-P_L)^(1/K)``
+    transform on the unbiased importance-sampled rate, with the error bar
+    propagated through the reference's exact expression — the binomial
+    standard error replaced by the weighted estimator's ``sqrt(variance)``.
+    Uniform weights reproduce ``wer_single_shot`` to float precision."""
+    logical_error_rate = stats.rate
+    logical_error_rate_eb = math.sqrt(stats.variance)
+    word_error_rate = 1.0 - (1 - logical_error_rate) ** (1 / K)
+    word_error_rate_eb = (
+        logical_error_rate_eb * ((1 - logical_error_rate_eb) ** (1 / K - 1))
+        / K)
+    return word_error_rate, word_error_rate_eb
+
+
+def wer_per_cycle_weighted(stats: WeightedStats, K: int, num_cycles: int):
+    """Weighted twin of ``wer_per_cycle``: identical two-branch inversion
+    on the weighted rate; the error bar replaces the binomial per-cycle se
+    with the weighted variance pushed through the same cycle inversion."""
+    logical_error_rate = stats.rate
+    per_qubit = 1.0 - (1 - logical_error_rate) ** (1 / K)
+    if per_qubit <= 0.5:
+        wer = (1.0 - (1 - 2 * per_qubit) ** (1 / num_cycles)) / 2
+    else:
+        wer = (1.0 + (-1 + 2 * per_qubit) ** (1 / num_cycles)) / 2
+    per_cycle = (1.0 - max(1 - 2 * logical_error_rate, 0.0)
+                 ** (1 / num_cycles)) / 2
+    # binomial se at the per-cycle rate scaled by the weighted-vs-binomial
+    # variance ratio of the TOTAL rate (uniform weights: ratio 1, exactly
+    # the reference propagation)
+    var_binom = max((1 - logical_error_rate) * logical_error_rate, 0.0) \
+        / max(stats.shots, 1)
+    scale = math.sqrt(stats.variance / var_binom) if var_binom > 0 else 1.0
+    per_cycle_eb = math.sqrt(
+        max((1 - per_cycle) * per_cycle, 0.0) / max(stats.shots, 1)) * scale
+    wer_eb = per_cycle_eb * ((1 - per_cycle_eb) ** (1 / K - 1)) / K
+    return wer, wer_eb
+
+
+def resumable_weighted_stream(driver, key, n_batches, extra, *, signature,
+                              progress, tele_on):
+    """Weighted twin of ``resumable_stream`` for the importance-sampled
+    megabatch engines: carry layout ``(count, min_w, s1, s2, w1, w2[,
+    tele])`` with the float32 weight moments persisted (exactly, as floats)
+    in the v2 cursor's ``weighted`` block.  Same fingerprint and key-stream
+    rules, so a killed weighted run resumes seed-for-seed (a fresh stream's
+    min-weight track is seeded by the driver's own init carry)."""
+    import jax.numpy as jnp
+
+    from ..utils import telemetry
+
+    start, carry0 = 0, None
+    state = progress.load(signature) if progress is not None else None
+    if state:
+        start = int(state["batches_done"])
+        wm = state.get("weighted") or {}
+        carry0 = [jnp.asarray(state["failures"], jnp.int32),
+                  jnp.asarray(state["min_w"], jnp.int32),
+                  jnp.asarray(wm.get("s1", 0.0), jnp.float32),
+                  jnp.asarray(wm.get("s2", 0.0), jnp.float32),
+                  jnp.asarray(wm.get("w1", 0.0), jnp.float32),
+                  jnp.asarray(wm.get("w2", 0.0), jnp.float32)]
+        if tele_on:
+            carry0.append(jnp.asarray(
+                state.get("tele") or [0] * telemetry.TELE_LEN, jnp.int32))
+        carry0 = tuple(carry0)
+
+    def stream():
+        for carry, done in driver.run_keys(key, n_batches, *extra,
+                                           start=start, carry0=carry0):
+            if progress is not None:
+                progress.save(
+                    signature, batches_done=done, failures=int(carry[0]),
+                    min_w=int(carry[1]),
+                    tele=(carry[6] if len(carry) > 6 else None),
+                    extra={"weighted": {
+                        "s1": float(carry[2]), "s2": float(carry[3]),
+                        "w1": float(carry[4]), "w2": float(carry[5])}})
+            yield carry, done
+
+    return (carry0, start), stream()
+
+
+def drive_weighted_run(driver, key, n_batches, extra, *, batch_size,
+                       total, carry0, start, stream, target_rse,
+                       progress, fetch=None):
+    """Shared drive loop of the weighted megabatch engines (the tail of
+    ``resumable_weighted_stream``): fixed budget = one whole-device fold +
+    ONE host sync; with ``progress`` or ``target_rse`` the per-megabatch
+    stream runs instead, early-stopping once the weighted estimator's
+    relative standard error reaches the target (``total`` is the requested
+    shot count — a stop before it counts as a driver early-stop).
+    ``fetch`` wraps the fixed-budget device fetch (engines pass their
+    guarded fetch); returns the HOST carry + batches done."""
+    import jax
+
+    from ..utils import telemetry
+
+    if progress is None and target_rse is None:
+        carry, done = driver.run(key, n_batches, *extra, start=start,
+                                 carry0=carry0)
+        get = (lambda: jax.device_get(carry)) if fetch is None \
+            else (lambda: fetch(lambda: jax.device_get(carry)))
+        return timed_host_sync(get), done
+
+    def _rse_hit(c, shots):
+        if target_rse is None or not shots:
+            return False
+        rse = WeightedStats.from_carry(c, shots).rse
+        return rse is not None and rse <= float(target_rse)
+
+    carry, done = carry0, start
+    if carry is None or not _rse_hit(carry, start * batch_size):
+        for carry, done in stream:
+            if _rse_hit(carry, done * batch_size):
+                if done * batch_size < total:
+                    telemetry.count("driver.early_stops")
+                break
+    else:
+        telemetry.count("driver.early_stops")
+    return carry, done
 
 
 @dataclasses.dataclass

@@ -28,11 +28,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..decoders.bp_decoders import decode_device
-from ..noise import depolarizing_xz, depolarizing_xz_packed
+from ..noise import (
+    depolarizing_xz,
+    depolarizing_xz_packed,
+    depolarizing_xz_tilted,
+    depolarizing_xz_tilted_packed,
+)
 from ..ops.linalg import ParityOp, gf2_matmul, parity_apply
 from ..ops.gf2_packed import (
     pack_shots,
     packed_parity_apply,
+    packed_residual_flags,
     packed_residual_stats,
     unpack_shots,
 )
@@ -41,16 +47,22 @@ from ..parallel.shots import MegabatchDriver, count_min_driver
 from ..utils import telemetry
 from .common import (
     apply_worker_batch_fence,
+    check_tilt_probs,
+    drive_weighted_run,
     engine_ladder_step,
     fence_batch_value,
     ShotBatcher,
+    WeightedStats,
     mesh_batch_stats,
     record_wer_run,
     resilient_engine_run,
     resumable_stream,
+    resumable_weighted_stream,
     run_signature,
     timed_host_sync,
+    weight_moments,
     wer_single_shot,
+    wer_single_shot_weighted,
     windowed_count,
 )
 
@@ -252,6 +264,84 @@ def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
         lambda key, state: _stats_one_batch(cfg, state, key),
         min_init=cfg[1],
         tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
+
+
+# ---------------------------------------------------------------------------
+# Weighted (importance-sampled) pipeline — the rare-event subsystem's data
+# engine unit (qldpc_fault_tolerance_tpu.rare).  Same syndrome/decode/check
+# pipeline as the direct path with the sampler swapped for the TILTED channel
+# and the per-shot log-weight carried as an extra plane into the weight
+# moments; at zero tilt (tilt == channel probs) the draws, flags and counts
+# are bit-identical to the direct engines.
+# ---------------------------------------------------------------------------
+def _weighted_flags_one_batch(cfg, state, key):
+    """One tilted batch -> per-shot failure flags + weights: ``(x_fail,
+    z_fail, min_w, w)`` with the flags (B,) uint8/bool and ``w = exp(logw)``
+    (B,) float32.  Packed or dense per cfg[5]; the tilt probabilities ride
+    in ``state["tilt"]``."""
+    batch_size, n = cfg[0], cfg[1]
+    if cfg[5]:
+        ex_p, ez_p, logw = depolarizing_xz_tilted_packed(
+            key, (batch_size, n), state["probs"], state["tilt"])
+        synd_z = unpack_shots(packed_parity_apply(
+            state["hx_par"][0], state["hx_par"][1], ez_p), batch_size)
+        synd_x = unpack_shots(packed_parity_apply(
+            state["hz_par"][0], state["hz_par"][1], ex_p), batch_size)
+        cor_z, aux_z = decode_device(cfg[4], state["dz"], synd_z)
+        cor_x, aux_x = decode_device(cfg[3], state["dx"], synd_x)
+        x_fail, z_fail, mw = packed_residual_flags(
+            ex_p ^ pack_shots(cor_x), ez_p ^ pack_shots(cor_z),
+            state["hz_par"], state["hx_par"],
+            state["lz_t"], state["lx_t"], batch_size, n)
+    else:
+        ex, ez, logw = depolarizing_xz_tilted(
+            key, (batch_size, n), state["probs"], state["tilt"])
+        synd_z = _parity(state["hx_par"], ez)
+        synd_x = _parity(state["hz_par"], ex)
+        cor_z, aux_z = decode_device(cfg[4], state["dz"], synd_z)
+        cor_x, aux_x = decode_device(cfg[3], state["dx"], synd_x)
+        x_fail, z_fail, mw = _check_flags(cfg, state, ex, ez, cor_x, cor_z)
+    return x_fail, z_fail, mw, jnp.exp(logw), aux_x, aux_z
+
+
+# single implementation of the per-batch weighted moment fold (common owns
+# it; phenom folds through the same one)
+_weight_moments = weight_moments
+
+
+def _weighted_stats_one_batch(cfg, state, key):
+    """One tilted batch fully on device -> ``(count, min_w, s1, s2, w1,
+    w2[, tele])`` — the weighted carry unit (parallel.shots
+    count_min_driver ``weighted=True``)."""
+    x_fail, z_fail, mw, w, aux_x, aux_z = _weighted_flags_one_batch(
+        cfg, state, key)
+    eval_type = cfg[2]
+    if eval_type == "X":
+        fail = x_fail
+    elif eval_type == "Z":
+        fail = z_fail
+    else:
+        fail = x_fail.astype(bool) | z_fail.astype(bool)
+    cnt, s1, s2 = _weight_moments(fail, w)
+    w1 = w.sum(dtype=jnp.float32)
+    w2 = (w * w).sum(dtype=jnp.float32)
+    out = (cnt, mw, s1, s2, w1, w2)
+    if _tele_on(cfg):
+        out += (telemetry.device_tele_vec(
+            [(cfg[3], aux_x), (cfg[4], aux_z)]),)
+    return out
+
+
+def _weighted_driver(cfg, k_inner: int):
+    """Memoized weighted megabatch driver for the data engine (tag
+    ``data-w`` keeps it apart from the direct fold's cache entries)."""
+    from ..parallel.shots import count_min_driver as _cmd
+
+    return _cmd("data-w", cfg, k_inner,
+                lambda key, state: _weighted_stats_one_batch(
+                    cfg, state, key),
+                min_init=cfg[1], weighted=True,
+                tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
 
 
 # ---------------------------------------------------------------------------
@@ -511,6 +601,143 @@ def fused_cells_program(sims, num_samples: int, mesh=None):
         num_samples, mesh=mesh)
 
 
+# ---------------------------------------------------------------------------
+# Weighted cell-fused execution: every p rung of a rare-event grid in ONE
+# device program, with per-cell tilts and the weighted carry planes
+# (rare/sweep.py drives these through CellFusedDriver(weighted=True))
+# ---------------------------------------------------------------------------
+def _weighted_all_one_batch(cfg, state, key):
+    """Per-cell unit of the weighted fused sweep: one tilted batch ->
+    ``((x, z, total) counts, min_w, (x, z, total) s1, (x, z, total) s2,
+    w1, w2[, tele])``.  Only the failure-dependent moments carry the
+    logical-type axis; the full-stream moments w1/w2 are type-free."""
+    x_fail, z_fail, mw, w, aux_x, aux_z = _weighted_flags_one_batch(
+        cfg, state, key)
+    t_fail = x_fail.astype(bool) | z_fail.astype(bool)
+    cx, s1x, s2x = _weight_moments(x_fail, w)
+    cz, s1z, s2z = _weight_moments(z_fail, w)
+    ct, s1t, s2t = _weight_moments(t_fail, w)
+    out = (jnp.stack([cx, cz, ct]), mw,
+           jnp.stack([s1x, s1z, s1t]), jnp.stack([s2x, s2z, s2t]),
+           w.sum(dtype=jnp.float32), (w * w).sum(dtype=jnp.float32))
+    if _tele_on(cfg):
+        out += (telemetry.device_tele_vec(
+            [(cfg[3], aux_x), (cfg[4], aux_z)]),)
+    return out
+
+
+def _weighted_cells_stats_fn(cfg, treedef, axes_flat):
+    """Per-lane weighted stats closure for CellFusedDriver(weighted=True):
+    gather each lane's cell state (tilt plane included), run the weighted
+    per-cell unit under vmap, select each lane's count/moments by its
+    cell's traced logical-type code."""
+    from .common import gather_lane_states
+
+    tele_on = _tele_on(cfg)
+
+    def stats(keys, lane_cell, active, stacked, ltypes):
+        lane_states, in_axes = gather_lane_states(
+            stacked, treedef, axes_flat, lane_cell)
+        out = jax.vmap(
+            lambda st, k: _weighted_all_one_batch(cfg, st, k),
+            in_axes=(in_axes, 0))(lane_states, keys)
+        cnt3, mw, s1_3, s2_3, w1, w2 = out[:6]
+        lt = ltypes[lane_cell][:, None]
+        res = (jnp.take_along_axis(cnt3, lt, axis=1)[:, 0], mw,
+               jnp.take_along_axis(s1_3, lt, axis=1)[:, 0],
+               jnp.take_along_axis(s2_3, lt, axis=1)[:, 0], w1, w2)
+        if tele_on:
+            res += (jnp.where(active[:, None], out[6], 0)
+                    .sum(axis=0, dtype=jnp.int32),)
+        return res
+
+    return stats
+
+
+def weighted_cells_program(sims, tilts, num_samples: int, mesh=None):
+    """Build a weighted FusedCellProgram: one cell per (p, tilt) rung of a
+    rare-event grid, sharing one compiled device program with per-cell
+    channel probs, decoder priors AND tilt planes stacked on the cell axis.
+    ``tilts``: per-cell (3,) tilt probability triples (``rare.tilt``
+    helpers build them); a cell whose tilt equals its channel probs runs
+    the zero-tilt configuration, bit-exact with the direct engines.
+    The key/batch layout reproduces each cell's own
+    ``WeightedWordErrorRate`` exactly, so per-cell moments are seed-for-
+    seed identical to the serial weighted runs."""
+    from ..parallel.shots import cell_fused_driver
+    from .common import (
+        LTYPE_CODES,
+        FusedCellProgram,
+        key_bytes as _key_bytes,
+        stack_cell_states,
+    )
+
+    rep = sims[0]
+    _check_rep_fusable(rep)
+    tele_on = telemetry.enabled()
+    cfg = (rep.batch_size, rep.N, "CELLS",
+           rep.decoder_x.device_static, rep.decoder_z.device_static,
+           rep._packed, False, tele_on)
+    for s in sims[1:]:
+        other = (s.batch_size, s.N, "CELLS",
+                 s.decoder_x.device_static, s.decoder_z.device_static,
+                 s._packed, False, tele_on)
+        if other != cfg or s._needs_host or s._fused_sampler:
+            raise ValueError(
+                "cells differ in program structure (batch size, code shape "
+                "or decoder statics); split them into separate buckets")
+        if s.K != rep.K or not np.array_equal(_key_bytes(s._base_key),
+                                              _key_bytes(rep._base_key)):
+            raise ValueError(
+                "cells of one fused bucket must share a seed and K")
+    tilts = [check_tilt_probs(t, s.channel_probs)
+             for s, t in zip(sims, tilts)]
+    cell_states = [
+        dict(s._dev_state, tilt=jnp.asarray(t, jnp.float32))
+        for s, t in zip(sims, tilts)]
+    stacked, treedef, axes_flat = stack_cell_states(cell_states)
+    ltypes = jnp.asarray(
+        [LTYPE_CODES[s.eval_logical_type] for s in sims], jnp.int32)
+    _, key = jax.random.split(rep._base_key)
+    n_dev = 1 if mesh is None else mesh.devices.size
+    batcher = ShotBatcher(num_samples, rep.batch_size * n_dev)
+    chunk = min(batcher.num_batches, rep._scan_chunk)
+    n_batches = -(-batcher.num_batches // chunk) * chunk
+    driver = cell_fused_driver(
+        "data-w", cfg, len(ltypes), chunk,
+        _weighted_cells_stats_fn(cfg, treedef, axes_flat),
+        min_init=rep.N, batch_size=rep.batch_size,
+        tele_len=telemetry.TELE_LEN if tele_on else 0,
+        mesh=mesh, state_key=axes_flat, weighted=True)
+    cell_tags = [
+        [float(np.asarray(p)) for p in s.channel_probs]
+        + [float(np.asarray(t_i)) for t_i in t]
+        for s, t in zip(sims, tilts)]
+    # fingerprints round-trip through JSON (tuples would come back lists,
+    # silently failing the resume match), so cells stay list-of-lists
+    signature_fn = lambda: run_signature(  # noqa: E731
+        "data-cells-w", key, batch_size=rep.batch_size, chunk=chunk,
+        n_batches=n_batches, cells=[list(t) for t in cell_tags],
+        ltypes=[int(x) for x in np.asarray(ltypes)])
+
+    def _wer_fn_guard(failures, shots):
+        # raw tilted-draw failure counts have no WER meaning: a weighted
+        # program must be driven through rare.sweep (weighted_cell_stream /
+        # eval_weighted_cells), which folds the importance-weight moments —
+        # not the direct grid loop, which would read counts as rates
+        raise ValueError(
+            "weighted fused-cell program routed into a direct WER drive; "
+            "use rare.sweep.eval_weighted_cells / weighted_cell_stream")
+
+    return FusedCellProgram(
+        driver=driver, key=key, extras=(stacked, ltypes),
+        n_batches=n_batches, chunk=chunk, batch_size=rep.batch_size,
+        n_cells=len(ltypes), engine="data",
+        wer_fn=_wer_fn_guard,
+        signature_fn=signature_fn, cell_tags=tuple(map(tuple, cell_tags)),
+        weighted=True)
+
+
 class CodeSimulator_DataError:
     """Same constructor/WordErrorRate surface as the reference class, batched.
 
@@ -523,6 +750,8 @@ class CodeSimulator_DataError:
     # cell) into one cell-axis device program (module fns above)
     fused_cells_program = staticmethod(fused_cells_program)
     fused_cells_program_states = staticmethod(fused_cells_program_states)
+    # weighted (importance-sampled) fused entry for the rare-event sweep
+    weighted_cells_program = staticmethod(weighted_cells_program)
 
     def __init__(self, code=None, decoder_x=None, decoder_z=None,
                  pauli_error_probs=(0.01, 0.01, 0.01), eval_logical_type="Total",
@@ -795,6 +1024,94 @@ class CodeSimulator_DataError:
 
         return resilient_engine_run(self, run, site="wer.data",
                                     degrade=self._degrade_once)
+
+    def WeightedWordErrorRate(self, num_run: int, tilt_probs=None, key=None,
+                              progress=None, target_rse=None):
+        """Importance-sampled WER over ``num_run`` shots drawn from the
+        TILTED channel ``tilt_probs`` (a ``[qx, qy, qz]`` triple, usually
+        from ``rare.tilt.tilt_channel``) — the rare-event estimator for
+        WER points direct Monte-Carlo cannot reach (a 1e-10 WER needs
+        ~1e12 direct shots; a well-tilted run resolves it in ~1e6).
+
+        Per-shot log importance weights ride the device pipeline as an
+        extra plane and fold into the weight moments ``(Σw·I, Σw²·I, Σw,
+        Σw²)`` on device, so the run keeps the engines' one-sync-per-
+        megabatch discipline.  ``tilt_probs=None`` (or equal to the channel
+        probs) is the ZERO-TILT configuration: draws, failure counts and
+        min-weight are bit-identical to ``WordErrorRate`` seed-for-seed,
+        and the estimate collapses onto the direct one.
+
+        ``progress``: utils.checkpoint.CellProgress — the cursor persists
+        the weight moments alongside the counts (v2 ``weighted`` block), so
+        a killed weighted stream resumes seed-for-seed.  ``target_rse``:
+        adaptive early stop once the weighted estimator's relative
+        standard error reaches the target (megabatch granularity, like
+        ``target_failures`` on the direct path).
+
+        Returns ``(wer, wer_eb)`` (the reference transform applied to the
+        unbiased weighted rate); the full ``WeightedStats`` lands on
+        ``self.last_weighted`` for ESS / variance consumers."""
+        apply_worker_batch_fence(self)
+        if self._needs_host or self._mesh is not None:
+            raise ValueError(
+                "weighted estimation requires the pure-device single-chip "
+                "path (no host-postprocess decoders, no mesh)")
+        if self._fused_sampler:
+            raise ValueError(
+                "the opt-in fused sampler has its own PRNG stream; weighted "
+                "estimation covers the seed-comparable packed/dense paths")
+        if tilt_probs is None:
+            tilt_probs = list(self.channel_probs)
+        tilt_probs = check_tilt_probs(tilt_probs, self.channel_probs)
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+
+        def run():
+            with telemetry.span("wer.data_w"):
+                return self._weighted_word_error_rate(
+                    num_run, tilt_probs, key, progress, target_rse)
+
+        return resilient_engine_run(self, run, site="wer.data_w",
+                                    degrade=self._degrade_once)
+
+    def _weighted_word_error_rate(self, num_run, tilt_probs, key, progress,
+                                  target_rse):
+        batcher = ShotBatcher(num_run, self.batch_size)
+        chunk = min(batcher.num_batches, self._scan_chunk)
+        n_batches = -(-batcher.num_batches // chunk) * chunk
+        tele_on = telemetry.enabled()
+        cfg = self._cfg(self.batch_size, tele=tele_on)
+        driver = _weighted_driver(cfg, chunk)
+        state = dict(self._dev_state,
+                     tilt=jnp.asarray(tilt_probs, jnp.float32))
+        before = driver.dispatches
+        fp = run_signature(
+            "data-w", key, batch_size=self.batch_size, chunk=chunk,
+            n_batches=n_batches, tilt=[round(q, 12) for q in tilt_probs])
+        (carry0, start), stream = resumable_weighted_stream(
+            driver, key, n_batches, (state,), signature=fp,
+            progress=progress, tele_on=tele_on)
+        carry, done = drive_weighted_run(
+            driver, key, n_batches, (state,), batch_size=self.batch_size,
+            total=batcher.total, carry0=carry0, start=start, stream=stream,
+            target_rse=target_rse, progress=progress)
+        self.last_dispatches = driver.dispatches - before
+        shots = done * self.batch_size
+        ws = WeightedStats.from_carry(carry, shots)
+        self.min_logical_weight = min(self.min_logical_weight, ws.min_w)
+        if len(carry) > 6:
+            telemetry.publish_device_tele(carry[6])
+        self.last_weighted = ws
+        wer = wer_single_shot_weighted(ws, self.K)
+        from .common import joint_kernel_variant
+
+        record_wer_run("data", ws.failures, shots, wer[0],
+                       dispatches=self.last_dispatches,
+                       kernel_variant=joint_kernel_variant(
+                           self.decoder_x, self.decoder_z,
+                           batch_size=self.batch_size),
+                       weighted=ws, tilt=float(sum(tilt_probs)))
+        return wer
 
     def _wer_result(self, failures: int, shots: int):
         """WER + telemetry bookkeeping shared by every WordErrorRate path."""

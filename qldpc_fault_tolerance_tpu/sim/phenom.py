@@ -28,11 +28,17 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..decoders.bp_decoders import decode_device
-from ..noise import bit_flips, depolarizing_xz
+from ..noise import (
+    bit_flips,
+    bit_flips_tilted,
+    depolarizing_xz,
+    depolarizing_xz_tilted,
+)
 from ..ops.linalg import ParityOp, gf2_matmul
 from ..ops.gf2_packed import (
     pack_shots,
     packed_parity_apply,
+    packed_residual_flags,
     packed_residual_stats,
     unpack_shots,
 )
@@ -40,16 +46,21 @@ from ..parallel.shots import MegabatchDriver, count_min_driver
 from ..utils import resilience, telemetry
 from .common import (
     apply_worker_batch_fence,
+    check_tilt_probs,
+    drive_weighted_run,
     engine_ladder_step,
     fence_batch_value,
     ShotBatcher,
+    WeightedStats,
     mesh_batch_stats,
     record_wer_run,
     resilient_engine_run,
     resumable_stream,
+    resumable_weighted_stream,
     run_signature,
     timed_host_sync,
     wer_per_cycle,
+    wer_per_cycle_weighted,
     wer_single_shot,
     windowed_count,
 )
@@ -246,6 +257,109 @@ def _stats_driver(cfg, k_inner: int) -> MegabatchDriver:
             cfg, state, key, num_rounds),
         min_init=cfg[1],
         tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
+
+
+# ---------------------------------------------------------------------------
+# Weighted (importance-sampled) pipeline — the rare-event subsystem's phenom
+# engine unit.  Every round's data depolarizing channel and syndrome bit
+# flips draw from tilted rates (``state["tilt"]`` / ``state["tilt_q"]``) and
+# the per-shot log weight accumulates through the round scan as an extra
+# carry plane; zero tilt reproduces the direct engine's draws bit for bit.
+# ---------------------------------------------------------------------------
+def _sample_ext_tilted(cfg, state, key, batch_size):
+    """Tilted twin of ``_sample_ext``: same key splits and binning, tilted
+    thresholds; returns ``(ex_ext, ez_ext, logw)``."""
+    n = cfg[1]
+    mx = state["hx_ext_t"].shape[0] - n
+    mz = state["hz_ext_t"].shape[0] - n
+    kd, kx, kz = jax.random.split(key, 3)
+    ex, ez, lw_d = depolarizing_xz_tilted(
+        kd, (batch_size, n), state["probs"], state["tilt"])
+    sx, lw_sx = bit_flips_tilted(kx, (batch_size, mz), state["q"],
+                                 state["tilt_q"])
+    sz, lw_sz = bit_flips_tilted(kz, (batch_size, mx), state["q"],
+                                 state["tilt_q"])
+    ex_ext = jnp.concatenate([ex, sx], axis=1)
+    ez_ext = jnp.concatenate([ez, sz], axis=1)
+    return ex_ext, ez_ext, lw_d + lw_sx + lw_sz
+
+
+def _weighted_flags_one_batch(cfg, state, key, num_rounds):
+    """One tilted phenom batch -> per-shot failure flags + weights
+    ``(x_fail, z_fail, min_w, w, aux_x, aux_z)``.  Round structure, key
+    splits and decode order match ``_stats_one_batch`` exactly; only the
+    samplers are tilted and the log weight rides the round carry."""
+    batch_size, n = cfg[0], cfg[1]
+    k_rounds, k_final = jax.random.split(key)
+    init = (jnp.zeros((batch_size, n), jnp.uint8),
+            jnp.zeros((batch_size, n), jnp.uint8),
+            jnp.zeros((batch_size,), jnp.float32))
+
+    def body(i, carry):
+        data_x, data_z, logw = carry
+        ex_ext, ez_ext, lw = _sample_ext_tilted(
+            cfg, state, jax.random.fold_in(k_rounds, i), batch_size)
+        cur_x = ex_ext.at[:, :n].set(ex_ext[:, :n] ^ data_x)
+        cur_z = ez_ext.at[:, :n].set(ez_ext[:, :n] ^ data_z)
+        synd_x, synd_z = _ext_syndromes(cfg, state, cur_x, cur_z)
+        dz, _ = decode_device(cfg[4], state["d1z"], synd_z)
+        dx, _ = decode_device(cfg[3], state["d1x"], synd_x)
+        cur_x = cur_x ^ dx
+        cur_z = cur_z ^ dz
+        return cur_x[:, :n], cur_z[:, :n], logw + lw
+
+    data_x, data_z, logw = jax.lax.fori_loop(
+        0, jnp.maximum(num_rounds - 1, 0), body, init)
+    ex_ext, ez_ext, lw_f = _sample_ext_tilted(cfg, state, k_final,
+                                              batch_size)
+    cur_x = data_x ^ ex_ext[:, :n]
+    cur_z = data_z ^ ez_ext[:, :n]
+    synd_x, synd_z = _bare_syndromes(cfg, state, cur_x, cur_z)
+    dz, az = decode_device(cfg[6], state["d2z"], synd_z)
+    dx, ax = decode_device(cfg[5], state["d2x"], synd_x)
+    logw = logw + lw_f
+    if cfg[7]:
+        x_fail, z_fail, mw = packed_residual_flags(
+            pack_shots(cur_x ^ dx), pack_shots(cur_z ^ dz),
+            state["hz_par"], state["hx_par"],
+            state["lz_t"], state["lx_t"], batch_size, n,
+            z_weight_excludes_stab=True)
+    else:
+        x_fail, z_fail, mw = _check_flags(cfg, state, cur_x, cur_z, dx, dz)
+    return x_fail, z_fail, mw, jnp.exp(logw), ax, az
+
+
+def _weighted_stats_one_batch(cfg, state, key, num_rounds):
+    """One tilted phenom batch -> the weighted carry unit
+    ``(count, min_w, s1, s2, w1, w2[, tele])``."""
+    from .common import weight_moments as _weight_moments
+
+    x_fail, z_fail, mw, w, ax, az = _weighted_flags_one_batch(
+        cfg, state, key, num_rounds)
+    eval_type = cfg[2]
+    if eval_type == "X":
+        fail = x_fail
+    elif eval_type == "Z":
+        fail = z_fail
+    else:
+        fail = x_fail.astype(bool) | z_fail.astype(bool)
+    cnt, s1, s2 = _weight_moments(fail, w)
+    out = (cnt, mw, s1, s2, w.sum(dtype=jnp.float32),
+           (w * w).sum(dtype=jnp.float32))
+    if _tele_on(cfg):
+        out += (telemetry.device_tele_vec([(cfg[5], ax), (cfg[6], az)]),)
+    return out
+
+
+def _weighted_driver(cfg, k_inner: int):
+    """Memoized weighted phenom megabatch driver (tag ``phenl-w``)."""
+    from ..parallel.shots import count_min_driver as _cmd
+
+    return _cmd("phenl-w", cfg, k_inner,
+                lambda key, state, num_rounds: _weighted_stats_one_batch(
+                    cfg, state, key, num_rounds),
+                min_init=cfg[1], weighted=True,
+                tele_len=telemetry.TELE_LEN if _tele_on(cfg) else 0)
 
 
 # ---------------------------------------------------------------------------
@@ -784,6 +898,96 @@ class CodeSimulator_Phenon:
             wer = wer_per_cycle(count, total, self.K, num_rounds)
             self._record_run(count, total, wer[0])
         return wer
+
+    def WeightedWordErrorRate(self, num_rounds: int, num_samples: int,
+                              tilt_probs=None, tilt_q=None, key=None,
+                              progress=None, target_rse=None):
+        """Importance-sampled per-qubit-per-cycle WER: every round's data
+        depolarizing channel draws from ``tilt_probs`` and the syndrome
+        bit flips from ``tilt_q``, with the per-shot log weight accumulated
+        through the round scan and the weight moments folded on device
+        (see sim/data_error.WeightedWordErrorRate for the shared
+        contract — zero tilt is bit-exact with ``WordErrorRate``
+        seed-for-seed, cursors resume through the v2 ``weighted`` block,
+        ``target_rse`` early-stops at megabatch granularity).  Returns
+        ``(wer, wer_eb)`` via the reference cycle inversion on the weighted
+        rate; the full WeightedStats lands on ``self.last_weighted``."""
+        apply_worker_batch_fence(self)
+        dec2_host = (self.decoder2_x.needs_host_postprocess
+                     or self.decoder2_z.needs_host_postprocess)
+        if not self._dec1_on_device or dec2_host or self._mesh is not None:
+            raise ValueError(
+                "weighted estimation requires the pure-device single-chip "
+                "path (no host-postprocess decoders, no mesh)")
+        if tilt_probs is None:
+            tilt_probs = list(self.channel_probs)
+        tilt_probs = check_tilt_probs(tilt_probs, self.channel_probs)
+        tilt_q = float(self.synd_prob if tilt_q is None else tilt_q)
+        if not 0.0 <= tilt_q < 1.0 or (float(self.synd_prob) > 0
+                                       and tilt_q == 0):
+            raise ValueError(
+                f"tilt_q must be a probability covering the syndrome "
+                f"channel's support (synd_prob={float(self.synd_prob)}), "
+                f"got {tilt_q}")
+        if key is None:
+            self._base_key, key = jax.random.split(self._base_key)
+        from ..utils import profiling
+
+        with profiling.engine_scope("wer.phenl_w"):
+            with telemetry.span("wer.phenl_w"):
+                ws = resilience.run_cell(
+                    lambda: self._weighted_count(
+                        num_rounds, num_samples, tilt_probs, tilt_q, key,
+                        progress, target_rse),
+                    label="wer.phenl_w", degrade=self._degrade_once)
+            wer = wer_per_cycle_weighted(ws, self.K, num_rounds)
+            from .common import joint_kernel_variant
+
+            record_wer_run("phenl", ws.failures, ws.shots, wer[0],
+                           dispatches=self.last_dispatches,
+                           kernel_variant=joint_kernel_variant(
+                               self.decoder1_x, self.decoder1_z,
+                               self.decoder2_x, self.decoder2_z,
+                               batch_size=self.batch_size),
+                           weighted=ws,
+                           tilt=float(sum(tilt_probs)))
+        return wer
+
+    def _weighted_count(self, num_rounds, num_samples, tilt_probs, tilt_q,
+                        key, progress, target_rse) -> WeightedStats:
+        batcher = ShotBatcher(num_samples, self.batch_size)
+        chunk = min(batcher.num_batches, self._scan_chunk)
+        n_batches = -(-batcher.num_batches // chunk) * chunk
+        tele_on = telemetry.enabled()
+        cfg = self._cfg(self.batch_size, tele=tele_on)
+        driver = _weighted_driver(cfg, chunk)
+        state = dict(self._dev_state,
+                     tilt=jnp.asarray(tilt_probs, jnp.float32),
+                     tilt_q=jnp.float32(tilt_q))
+        before = driver.dispatches
+        fp = run_signature(
+            "phenl-w", key, batch_size=self.batch_size, chunk=chunk,
+            n_batches=n_batches, rounds=int(num_rounds),
+            tilt=[round(q, 12) for q in tilt_probs],
+            tilt_q=round(tilt_q, 12))
+        extra = (state, jnp.asarray(num_rounds, jnp.int32))
+        (carry0, start), stream = resumable_weighted_stream(
+            driver, key, n_batches, extra, signature=fp,
+            progress=progress, tele_on=tele_on)
+        carry, done = drive_weighted_run(
+            driver, key, n_batches, extra, batch_size=self.batch_size,
+            total=batcher.total, carry0=carry0, start=start, stream=stream,
+            target_rse=target_rse, progress=progress,
+            fetch=lambda get: resilience.guarded_fetch(
+                get, label="phenl_w_drain"))
+        self.last_dispatches = driver.dispatches - before
+        shots = done * self.batch_size
+        ws = WeightedStats.from_carry(carry, shots)
+        self.min_logical_weight = min(self.min_logical_weight, ws.min_w)
+        if len(carry) > 6:
+            telemetry.publish_device_tele(carry[6])
+        self.last_weighted = ws
+        return ws
 
     def WordErrorProbability(self, num_rounds: int, num_samples: int,
                              key=None, progress=None):

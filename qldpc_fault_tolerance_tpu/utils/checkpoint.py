@@ -201,7 +201,12 @@ class CellProgress:
         return state
 
     def save(self, fingerprint: dict, batches_done: int, failures: int,
-             min_w: int, tele=None) -> None:
+             min_w: int, tele=None, extra: dict | None = None) -> None:
+        """``extra``: additional JSON-safe state merged into the cursor —
+        the weighted (importance-sampled) streams persist their float
+        weight moments here (``{"weighted": {s1, s2, w1, w2}}``); loaders
+        that don't know the keys ignore them, exactly like the additive
+        diagnostics block below."""
         self._saves += 1
         if (self._saves - 1) % self.every:
             return
@@ -212,6 +217,8 @@ class CellProgress:
         }
         if tele is not None:
             state["tele"] = [int(x) for x in tele]
+        if extra:
+            state.update(extra)
         # statistical observability: the cursor carries its Wilson interval
         # (shots reconstructed from the fingerprint's batch layout) so a
         # tail -f of the checkpoint shows estimator health mid-cell; purely
@@ -226,13 +233,15 @@ class CellProgress:
         self.checkpoint.put_progress(self.key, state)
 
     def save_cells(self, fingerprint, batches_done, failures, shots, min_w,
-                   cursors=None, tele=None) -> None:
+                   cursors=None, tele=None, extra: dict | None = None
+                   ) -> None:
         """Vector twin of ``save`` for cell-FUSED runs: one progress record
         carries the whole bucket's per-cell counters.  ``batches_done`` is
         the uniform cursor of the fixed-budget fused stream; adaptive runs
         additionally persist per-cell ``cursors`` (cells advance at
-        different rates once lanes reallocate).  Same ``every`` throttling
-        and fingerprint rules as the scalar record."""
+        different rates once lanes reallocate).  Same ``every`` throttling,
+        fingerprint and ``extra`` rules as the scalar record (weighted
+        fused buckets persist per-cell weight-moment lists there)."""
         self._saves += 1
         if (self._saves - 1) % self.every:
             return
@@ -247,6 +256,8 @@ class CellProgress:
             state["cursors"] = [int(x) for x in cursors]
         if tele is not None:
             state["tele"] = [int(x) for x in tele]
+        if extra:
+            state.update(extra)
         # per-cell Wilson intervals on the fused cursor (counts are right
         # here; additive keys the resume loader ignores)
         from . import diagnostics

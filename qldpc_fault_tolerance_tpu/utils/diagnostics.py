@@ -50,7 +50,10 @@ __all__ = [
     "CI_KEYS",
     "wilson_interval",
     "clopper_pearson_interval",
+    "effective_sample_size",
+    "ess_interval",
     "ci_fields",
+    "weighted_ci_fields",
     "ci_arrays",
     "enabled",
     "enable",
@@ -123,6 +126,74 @@ def clopper_pearson_interval(failures, shots, alpha: float = 0.05):
     lo = 0.0 if f == 0 else float(beta.ppf(alpha / 2.0, f, n - f + 1))
     hi = 1.0 if f >= n else float(beta.ppf(1.0 - alpha / 2.0, f + 1, n - f))
     return lo, hi
+
+
+def effective_sample_size(w1, w2):
+    """Kish effective sample size of a weight stream from its first two
+    moments ``w1 = Σw`` / ``w2 = Σw²``: ``(Σw)² / Σw²``.  Uniform weights
+    give exactly the shot count; a degenerate stream (one dominant weight)
+    collapses toward 1.  Zero-weight streams return 0.0."""
+    w1 = float(w1)
+    w2 = float(w2)
+    return (w1 * w1 / w2) if w2 > 0 else 0.0
+
+
+def ess_interval(s1, s2, shots, z: float = Z_95):
+    """ESS-aware confidence interval for a WEIGHTED failure-rate estimate.
+
+    The unbiased importance-sampling estimator is ``p̂ = s1 / shots`` with
+    ``s1 = Σ wᵢ·Iᵢ`` and ``s2 = Σ wᵢ²·Iᵢ`` (failure-term weight moments).
+    Wilson / Clopper-Pearson assume INTEGER binomial counts; treating
+    summed weights as shot counts misstates the interval whenever weights
+    are non-uniform.  The honest substitute maps the weighted stream to
+    its effective binomial counts — effective failures ``f_eff = s1²/s2``
+    (the ESS of the failure-weight stream) at the same rate, so effective
+    shots ``n_eff = f_eff / p̂ = shots·s1/s2`` — and takes the Wilson
+    interval of ``(f_eff, n_eff)``.  In the uniform-weight limit
+    (``wᵢ ≡ 1``: ``s1 = s2 = failures``) this IS ``wilson_interval(
+    failures, shots)`` to float precision (pinned to 1e-12 in tier-1).
+    Zero observed failures fall back to Wilson at ``(0, shots)`` — the
+    count carries no weight information to correct by."""
+    s1 = float(s1)
+    s2 = float(s2)
+    shots = float(shots)
+    if shots <= 0:
+        return 0.0, 1.0
+    if s1 <= 0 or s2 <= 0:
+        return wilson_interval(0.0, shots, z)
+    f_eff = s1 * s1 / s2
+    n_eff = shots * s1 / s2
+    return wilson_interval(f_eff, n_eff, z)
+
+
+def weighted_ci_fields(failures, s1, s2, w1, w2, shots,
+                       z: float = Z_95) -> dict:
+    """Weighted twin of ``ci_fields`` for importance-sampled runs: the
+    CI_KEYS block computed from the weight moments (rate = unbiased
+    ``s1/shots``, interval from ``ess_interval``, rse from the sample
+    variance of the per-shot ``w·I`` terms) plus the ESS diagnostics the
+    v3 event schema carries (``ess`` of the full weight stream,
+    ``ess_failures`` of the failure terms).  ``failures`` stays the RAW
+    integer failure count — consumers must not mistake summed weights for
+    shot counts (the bug this path exists to fix)."""
+    s1 = float(s1)
+    s2 = float(s2)
+    w1 = float(w1)
+    w2 = float(w2)
+    n = int(shots)
+    rate = s1 / n if n else 0.0
+    lo, hi = ess_interval(s1, s2, n, z)
+    rel_width = (hi - lo) / rate if rate > 0 else None
+    # rse of the unbiased estimator: sqrt(Var̂[w·I]/n)/rate with
+    # Var̂[w·I] = s2/n - rate² (population form; matches sqrt((1-r)/f) in
+    # the uniform limit up to O(1/n), and is what adaptive budgets act on)
+    var = max(s2 / n - rate * rate, 0.0) / n if n else 0.0
+    rse = math.sqrt(var) / rate if rate > 0 else None
+    return {"failures": int(failures), "shots": n, "rate": rate,
+            "ci_low": lo, "ci_high": hi,
+            "rel_ci_width": rel_width, "rse": rse,
+            "ess": effective_sample_size(w1, w2),
+            "ess_failures": effective_sample_size(s1, s2)}
 
 
 def ci_fields(failures, shots, z: float = Z_95) -> dict:

@@ -349,7 +349,14 @@ def event(kind: str, **fields) -> None:
 # serve_batch / serve_drain) emitted by the decode service.  Purely
 # additive — every v1 event validates unchanged (pinned by the
 # back-compat test in tests/test_serve.py against _V1_EVENT_KINDS).
-EVENT_SCHEMA_VERSION = 2
+#
+# v3 (ISSUE 10): the rare-event subsystem (qldpc_fault_tolerance_tpu.rare)
+# adds the ``rare_stratum`` kind (one per fixed-weight stratum of a
+# subset-splitting run) and the weighted ``wer_run`` / ``cell_done`` /
+# ``cell_progress`` fields (log_weight_sum, ess, ess_failures, tilt) —
+# all OPTIONAL, so direct-MC events validate unchanged.  The v1 AND v2
+# kind sets are frozen below; the back-compat test extends to both.
+EVENT_SCHEMA_VERSION = 3
 
 # the v1 kind set, frozen for the back-compat guarantee: these kinds and
 # their required fields must keep validating across schema bumps
@@ -358,6 +365,11 @@ _V1_EVENT_KINDS = frozenset({
     "cell_progress", "cell_resume", "fit_report", "anomaly", "ledger",
     "fused_fallback", "fault_injected", "degrade", "retry",
     "retry_exhausted", "fail_fast", "watchdog_timeout", "program_cost",
+})
+
+# the v2 additions, frozen with the same guarantee at the v3 bump
+_V2_EVENT_KINDS = frozenset({
+    "serve_session", "serve_request", "serve_batch", "serve_drain",
 })
 
 _NUM = (int, float)
@@ -372,6 +384,13 @@ _CI_FIELDS = {
 _CELL_KEY_FIELDS = {
     "cycles": int, "samples": int, "rep": int, "wer": _NUM,
 }
+# the importance-sampled block (v3): WeightedStats.event_fields plus the
+# ESS-aware uncertainty extras (utils.diagnostics.weighted_ci_fields) a
+# weighted run's wer_run / cell_done events carry
+_WEIGHTED_FIELDS = {
+    "log_weight_sum": _OPT_NUM, "ess": _NUM, "ess_failures": _NUM,
+    "tilt": _NUM,
+}
 
 EVENT_SCHEMAS: dict[str, dict] = {
     "telemetry_enabled": {"required": {"pid": int}, "optional": {}},
@@ -384,7 +403,7 @@ EVENT_SCHEMAS: dict[str, dict] = {
         # ops.bp_pallas.KERNEL_VARIANTS, or "mixed") — silent routing to
         # the XLA twin now leaves a named trace (ISSUE 9 satellite)
         "optional": {"dispatches": int, "kernel_variant": str,
-                     **_CI_FIELDS},
+                     **_CI_FIELDS, **_WEIGHTED_FIELDS},
     },
     "heartbeat": {
         "required": {"engine": str, "shots": int},
@@ -392,12 +411,14 @@ EVENT_SCHEMAS: dict[str, dict] = {
     },
     "cell_done": {
         "required": {"code": str, "noise": str, "type": str, "p": _NUM},
-        "optional": {**_CELL_KEY_FIELDS, **_CI_FIELDS},
+        "optional": {**_CELL_KEY_FIELDS, **_CI_FIELDS, **_WEIGHTED_FIELDS},
     },
     "cell_progress": {
         "required": {"engine": str, "cells": list, "failures": list,
                      "shots": list, "ci_low": list, "ci_high": list},
-        "optional": {"rse": list},
+        # ess (per-cell list): present on weighted fused buckets — the
+        # dashboard's mark for importance-sampled cells
+        "optional": {"rse": list, "ess": list},
     },
     "cell_resume": {
         "required": {"key": dict, "batches_done": int},
@@ -479,6 +500,15 @@ EVENT_SCHEMAS: dict[str, dict] = {
     "serve_drain": {
         "required": {"pending_requests": int, "completed": int},
         "optional": {"elapsed_s": _NUM},
+    },
+    # --- v3: rare-event estimation (rare/) events -------------------------
+    # one per fixed-weight stratum of a subset-splitting run
+    # (rare.estimator.stratified_wer): weight is the binomial mass P(W=k)
+    # the stratum's empirical rate is combined under
+    "rare_stratum": {
+        "required": {"stratum": int, "shots": int, "failures": int,
+                     "weight": _NUM, "rate": _NUM},
+        "optional": {"contribution": _NUM},
     },
 }
 

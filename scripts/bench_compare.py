@@ -59,6 +59,13 @@ GATED_FIELDS = (
     "quant_ab.int8_shots_per_s",
     "cost_model.mfu",
     "cost_model.hbm_util",
+    # rare-event estimation rounds (bench.py rare, ISSUE 10): the
+    # variance-reduction factors and the weighted arm's throughput must
+    # not regress once recorded; r01-r05 lack these keys so the checked-in
+    # history gates unchanged
+    "vrf_equal_shots",
+    "vrf_fixed_wallclock",
+    "weighted_shots_per_s",
 )
 
 # gated fields where a RISE is the regression (latencies)

@@ -21,7 +21,11 @@ Inputs (auto-detected per line, freely mixable):
     serve block instead of being dropped.
 
 Views (``--view``): ``wer`` (default; WER with relative CI width), ``ci``
-(interval bounds on the failure rate), ``shots``, ``state``.
+(interval bounds on the failure rate), ``shots``, ``state``, ``ess``
+(effective sample size / shots — the importance-sampled cells' health
+column; direct cells show their plain shot count).  Weighted cells (the
+rare/ subsystem, event schema v3) are marked ``*`` in every view so a
+mixed direct/weighted grid reads at a glance.
 
 ``--drift`` compares the LAST ledger run against the most recent prior run
 with the SAME config fingerprint (bench_compare's regression-ledger idea,
@@ -99,7 +103,7 @@ def build_grid(records: list[dict], grid: dict | None = None) -> dict:
                              {k: c.get(k) for k in
                               ("wer", "failures", "shots", "rate", "ci_low",
                                "ci_high", "rel_ci_width", "rse",
-                               "substrate")},
+                               "substrate", "ess", "tilt")},
                              "done")
             grid["anomalies"].extend(rec.get("anomalies", []))
             grid["fits"].extend(rec.get("fits", []))
@@ -107,20 +111,24 @@ def build_grid(records: list[dict], grid: dict | None = None) -> dict:
             _cell_update(grid, rec,
                          {k: rec.get(k) for k in
                           ("wer", "failures", "shots", "rate", "ci_low",
-                           "ci_high", "rel_ci_width", "rse")},
+                           "ci_high", "rel_ci_width", "rse", "ess",
+                           "tilt", "log_weight_sum")},
                          "done")
         elif kind == "cell_progress":
-            for c, f, n, lo, hi, rse in zip(
+            n_cells = len(rec.get("cells", []))
+            for c, f, n, lo, hi, rse, ess in zip(
                     rec.get("cells", []), rec.get("failures", []),
                     rec.get("shots", []), rec.get("ci_low", []),
                     rec.get("ci_high", []),
-                    rec.get("rse") or [None] * len(rec.get("cells", []))):
+                    rec.get("rse") or [None] * n_cells,
+                    rec.get("ess") or [None] * n_cells):
                 key = c if isinstance(c, dict) else {"p": c}
                 key.setdefault("code", f"({rec.get('engine', '?')})")
                 rate = (f / n) if n else 0.0
                 _cell_update(grid, key,
                              {"failures": f, "shots": n, "rate": rate,
                               "ci_low": lo, "ci_high": hi, "rse": rse,
+                              "ess": ess,
                               "rel_ci_width": ((hi - lo) / rate
                                                if rate > 0 else None)},
                              "running")
@@ -176,8 +184,19 @@ def _cell_text(cell: dict, view: str) -> str:
         return "-"
     mark = "!" if cell.get("anomaly") else ("~" if cell.get("state") ==
                                             "running" else "")
+    # importance-sampled cells (rare/ subsystem, event schema v3) carry an
+    # effective sample size; the * mark keeps a mixed direct/weighted grid
+    # readable at a glance
+    if cell.get("ess") is not None:
+        mark += "*"
     if view == "state":
         return mark + (cell.get("state") or "?")
+    if view == "ess":
+        ess = cell.get("ess")
+        n = cell.get("shots")
+        if ess is None:
+            return mark + ("-" if n is None else f"{n}")
+        return f"{mark}{ess:.3g}/{n}" if n else f"{mark}{ess:.3g}"
     if view == "shots":
         n = cell.get("shots")
         f = cell.get("failures")
@@ -221,7 +240,8 @@ def render_grid(grid: dict, view: str = "wer", title: str = "") -> str:
                   for code, lt, noise in grid["rows"]) + 2
     header = " " * label_w + "".join(_fmt(f"p={p:g}", width) for p in all_p)
     lines.append("")
-    lines.append(f"-- grid ({view}; ~ running, ! anomaly) --")
+    lines.append(f"-- grid ({view}; ~ running, ! anomaly, "
+                 "* importance-sampled) --")
     lines.append(header)
     for (code, lt, noise), cells in sorted(grid["rows"].items()):
         label = f"{code} {lt} ({noise})"
@@ -354,7 +374,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="run-ledger dir/.jsonl or telemetry JSONL "
                                  "stream")
-    ap.add_argument("--view", choices=("wer", "ci", "shots", "state"),
+    ap.add_argument("--view", choices=("wer", "ci", "shots", "state", "ess"),
                     default="wer")
     ap.add_argument("--follow", action="store_true",
                     help="tail the file and re-render on new lines")

@@ -1,0 +1,611 @@
+"""Rare-event estimation subsystem (qldpc_fault_tolerance_tpu.rare):
+estimator correctness, zero-tilt bit-exactness against the direct engines,
+ESS-aware uncertainty, kill+resume of weighted streams, the weighted fused
+sweep, and the v3 event schema.
+
+The load-bearing contracts, in the order the issue pins them:
+
+  * the ESS interval path reproduces Wilson to 1e-12 in the uniform-weight
+    limit (summed weights must never masquerade as shot counts);
+  * the zero-tilt configuration (tilt == channel probs) is bit-exact with
+    the existing data/phenom engines seed-for-seed;
+  * tilted and direct estimators agree within combined CIs in the overlap
+    regime (a p both can resolve);
+  * a killed weighted stream resumes seed-for-seed through the v2
+    checkpoint cursor (weight moments persisted alongside the counts).
+"""
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BPDecoder
+from qldpc_fault_tolerance_tpu.noise import (
+    bit_flips,
+    bit_flips_tilted,
+    bit_flips_tilted_packed,
+    depolarizing_xz,
+    depolarizing_xz_stratum,
+    depolarizing_xz_tilted,
+    depolarizing_xz_tilted_packed,
+    fixed_weight_flips,
+    stratum_log_weight,
+)
+from qldpc_fault_tolerance_tpu.rare import (
+    auto_tilt,
+    eval_rare_grid,
+    eval_weighted_cells,
+    fit_rare_distance,
+    rare_fit_points,
+    stratified_wer,
+    tilt_channel,
+    tilted_wer,
+    variance_reduction,
+    weighted_fit_point,
+)
+from qldpc_fault_tolerance_tpu.sim.common import (
+    WeightedStats,
+    wer_per_cycle,
+    wer_per_cycle_weighted,
+    wer_single_shot,
+    wer_single_shot_weighted,
+)
+from qldpc_fault_tolerance_tpu.sim.data_error import CodeSimulator_DataError
+from qldpc_fault_tolerance_tpu.sim.phenom import CodeSimulator_Phenon
+from qldpc_fault_tolerance_tpu.utils import diagnostics, telemetry
+
+CODE = hgp(rep_code(3), rep_code(3), name="rep3hgp")
+
+
+def data_sim(p=0.05, seed=0, **kw):
+    dec = lambda h: BPDecoder(h, np.full(CODE.N, p), max_iter=6)  # noqa: E731
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("scan_chunk", 2)
+    return CodeSimulator_DataError(
+        code=CODE, decoder_x=dec(CODE.hz), decoder_z=dec(CODE.hx),
+        pauli_error_probs=[p / 3] * 3, seed=seed, **kw)
+
+
+def phenom_sim(p=0.04, seed=0, **kw):
+    ext = np.hstack([CODE.hx, np.eye(CODE.hx.shape[0], dtype=np.uint8)])
+    extz = np.hstack([CODE.hz, np.eye(CODE.hz.shape[0], dtype=np.uint8)])
+    d1 = lambda h: BPDecoder(  # noqa: E731
+        h, np.full(h.shape[1], p), max_iter=4)
+    d2 = lambda h: BPDecoder(h, np.full(CODE.N, p), max_iter=6)  # noqa: E731
+    kw.setdefault("batch_size", 64)
+    kw.setdefault("scan_chunk", 2)
+    return CodeSimulator_Phenon(
+        code=CODE, decoder1_x=d1(extz), decoder1_z=d1(ext),
+        decoder2_x=d2(CODE.hz), decoder2_z=d2(CODE.hx),
+        pauli_error_probs=[p / 3] * 3, q=p, seed=seed, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Tilted samplers
+# ---------------------------------------------------------------------------
+def test_tilted_depolarizing_zero_tilt_bitexact():
+    """tilt == p consumes the same uniform draw with the same thresholds,
+    so the error planes are bit-identical and the log weight exactly 0."""
+    key = jax.random.PRNGKey(3)
+    probs = [0.02, 0.01, 0.03]
+    ex0, ez0 = depolarizing_xz(key, (32, CODE.N), probs)
+    ex1, ez1, lw = depolarizing_xz_tilted(key, (32, CODE.N), probs, probs)
+    assert jnp.array_equal(ex0, ex1) and jnp.array_equal(ez0, ez1)
+    assert jnp.all(lw == 0.0)  # exact zero, not approximately
+
+
+def test_tilted_bit_flips_zero_tilt_bitexact():
+    key = jax.random.PRNGKey(4)
+    f0 = bit_flips(key, (16, 40), 0.03)
+    f1, lw = bit_flips_tilted(key, (16, 40), 0.03, 0.03)
+    assert jnp.array_equal(f0, f1)
+    assert jnp.all(lw == 0.0)
+
+
+def test_tilted_log_weight_matches_analytic():
+    """The per-shot log weight is the sum over sites of the exact
+    per-outcome log likelihood ratio — recomputable from the planes."""
+    key = jax.random.PRNGKey(5)
+    probs, tilt = [0.01, 0.005, 0.02], [0.04, 0.02, 0.08]
+    ex, ez, lw = depolarizing_xz_tilted(key, (64, CODE.N), probs, tilt)
+    px, py, pz = probs
+    qx, qy, qz = tilt
+    is_y = (ex == 1) & (ez == 1)
+    is_x = (ex == 1) & (ez == 0)
+    is_z = (ex == 0) & (ez == 1)
+    terms = np.where(
+        is_y, math.log(py) - math.log(qy),
+        np.where(is_x, math.log(px) - math.log(qx),
+                 np.where(is_z, math.log(pz) - math.log(qz),
+                          math.log1p(-sum(probs))
+                          - math.log1p(-sum(tilt)))))
+    expect = np.asarray(terms, np.float32).sum(axis=1)
+    np.testing.assert_allclose(np.asarray(lw), expect, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_tilted_packed_matches_dense():
+    from qldpc_fault_tolerance_tpu.ops.gf2_packed import pack_shots
+
+    key = jax.random.PRNGKey(6)
+    probs, tilt = [0.02] * 3, [0.06] * 3
+    ex, ez, lw = depolarizing_xz_tilted(key, (64, CODE.N), probs, tilt)
+    exp, ezp, lwp = depolarizing_xz_tilted_packed(
+        key, (64, CODE.N), probs, tilt)
+    assert jnp.array_equal(exp, pack_shots(ex))
+    assert jnp.array_equal(ezp, pack_shots(ez))
+    assert jnp.array_equal(lw, lwp)
+    fp, lwf = bit_flips_tilted_packed(key, (64, 40), 0.03, 0.09)
+    f, lwd = bit_flips_tilted(key, (64, 40), 0.03, 0.09)
+    assert jnp.array_equal(fp, pack_shots(f)) and jnp.array_equal(lwf, lwd)
+
+
+def test_fixed_weight_flips_exact_weight():
+    for k in (1, 3, 7):
+        flips = fixed_weight_flips(jax.random.PRNGKey(k), (128, 20), k)
+        assert jnp.all(flips.sum(axis=1) == k)
+    # traced k: one program serves every stratum
+    fn = jax.jit(lambda kk, k: fixed_weight_flips(kk, (64, 20), k))
+    for k in (2, 5):
+        assert jnp.all(fn(jax.random.PRNGKey(0), k).sum(axis=1) == k)
+
+
+def test_stratum_log_weight_matches_binomial():
+    n, k, p = 25, 4, 0.03
+    expect = (math.lgamma(n + 1) - math.lgamma(k + 1)
+              - math.lgamma(n - k + 1)
+              + k * math.log(p) + (n - k) * math.log1p(-p))
+    assert abs(float(stratum_log_weight(n, k, p)) - expect) < 1e-4
+
+
+def test_depolarizing_stratum_exact_weight_and_types():
+    key = jax.random.PRNGKey(8)
+    ex, ez, lw = depolarizing_xz_stratum(
+        key, (256, CODE.N), [0.02, 0.01, 0.03], 3)
+    w = np.asarray((ex.astype(bool) | ez.astype(bool)).sum(axis=1))
+    assert (w == 3).all()  # total Pauli weight is exactly the stratum
+    assert np.allclose(np.asarray(lw), float(lw[0]))  # constant per stratum
+
+
+# ---------------------------------------------------------------------------
+# ESS-aware uncertainty (utils.diagnostics)
+# ---------------------------------------------------------------------------
+def test_ess_interval_uniform_limit_matches_wilson_1e12():
+    """Uniform weights (s1 = s2 = failures): the ESS interval IS Wilson.
+    The issue pins 1e-12."""
+    for f, n in [(0, 100), (1, 100), (17, 1000), (350, 4096), (999, 1000)]:
+        lo_w, hi_w = diagnostics.wilson_interval(f, n)
+        lo_e, hi_e = diagnostics.ess_interval(float(f), float(f), n)
+        assert abs(lo_w - lo_e) < 1e-12 and abs(hi_w - hi_e) < 1e-12, (f, n)
+
+
+def test_weighted_ci_fields_uniform_limit_matches_ci_fields():
+    f, n = 23, 2048
+    direct = diagnostics.ci_fields(f, n)
+    weighted = diagnostics.weighted_ci_fields(
+        f, float(f), float(f), float(n), float(n), n)
+    for key in ("rate", "ci_low", "ci_high", "rel_ci_width"):
+        assert abs(direct[key] - weighted[key]) < 1e-12, key
+    assert weighted["failures"] == f and weighted["shots"] == n
+    assert abs(weighted["ess"] - n) < 1e-9
+    assert abs(weighted["ess_failures"] - f) < 1e-9
+
+
+def test_ess_interval_widens_under_weight_degeneracy():
+    """Same summed failure weight, degenerate distribution (one dominant
+    weight): the honest interval must be wider than the uniform one."""
+    n = 1000
+    lo_u, hi_u = diagnostics.ess_interval(10.0, 10.0, n)   # 10 weight-1
+    lo_d, hi_d = diagnostics.ess_interval(10.0, 100.0, n)  # 1 weight-10
+    assert (hi_d - lo_d) > (hi_u - lo_u)
+
+
+def test_effective_sample_size():
+    assert diagnostics.effective_sample_size(100.0, 100.0) == 100.0
+    assert diagnostics.effective_sample_size(10.0, 100.0) == 1.0
+    assert diagnostics.effective_sample_size(0.0, 0.0) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# WeightedStats + weighted WER transforms
+# ---------------------------------------------------------------------------
+def test_weighted_stats_uniform_limit_collapses_to_direct():
+    f, n, K = 37, 4096, CODE.K
+    ws = WeightedStats(failures=f, shots=n, s1=float(f), s2=float(f),
+                       w1=float(n), w2=float(n))
+    assert ws.rate == f / n
+    assert abs(ws.ess - n) < 1e-9
+    w_w, _ = wer_single_shot_weighted(ws, K)
+    w_d, _ = wer_single_shot(f, n, K)
+    assert abs(w_w - w_d) < 1e-12
+    pc_w, _ = wer_per_cycle_weighted(ws, K, 5)
+    pc_d, _ = wer_per_cycle(f, n, K, 5)
+    assert abs(pc_w - pc_d) < 1e-12
+
+
+def test_weighted_stats_merge():
+    a = WeightedStats(failures=3, shots=100, s1=2.0, s2=1.5, w1=90.0,
+                      w2=85.0, min_w=4)
+    b = WeightedStats(failures=1, shots=50, s1=0.5, s2=0.3, w1=45.0,
+                      w2=44.0, min_w=3)
+    m = a.merge(b)
+    assert m.failures == 4 and m.shots == 150 and m.min_w == 3
+    assert m.s1 == 2.5 and m.w1 == 135.0
+
+
+# ---------------------------------------------------------------------------
+# Zero-tilt bit-exactness against the direct engines (seed-for-seed)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("packed", [True, False])
+def test_data_zero_tilt_bitexact(packed):
+    shots = 64 * 8
+    direct = data_sim(packed=packed).WordErrorRate(shots)
+    sim = data_sim(packed=packed)
+    weighted = sim.WeightedWordErrorRate(shots)
+    ws = sim.last_weighted
+    assert weighted[0] == direct[0]
+    # the uniform-weight limit collapses every moment onto the counts
+    assert ws.s1 == ws.failures and ws.s2 == ws.failures
+    assert ws.w1 == ws.shots and ws.w2 == ws.shots
+
+
+def test_phenom_zero_tilt_bitexact():
+    samples = 64 * 4
+    direct = phenom_sim().WordErrorRate(num_rounds=3, num_samples=samples)
+    sim = phenom_sim()
+    weighted = sim.WeightedWordErrorRate(num_rounds=3, num_samples=samples)
+    ws = sim.last_weighted
+    assert weighted[0] == direct[0]
+    assert ws.s1 == ws.failures and ws.w1 == ws.shots
+
+
+def test_data_weighted_rejects_unsupported_paths():
+    sim = data_sim()
+    sim._needs_host = True
+    with pytest.raises(ValueError, match="pure-device"):
+        sim.WeightedWordErrorRate(64)
+
+
+# ---------------------------------------------------------------------------
+# Overlap-regime parity: tilted vs direct where both resolve the rate
+# ---------------------------------------------------------------------------
+def test_overlap_regime_tilted_matches_direct():
+    """At a p near threshold both estimators resolve the failure rate; the
+    tilted one must agree within combined CIs (fixed seeds, so this is a
+    deterministic regression test, not a flaky statistical one)."""
+    shots = 4096
+    sim_d = data_sim(p=0.05, seed=2, batch_size=256)
+    sim_d.WordErrorRate(shots)
+    # direct failure rate from its own weighted view at zero tilt (same
+    # counts, gives us the binomial moments without a private attribute)
+    sim_0 = data_sim(p=0.05, seed=2, batch_size=256)
+    sim_0.WeightedWordErrorRate(shots)
+    direct = sim_0.last_weighted
+    sim_w = data_sim(p=0.05, seed=2, batch_size=256)
+    tilt = tilt_channel([0.05 / 3] * 3, 0.10)
+    sim_w.WeightedWordErrorRate(shots, tilt_probs=tilt)
+    tilted = sim_w.last_weighted
+    assert tilted.failures > 50  # the tilt boosts the failure yield
+    var_d = direct.rate * (1 - direct.rate) / direct.shots
+    sigma = math.sqrt(tilted.variance + var_d)
+    assert abs(tilted.rate - direct.rate) <= 3.0 * sigma
+    # and the tilt reduced the variance on this sub-threshold cell
+    vrf = variance_reduction(tilted)
+    assert vrf is not None and vrf > 1.0
+
+
+# ---------------------------------------------------------------------------
+# Kill + resume of a weighted stream (v2 checkpoint, seed-for-seed)
+# ---------------------------------------------------------------------------
+@pytest.mark.faults
+def test_weighted_kill_resume_seed_for_seed(tmp_path):
+    from qldpc_fault_tolerance_tpu.utils import faultinject, resilience
+    from qldpc_fault_tolerance_tpu.utils.checkpoint import (
+        CellProgress,
+        SweepCheckpoint,
+    )
+
+    key = jax.random.PRNGKey(31)
+    shots = 64 * 16  # 16 batches = 8 megabatches at scan_chunk 2
+    tilt = tilt_channel([0.05 / 3] * 3, 0.12)
+    clean_sim = data_sim()
+    clean = clean_sim.WeightedWordErrorRate(shots, tilt_probs=tilt, key=key)
+    clean_ws = clean_sim.last_weighted
+
+    ckpt_path = str(tmp_path / "cells.jsonl")
+    cell_key = {"code": "rep3hgp", "noise": "data-w", "p": 0.05}
+    plan = faultinject.FaultPlan([
+        faultinject.Fault(site="megabatch_dispatch", kind="raise", after=3,
+                          count=99),
+    ])
+    policy = resilience.RetryPolicy(max_attempts=1, base_delay=0.0,
+                                    jitter=0.0, reset_caches=False)
+    progress = CellProgress(SweepCheckpoint(ckpt_path), cell_key, every=1)
+    with resilience.policy_override(policy):
+        with plan.active():
+            with pytest.raises(faultinject.InjectedFault):
+                data_sim().WeightedWordErrorRate(
+                    shots, tilt_probs=tilt, key=key, progress=progress)
+
+    # the persisted cursor carries the weighted block (v2, additive)
+    st = SweepCheckpoint(ckpt_path).get_progress(cell_key)
+    assert st is not None and st["batches_done"] > 0
+    assert set(st["weighted"]) == {"s1", "s2", "w1", "w2"}
+
+    progress2 = CellProgress(SweepCheckpoint(ckpt_path), cell_key, every=1)
+    sim = data_sim()
+    resumed = sim.WeightedWordErrorRate(shots, tilt_probs=tilt, key=key,
+                                        progress=progress2)
+    ws = sim.last_weighted
+    assert resumed == clean  # seed-for-seed identical WER + error bar
+    assert (ws.failures, ws.shots) == (clean_ws.failures, clean_ws.shots)
+    assert (ws.s1, ws.s2, ws.w1, ws.w2) == (
+        clean_ws.s1, clean_ws.s2, clean_ws.w1, clean_ws.w2)
+    assert sim.last_dispatches < 8  # it resumed, not re-ran
+
+
+# ---------------------------------------------------------------------------
+# Weighted fused cells (rare/sweep.py)
+# ---------------------------------------------------------------------------
+def _rung_sims(ps, seed=17, batch=64):
+    sims = []
+    for p in ps:
+        dec = lambda h: BPDecoder(  # noqa: E731
+            h, np.full(CODE.N, p), max_iter=6)
+        sims.append(CodeSimulator_DataError(
+            code=CODE, decoder_x=dec(CODE.hz), decoder_z=dec(CODE.hx),
+            pauli_error_probs=[p / 3] * 3, seed=seed, batch_size=batch,
+            scan_chunk=2))
+    return sims
+
+
+def test_weighted_cells_match_serial_weighted():
+    """The fused rung ladder reproduces each rung's serial
+    WeightedWordErrorRate seed-for-seed: same counts, same moments."""
+    ps = [0.05, 0.03]
+    tilts = [tilt_channel([p / 3] * 3, 0.1) for p in ps]
+    shots = 64 * 4
+    cells = eval_weighted_cells(_rung_sims(ps), tilts, shots)
+    for p, tilt, cell in zip(ps, tilts, cells):
+        serial = _rung_sims([p])[0]
+        serial.WeightedWordErrorRate(shots, tilt_probs=tilt)
+        sw = serial.last_weighted
+        fw = cell["stats"]
+        assert (fw.failures, fw.shots) == (sw.failures, sw.shots)
+        np.testing.assert_allclose(
+            [fw.s1, fw.s2, fw.w1, fw.w2],
+            [sw.s1, sw.s2, sw.w1, sw.w2], rtol=1e-6)
+
+
+def test_weighted_cells_zero_tilt_matches_direct_fused():
+    """A rung tilted to its own channel probs runs the zero-tilt
+    configuration inside the fused program too."""
+    ps = [0.06, 0.04]
+    tilts = [[p / 3] * 3 for p in ps]  # zero tilt on every rung
+    shots = 64 * 4
+    cells = eval_weighted_cells(_rung_sims(ps), tilts, shots)
+    for cell in cells:
+        ws = cell["stats"]
+        assert ws.s1 == ws.failures and ws.w1 == ws.shots
+
+
+def test_weighted_cells_adaptive_donates_lanes():
+    """target_rse: converged (shallow) rungs stop consuming lanes and the
+    deep rung keeps running — the ESS-aware twin of the adaptive fused
+    sweep.  Convergence is checked on the weighted rse."""
+    ps = [0.08, 0.05]
+    tilts = [tilt_channel([p / 3] * 3, 0.12) for p in ps]
+    with telemetry.session(reset_metrics=True) as reg:
+        cells = eval_weighted_cells(
+            _rung_sims(ps, batch=64), tilts, 64 * 64,
+            target_rse=0.25, min_failures=5)
+        snap = reg.snapshot()
+    for cell in cells:
+        ws = cell["stats"]
+        assert ws.failures >= 5
+        rse = ws.rse
+        # every rung either hit the target or ran the full budget
+        assert (rse is not None and rse <= 0.25) or ws.shots == 64 * 64
+    assert snap.get("driver.early_stops", {}).get("value", 0) >= 1
+
+
+def test_eval_rare_grid_factory_entry():
+    """The sweep-layer entry builds rungs through the decoder factory with
+    CodeFamily's channel conventions and returns fit-ready points keyed on
+    the sweep's eval_p axis."""
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+
+    p_list = [0.04, 0.02]
+    points = eval_rare_grid(
+        CODE, BP_Decoder_Class(6, "minimum_sum", 0.625), p_list, 64 * 4,
+        d_eff=3.0, batch_size=64, seed=13)
+    assert [pt["p"] for pt in points] == p_list  # eval_p, not 1.5*eval_p
+    for pt in points:
+        assert pt["stats"].shots == 64 * 4
+        assert pt["tilt"] >= 0.04 * 1.5  # tilted above every rung's rate
+
+
+def test_weighted_cells_checkpoint_resume(tmp_path):
+    """A finished weighted fused grid re-invoked with the same checkpoint
+    resumes past the end: persisted counters come back, no new dispatches,
+    seed-for-seed equal results."""
+    from qldpc_fault_tolerance_tpu.utils.checkpoint import SweepCheckpoint
+
+    ps = [0.05, 0.03]
+    tilts = [tilt_channel([p / 3] * 3, 0.1) for p in ps]
+    shots = 64 * 4
+    path = str(tmp_path / "rare_ckpt.jsonl")
+    first = eval_weighted_cells(_rung_sims(ps), tilts, shots,
+                                checkpoint=SweepCheckpoint(path))
+    second = eval_weighted_cells(_rung_sims(ps), tilts, shots,
+                                 checkpoint=SweepCheckpoint(path))
+    for a, b in zip(first, second):
+        assert a["wer"] == b["wer"]
+        assert a["stats"].failures == b["stats"].failures
+        np.testing.assert_allclose(
+            [a["stats"].s1, a["stats"].w2], [b["stats"].s1, b["stats"].w2],
+            rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Stratified (fixed-weight subset) estimator
+# ---------------------------------------------------------------------------
+def test_stratified_masses_and_rows():
+    sim = data_sim(p=0.06, seed=3)
+    res = stratified_wer(sim, range(2, 6), 128)
+    assert 0.0 <= res["rate"] <= 1.0
+    # covered + head + tail account for the full weight distribution
+    assert abs(res["covered_mass"] + res["head_mass"] + res["tail_mass"]
+               - 1.0) < 1e-9
+    # head mass (k<2: the decoder-correctable shell) dominates at this p
+    # and must NOT be reported as truncation error
+    assert res["head_mass"] > 0.5
+    assert res["tail_mass"] < 0.2
+    assert [r["stratum"] for r in res["strata"]] == [2, 3, 4, 5]
+    for row in res["strata"]:
+        pmf = math.exp(
+            math.lgamma(CODE.N + 1) - math.lgamma(row["stratum"] + 1)
+            - math.lgamma(CODE.N - row["stratum"] + 1)
+            + row["stratum"] * math.log(0.06)
+            + (CODE.N - row["stratum"]) * math.log1p(-0.06))
+        assert abs(row["weight"] - pmf) < 1e-12
+
+
+def test_stratified_consistent_with_direct():
+    """Σ_k P(W=k) r_k over a wide stratum range estimates the same failure
+    rate direct MC sees (within combined statistical error)."""
+    p = 0.08
+    res = stratified_wer(data_sim(p=p, seed=5, batch_size=256),
+                         range(1, 9), 2048)
+    sim0 = data_sim(p=p, seed=6, batch_size=256)
+    sim0.WeightedWordErrorRate(8192)  # zero tilt == direct counts
+    direct = sim0.last_weighted
+    var_d = direct.rate * (1 - direct.rate) / direct.shots
+    sigma = math.sqrt(res["variance"] + var_d)
+    assert abs(res["rate"] - direct.rate) <= 4.0 * sigma
+    assert res["tail_mass"] < 0.01  # range covers the relevant strata
+
+
+# ---------------------------------------------------------------------------
+# Tilt selection + fit plumbing
+# ---------------------------------------------------------------------------
+def test_auto_tilt_bounds():
+    assert auto_tilt(0.001) == pytest.approx(0.004)  # factor fallback
+    # distance-aimed: q = (d_eff/2)/n
+    assert auto_tilt(0.001, n=100, d_eff=10.0) == pytest.approx(0.05)
+    assert auto_tilt(0.2, n=100, d_eff=2.0) == 0.2  # never below p
+    assert auto_tilt(0.001, n=4, d_eff=8.0) == 0.25  # capped
+    with pytest.raises(ValueError):
+        auto_tilt(0.0)
+
+
+def test_tilt_channel_preserves_ratios():
+    tilt = tilt_channel([0.01, 0.02, 0.03], 0.12)
+    assert sum(tilt) == pytest.approx(0.12)
+    assert tilt[1] / tilt[0] == pytest.approx(2.0)
+    with pytest.raises(ValueError):
+        tilt_channel([0.0, 0.0, 0.0], 0.1)
+
+
+def test_weighted_fit_point_and_fit_rare_distance():
+    """Synthetic rare-event points on an exact pl = A p^{d/2} curve: the
+    sigma-weighted fit recovers d within its own CI."""
+    A, d = 30.0, 4.0
+    points = []
+    for p in (0.001, 0.002, 0.004, 0.008):
+        pl = A * p ** (d / 2)
+        n = 100000
+        # synthetic weighted stats with a plausible second moment
+        s1 = pl * n
+        ws = WeightedStats(failures=max(int(pl * n * 2), 10), shots=n,
+                           s1=s1, s2=s1 * 2e-3, w1=float(n),
+                           w2=float(n) * 1.1)
+        points.append(weighted_fit_point(p, ws, K=1, tilt=0.05))
+    ps, wers, sigmas = rare_fit_points(points)
+    assert len(ps) == 4 and all(s > 0 for s in sigmas)
+    report = fit_rare_distance(points)
+    assert report["converged"]
+    assert report["d_eff"] == pytest.approx(d, rel=0.05)
+
+
+def test_rare_fit_points_drops_sigma_less_cells():
+    ws0 = WeightedStats(failures=0, shots=100, s1=0.0, s2=0.0, w1=100.0,
+                        w2=100.0)
+    pt0 = weighted_fit_point(0.001, ws0, K=1)
+    assert pt0["sigma"] is None
+    ws1 = WeightedStats(failures=5, shots=100, s1=0.05, s2=0.01,
+                        w1=100.0, w2=101.0)
+    pt1 = weighted_fit_point(0.002, ws1, K=1)
+    ps, _, _ = rare_fit_points([pt0, pt1])
+    assert ps == [0.002]
+
+
+def test_variance_reduction_none_without_failures():
+    ws = WeightedStats(failures=0, shots=100, s1=0.0, s2=0.0, w1=100.0,
+                       w2=100.0)
+    assert variance_reduction(ws) is None
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: v3 events validate, weighted runs carry the new fields
+# ---------------------------------------------------------------------------
+def test_weighted_events_validate_against_schema_v3():
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    try:
+        with diagnostics.sweep_run(config={"test": "rare"}):
+            sim = data_sim(p=0.05, seed=4)
+            sim.WeightedWordErrorRate(
+                128, tilt_probs=tilt_channel([0.05 / 3] * 3, 0.1))
+            stratified_wer(data_sim(p=0.05, seed=4), [2, 3], 64)
+            tilts = [tilt_channel([0.05 / 3] * 3, 0.1)]
+            eval_weighted_cells(_rung_sims([0.05]), tilts, 128)
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+    kinds = {e["kind"] for e in sink.records}
+    assert {"wer_run", "rare_stratum", "cell_done"} <= kinds
+    problems = [p for e in sink.records for p in telemetry.validate_event(e)]
+    assert problems == [], problems
+    weighted_runs = [e for e in sink.records if e["kind"] == "wer_run"
+                     and "ess" in e]
+    assert weighted_runs, "weighted wer_run events must carry ess"
+    for e in weighted_runs:
+        assert e["log_weight_sum"] is None or e["log_weight_sum"] > 0
+        assert e["ess"] > 0
+    done = [e for e in sink.records if e["kind"] == "cell_done"]
+    assert done and all("ess" in e and "tilt" in e for e in done)
+
+
+def test_tilted_wer_returns_fit_point():
+    pt = tilted_wer(data_sim(p=0.05, seed=8), 256, q_total=0.1)
+    assert set(pt) >= {"p", "wer", "wer_eb", "sigma", "ess", "tilt"}
+    assert pt["p"] == pytest.approx(0.05)
+    assert pt["tilt"] == pytest.approx(0.1)
+
+
+def test_weighted_tilt_support_validation():
+    """The entry points reject tilts the estimator cannot be unbiased
+    under: support violations (an outcome the channel produces that the
+    proposal never draws) and non-sub-probability triples fail loudly
+    instead of returning a healthy-looking biased number."""
+    sim = data_sim(p=0.03)
+    with pytest.raises(ValueError, match="support"):
+        sim.WeightedWordErrorRate(64, tilt_probs=[0.0, 0.02, 0.02])
+    with pytest.raises(ValueError, match="sub-probability"):
+        sim.WeightedWordErrorRate(64, tilt_probs=[0.5, 0.4, 0.2])
+    with pytest.raises(ValueError, match="components"):
+        sim.WeightedWordErrorRate(64, tilt_probs=[0.1, 0.1])
+    ps = phenom_sim(p=0.03)
+    with pytest.raises(ValueError, match="support"):
+        ps.WeightedWordErrorRate(2, 64, tilt_probs=[0.0, 0.02, 0.02])
+    with pytest.raises(ValueError, match="tilt_q"):
+        ps.WeightedWordErrorRate(2, 64, tilt_q=0.0)
+    # the fused weighted grid validates per cell through the same gate
+    with pytest.raises(ValueError, match="support"):
+        eval_weighted_cells([data_sim(p=0.03)], [[0.0, 0.02, 0.02]], 64)

@@ -726,6 +726,26 @@ def test_v1_events_still_validate_after_schema_bump():
         assert telemetry.validate_event(rec) == [], (kind, fields)
 
 
+def test_v2_events_still_validate_after_v3_bump():
+    """The v3 (rare-event) bump is additive too: representative v2 serve
+    events — one per frozen v2 kind — must still validate unchanged, and
+    the v1/v2 kind sets stay frozen."""
+    v2_samples = {
+        "serve_session": {"session": "hgp_rep3", "event": "open"},
+        "serve_request": {"session": "hgp_rep3", "tenant": "t0",
+                          "shots": 4},
+        "serve_batch": {"session": "hgp_rep3", "requests": 2, "shots": 8,
+                        "bucket": 32},
+        "serve_drain": {"pending_requests": 0, "completed": 6},
+    }
+    assert set(v2_samples) == set(telemetry._V2_EVENT_KINDS)
+    assert telemetry.EVENT_SCHEMA_VERSION >= 3
+    assert not (telemetry._V1_EVENT_KINDS & telemetry._V2_EVENT_KINDS)
+    for kind, fields in v2_samples.items():
+        rec = {"ts": 1.0, "kind": kind, **fields}
+        assert telemetry.validate_event(rec) == [], (kind, fields)
+
+
 # ---------------------------------------------------------------------------
 # Satellite: report + dashboard render serve events instead of dropping them
 # ---------------------------------------------------------------------------
