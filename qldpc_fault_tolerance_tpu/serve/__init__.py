@@ -16,11 +16,20 @@ request-driven decoder service:
   server.py     asyncio TCP front-end (length-prefixed JSON frames),
                 streamed per-request responses, drain-on-shutdown.
   client.py     blocking pipelined client (the bench load generator).
+  ops.py        live ops plane (ISSUE 11): SLO burn-rate engine feeding
+                shed/defer admission signals into the batcher, plus the
+                /metrics /healthz /varz /tracez HTTP sidecar.
+
+Per-request observability (ISSUE 11): trace contexts ride an optional
+wire-frame field end to end (utils.tracing) — queue_wait / batch_assemble
+/ pad / device_decode / slice / respond stage spans land in the telemetry
+JSONL and the always-on flight-recorder ring, which ships a postmortem
+when a dispatch dies.
 
 ``bench.py serve`` (BENCH_MODE=serve) measures sustained QPS and p50/p99
-latency under a mixed-code multi-tenant request storm; the ``serve.*``
-telemetry surface is rendered by scripts/telemetry_report.py and
-scripts/sweep_dashboard.py.
+latency under a mixed-code multi-tenant request storm (plus a tracing
+on/off A/B arm); the ``serve.*`` telemetry surface is rendered by
+scripts/telemetry_report.py and scripts/sweep_dashboard.py.
 """
 from .session import (
     DEFAULT_BUCKETS,
@@ -29,6 +38,14 @@ from .session import (
     SessionCache,
 )
 from .scheduler import ContinuousBatcher, DecodeResult, assemble_round_robin
+from .ops import (
+    AdmissionError,
+    OpsHandle,
+    OpsServer,
+    SLOEngine,
+    SLOPolicy,
+    start_ops_thread,
+)
 from .server import DecodeServer, ServerHandle, start_server_thread
 from .client import ClientResult, DecodeClient
 
@@ -40,6 +57,12 @@ __all__ = [
     "ContinuousBatcher",
     "DecodeResult",
     "assemble_round_robin",
+    "AdmissionError",
+    "OpsHandle",
+    "OpsServer",
+    "SLOEngine",
+    "SLOPolicy",
+    "start_ops_thread",
     "DecodeServer",
     "ServerHandle",
     "start_server_thread",

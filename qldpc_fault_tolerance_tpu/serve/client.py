@@ -9,6 +9,13 @@ one connection per request.  ``decode`` is the submit+wait convenience.
 Latency is measured CLIENT-side (submit to response-parsed), which is the
 number a tail-latency SLO is actually about — it includes the wire, the
 queue wait, the batch fill and the dispatch.
+
+Tracing (ISSUE 11): construct with ``traced=True`` (or pass ``trace=`` per
+submit) and every request mints a ``utils.tracing.TraceContext`` that
+rides the optional wire field — the server records the full stage-span
+tree under it and echoes the trace id back on ``ClientResult.trace_id``,
+the key for the JSONL stream and ``/tracez``.  Untraced clients send
+byte-identical frames to pre-tracing builds.
 """
 from __future__ import annotations
 
@@ -24,7 +31,8 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from .wire import HEADER, MAX_FRAME_BYTES, encode_frame
+from ..utils import tracing
+from .wire import HEADER, MAX_FRAME_BYTES, TRACE_FIELD, encode_frame
 
 __all__ = ["ClientResult", "DecodeClient"]
 
@@ -36,12 +44,14 @@ class ClientResult:
     latency_s: float                 # client-side: submit -> response parsed
     server_latency_ms: float | None  # scheduler-side, from the response
     request_id: str
+    trace_id: str | None = None      # echoed by the server when traced
 
 
 class DecodeClient:
     def __init__(self, host: str, port: int, *, tenant: str = "default",
-                 timeout: float = 60.0):
+                 timeout: float = 60.0, traced: bool = False):
         self.tenant = str(tenant)
+        self.traced = bool(traced)
         self.timeout = float(timeout)
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
@@ -116,7 +126,8 @@ class DecodeClient:
                     converged=msg.get("converged"),
                     latency_s=time.perf_counter() - t0,
                     server_latency_ms=msg.get("latency_ms"),
-                    request_id=str(rid)))
+                    request_id=str(rid),
+                    trace_id=msg.get("trace_id")))
             else:
                 fut.set_exception(
                     RuntimeError(msg.get("error", "decode failed")))
@@ -134,18 +145,27 @@ class DecodeClient:
 
     # ------------------------------------------------------------------
     def submit(self, session: str, syndromes, *,
-               tenant: str | None = None) -> Future:
+               tenant: str | None = None,
+               trace: "tracing.TraceContext | None" = None) -> Future:
+        """Send one decode request; returns its future.  ``trace``
+        attaches an explicit trace context; ``traced=True`` clients mint
+        one per request when none is given."""
         arr = np.atleast_2d(np.asarray(syndromes))
         rid = f"{self._prefix}-{next(self._ids)}"
+        if trace is None and self.traced:
+            trace = tracing.TraceContext()
         fut: Future = Future()
         with self._plock:
             if self._closed:
                 raise RuntimeError("client closed")
             self._pending[rid] = (fut, time.perf_counter())
+        msg = {"op": "decode", "id": rid, "session": str(session),
+               "tenant": tenant or self.tenant,
+               "syndromes": arr.tolist()}
+        if trace is not None:
+            msg[TRACE_FIELD] = trace.to_wire()
         try:
-            self._send({"op": "decode", "id": rid, "session": str(session),
-                        "tenant": tenant or self.tenant,
-                        "syndromes": arr.tolist()})
+            self._send(msg)
         except OSError:
             with self._plock:
                 self._pending.pop(rid, None)
@@ -153,9 +173,10 @@ class DecodeClient:
         return fut
 
     def decode(self, session: str, syndromes, *,
-               tenant: str | None = None) -> ClientResult:
-        return self.submit(session, syndromes,
-                           tenant=tenant).result(timeout=self.timeout)
+               tenant: str | None = None,
+               trace: "tracing.TraceContext | None" = None) -> ClientResult:
+        return self.submit(session, syndromes, tenant=tenant,
+                           trace=trace).result(timeout=self.timeout)
 
     def ping(self) -> dict:
         fut: Future = Future()

@@ -1,0 +1,519 @@
+"""The decode service's live ops plane: SLO burn-rate engine + HTTP
+endpoints.
+
+Two pieces a production decode service is actually operated with, built on
+the telemetry/tracing substrate that already exists:
+
+  * **SLOEngine** — rolling-window burn-rate evaluation over the served
+    request stream (latency-vs-target and error-rate objectives, fed
+    per-request by the ``ContinuousBatcher``).  Burn rate is the standard
+    SRE quantity: the fraction of the error budget consumed in the window,
+    normalized so 1.0 = exactly on budget.  Sustained burn above the
+    ``defer`` threshold marks a tenant for deprioritized assembly (its
+    requests ride batches' spare capacity); above the ``shed`` threshold
+    new submits for the tenant are rejected at admission with a structured
+    error — the concrete admission signal ROADMAP item 1's
+    admission-control/autoscaling loop needs.  Every signal transition
+    emits a versioned ``slo_alert`` event.
+
+  * **OpsServer** — a dependency-free asyncio HTTP/1.1 endpoint beside the
+    TCP decode port serving ``/metrics`` (the existing Prometheus text
+    exposition), ``/healthz`` (queue depth, session cache, last-dispatch
+    age, SLO signals; 503 while draining/stopped), ``/varz`` (raw registry
+    snapshot + compile stats as JSON), and ``/tracez`` (recent slow /
+    errored traces from the flight-recorder ring; filter with
+    ``?trace_id=``, ``?slow_ms=``, ``?errored=1``, ``?limit=``).
+
+Neither piece touches the sweep hot path; both read state the serving
+layer already maintains.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import dataclasses
+import json
+import threading
+import time
+import urllib.parse
+
+from ..utils import telemetry, tracing
+
+__all__ = [
+    "AdmissionError",
+    "SLOPolicy",
+    "SLOEngine",
+    "OpsServer",
+    "OpsHandle",
+    "spawn_server_loop",
+    "start_ops_thread",
+]
+
+
+class AdmissionError(RuntimeError):
+    """A submit rejected by the SLO admission signal (tenant shed).  The
+    server answers the request with this as a structured error — shed
+    traffic is refused loudly and cheaply, never queued and timed out."""
+
+    def __init__(self, tenant: str, signal: str, burn_rate: float):
+        self.tenant = str(tenant)
+        self.signal = str(signal)
+        self.burn_rate = float(burn_rate)
+        super().__init__(
+            f"admission {signal}: tenant {tenant!r} is burning its SLO "
+            f"budget at {burn_rate:.1f}x (shed threshold exceeded)")
+
+
+@dataclasses.dataclass
+class SLOPolicy:
+    """The objectives and thresholds one SLOEngine evaluates.
+
+    ``latency_target_s`` / ``latency_objective``: at least that fraction
+    of a tenant's requests must complete under the target.
+    ``error_objective``: at least that fraction must succeed.  Budgets are
+    the complements; burn rate is bad-fraction / budget over the rolling
+    ``window_s``.  Signals: burn >= ``burn_shed`` -> "shed"; >=
+    ``burn_defer`` -> "defer"; else "admit".  ``min_requests`` keeps a
+    cold tenant from being judged on noise.
+    """
+
+    latency_target_s: float = 0.25
+    latency_objective: float = 0.99
+    error_objective: float = 0.999
+    window_s: float = 30.0
+    min_requests: int = 20
+    burn_defer: float = 2.0
+    burn_shed: float = 6.0
+    eval_interval_s: float = 0.5
+    max_window_requests: int = 4096  # per-tenant memory bound
+    # total-tenant memory bound: tenant names are WIRE-supplied, so the
+    # engine must not let a hostile client mint unbounded per-tenant
+    # state (the scheduler caps its per-tenant counters the same way).
+    # Tenants beyond the cap are simply not judged (admitted); tenants
+    # whose whole window aged out are garbage-collected every evaluate.
+    max_tenants: int = 256
+
+
+class _TenantWindow:
+    """One tenant's rolling window with incrementally maintained bad
+    counts: O(1) per observation and per expiry, so ``evaluate`` never
+    rescans live entries — it runs synchronously inside submits,
+    including on the server's event-loop thread, where an O(window)
+    scan per tenant would stall every connection."""
+
+    __slots__ = ("entries", "max_entries", "bad_lat", "bad_err")
+
+    def __init__(self, max_entries: int):
+        self.entries: collections.deque = collections.deque()
+        self.max_entries = int(max_entries)
+        self.bad_lat = 0
+        self.bad_err = 0
+
+    def append(self, now: float, bad_lat: bool, ok: bool) -> None:
+        if len(self.entries) >= self.max_entries:
+            self._drop()
+        self.entries.append((now, bad_lat, ok))
+        if bad_lat:
+            self.bad_lat += 1
+        if not ok:
+            self.bad_err += 1
+
+    def _drop(self) -> None:
+        _, bad_lat, ok = self.entries.popleft()
+        if bad_lat:
+            self.bad_lat -= 1
+        if not ok:
+            self.bad_err -= 1
+
+    def expire(self, cutoff: float) -> None:
+        """Drop entries older than the window (they are append-time
+        ordered, so the stale ones are a prefix)."""
+        while self.entries and self.entries[0][0] < cutoff:
+            self._drop()
+
+    def newest_ts(self) -> float:
+        return self.entries[-1][0] if self.entries else float("-inf")
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+class SLOEngine:
+    """Per-tenant rolling-window burn-rate evaluation + admission signals.
+
+    The batcher feeds ``observe_request`` per completed request and
+    consults ``admission`` per submit / ``deferred_tenants`` per assembly;
+    both consults are a dict read after a lazily rate-limited
+    ``evaluate``.  ``now`` is injectable everywhere (monotonic seconds)
+    so tests drive the window deterministically."""
+
+    def __init__(self, policy: SLOPolicy | None = None):
+        self.policy = policy or SLOPolicy()
+        self._lock = threading.Lock()
+        self._windows: dict[str, _TenantWindow] = {}
+        self._signals: dict[str, str] = {}
+        self._last_eval = float("-inf")
+        self._last_report: dict = {}
+        self._queue_depth = 0
+
+    # ------------------------------------------------------------------
+    # observations
+    # ------------------------------------------------------------------
+    def observe_request(self, tenant: str, latency_s: float,
+                        ok: bool = True, now: float | None = None) -> None:
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            win = self._windows.get(tenant)
+            if win is None:
+                if len(self._windows) >= self.policy.max_tenants:
+                    # wire-supplied tenant names must not mint unbounded
+                    # state; an overflow tenant is unjudged (admitted)
+                    telemetry.count("serve.slo.tenant_overflow")
+                    return
+                win = self._windows[tenant] = _TenantWindow(
+                    self.policy.max_window_requests)
+            win.append(now, float(latency_s) > self.policy.latency_target_s,
+                       bool(ok))
+        self._maybe_evaluate(now)
+
+    def observe_queue_depth(self, depth: int) -> None:
+        self._queue_depth = int(depth)
+
+    # ------------------------------------------------------------------
+    # evaluation
+    # ------------------------------------------------------------------
+    def _tenant_burn(self, win: _TenantWindow) -> dict | None:
+        # caller (evaluate, under the lock) already expired every entry
+        # older than the window, and the window maintains its bad counts
+        # incrementally: this is O(1)
+        n = len(win)
+        if n < self.policy.min_requests:
+            return None
+        bad_lat, bad_err = win.bad_lat, win.bad_err
+        budget_lat = max(1e-9, 1.0 - self.policy.latency_objective)
+        budget_err = max(1e-9, 1.0 - self.policy.error_objective)
+        burn_lat = (bad_lat / n) / budget_lat
+        burn_err = (bad_err / n) / budget_err
+        burn = max(burn_lat, burn_err)
+        return {
+            "requests": n,
+            "bad_latency": bad_lat,
+            "bad_errors": bad_err,
+            "burn_latency": round(burn_lat, 4),
+            "burn_error": round(burn_err, 4),
+            "burn_rate": round(burn, 4),
+            "objective": ("latency" if burn_lat >= burn_err else "errors"),
+            "bad_fraction": round(max(bad_lat, bad_err) / n, 6),
+        }
+
+    def _maybe_evaluate(self, now: float) -> None:
+        if now - self._last_eval >= self.policy.eval_interval_s:
+            self.evaluate(now=now)
+
+    def evaluate(self, now: float | None = None) -> dict:
+        """Re-derive every tenant's burn rate and admission signal; emits
+        one ``slo_alert`` event (+ counter) per signal TRANSITION — steady
+        state is silent.  Returns {tenant: report}."""
+        now = time.monotonic() if now is None else float(now)
+        pol = self.policy
+        report: dict = {}
+        alerts = []
+        with self._lock:
+            self._last_eval = now
+            # GC tenants whose whole window aged out: their signal is
+            # "admit" by construction, and dropping them bounds state to
+            # the tenants actually sending traffic (a shed tenant that
+            # went quiet gets its recovery transition on the way out)
+            cutoff = now - pol.window_s
+            for tenant in [t for t, w in self._windows.items()
+                           if w.newest_ts() < cutoff]:
+                del self._windows[tenant]
+                prev = self._signals.pop(tenant, "admit")
+                if prev != "admit":
+                    alerts.append((tenant, prev, "admit",
+                                   {"requests": 0, "burn_rate": 0.0}))
+            for tenant, win in self._windows.items():
+                win.expire(cutoff)
+                burn = self._tenant_burn(win)
+                if burn is None:
+                    signal = "admit"
+                    burn = {"requests": len(win), "burn_rate": 0.0}
+                elif burn["burn_rate"] >= pol.burn_shed:
+                    signal = "shed"
+                elif burn["burn_rate"] >= pol.burn_defer:
+                    signal = "defer"
+                else:
+                    signal = "admit"
+                prev = self._signals.get(tenant, "admit")
+                if signal != prev:
+                    alerts.append((tenant, prev, signal, dict(burn)))
+                self._signals[tenant] = signal
+                report[tenant] = {**burn, "signal": signal}
+            self._last_report = report
+        for tenant, prev, signal, burn in alerts:
+            telemetry.count("serve.slo.alerts")
+            telemetry.count(f"serve.slo.{signal}_transitions")
+            fields = dict(
+                tenant=str(tenant), signal=signal, prev_signal=prev,
+                window_s=float(pol.window_s),
+                queue_depth=int(self._queue_depth),
+                **{k: v for k, v in burn.items()
+                   if k in ("burn_rate", "burn_latency", "burn_error",
+                            "objective", "requests", "bad_fraction")})
+            telemetry.event("slo_alert", **fields)
+            tracing.flight_record("slo_alert", **fields)
+        return report
+
+    # ------------------------------------------------------------------
+    # signals the batcher consumes
+    # ------------------------------------------------------------------
+    def admission(self, tenant: str, now: float | None = None) -> str:
+        """"admit" | "defer" | "shed" for one tenant (re-evaluating when
+        the cached evaluation went stale)."""
+        self._maybe_evaluate(time.monotonic() if now is None
+                             else float(now))
+        return self._signals.get(str(tenant), "admit")
+
+    def deferred_tenants(self) -> frozenset:
+        # under the lock: evaluate() inserts/deletes keys concurrently
+        # from submit threads, and a mid-iteration resize here would
+        # RuntimeError the scheduler loop thread
+        with self._lock:
+            return frozenset(t for t, s in self._signals.items()
+                             if s == "defer")
+
+    def check_admission(self, tenant: str,
+                        now: float | None = None) -> str:
+        """The submit-side gate: raises ``AdmissionError`` for a shed
+        tenant, returns the signal otherwise."""
+        signal = self.admission(tenant, now=now)
+        if signal == "shed":
+            # aggregate counter only: tenant is wire input, and a counter
+            # per name would let clients grow the registry without bound
+            # (the slo_alert event already names the tenant)
+            telemetry.count("serve.admission.shed")
+            burn = self._last_report.get(str(tenant), {})
+            raise AdmissionError(tenant, signal,
+                                 float(burn.get("burn_rate", 0.0)))
+        if signal == "defer":
+            telemetry.count("serve.admission.deferred")
+        return signal
+
+    def report(self) -> dict:
+        """The last evaluation's per-tenant report (for /healthz)."""
+        with self._lock:
+            return {t: dict(r) for t, r in self._last_report.items()}
+
+
+# ---------------------------------------------------------------------------
+# HTTP ops plane
+# ---------------------------------------------------------------------------
+_HTTP_REASONS = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
+                 500: "Internal Server Error", 503: "Service Unavailable"}
+
+
+def _http_response(status: int, body: str,
+                   content_type: str = "application/json") -> bytes:
+    payload = body.encode("utf-8")
+    head = (f"HTTP/1.1 {status} {_HTTP_REASONS.get(status, 'OK')}\r\n"
+            f"Content-Type: {content_type}; charset=utf-8\r\n"
+            f"Content-Length: {len(payload)}\r\n"
+            "Connection: close\r\n\r\n")
+    return head.encode("ascii") + payload
+
+
+class OpsServer:
+    """The HTTP sidecar: GET-only, one request per connection, stdlib
+    asyncio all the way down (the decode service deliberately has no web
+    framework dependency)."""
+
+    def __init__(self, batcher=None, slo: SLOEngine | None = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 flight: "tracing.FlightRecorder | None" = None):
+        self.batcher = batcher
+        self.slo = slo
+        self.host = host
+        self.port = int(port)
+        self.flight = flight
+        self._server: asyncio.AbstractServer | None = None
+        self.t_started = time.monotonic()
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port)
+        self.port = self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    # ------------------------------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            try:
+                head = await asyncio.wait_for(
+                    reader.readuntil(b"\r\n\r\n"), timeout=10.0)
+            except (asyncio.IncompleteReadError, asyncio.LimitOverrunError,
+                    asyncio.TimeoutError, ConnectionError):
+                return
+            request_line = head.split(b"\r\n", 1)[0].decode(
+                "latin-1", "replace")
+            parts = request_line.split()
+            if len(parts) < 2:
+                return
+            method, target = parts[0], parts[1]
+            if method != "GET":
+                writer.write(_http_response(
+                    405, json.dumps({"error": "GET only"})))
+            else:
+                writer.write(self._route(target))
+            await writer.drain()
+        except (ConnectionError, RuntimeError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:
+                pass
+
+    def _route(self, target: str) -> bytes:
+        telemetry.count("serve.ops.requests")
+        url = urllib.parse.urlsplit(target)
+        query = urllib.parse.parse_qs(url.query)
+        try:
+            if url.path == "/metrics":
+                return _http_response(200, telemetry.prometheus_text(),
+                                      content_type="text/plain")
+            if url.path == "/healthz":
+                body = self.healthz()
+                status = 200 if body.get("ok") else 503
+                return _http_response(status, json.dumps(
+                    body, sort_keys=True, default=str))
+            if url.path == "/varz":
+                return _http_response(200, json.dumps(
+                    self.varz(), sort_keys=True, default=str))
+            if url.path == "/tracez":
+                return _http_response(200, json.dumps(
+                    self.tracez(query), sort_keys=True, default=str))
+            return _http_response(404, json.dumps(
+                {"error": f"unknown path {url.path!r}", "paths":
+                 ["/metrics", "/healthz", "/varz", "/tracez"]}))
+        except Exception as exc:  # noqa: BLE001 — an ops bug must answer
+            return _http_response(500, json.dumps(
+                {"error": f"{type(exc).__name__}: {exc}"}))
+
+    # ------------------------------------------------------------------
+    # endpoint bodies (plain methods so tests can call them directly)
+    # ------------------------------------------------------------------
+    def healthz(self) -> dict:
+        body: dict = {"ok": True, "uptime_s": round(
+            time.monotonic() - self.t_started, 3)}
+        if self.batcher is not None:
+            health = self.batcher.health()
+            body.update(health)
+            body["ok"] = not (health.get("stopped")
+                              or health.get("draining"))
+        if self.slo is not None:
+            body["slo"] = self.slo.report()
+        return body
+
+    def varz(self) -> dict:
+        return {"metrics": telemetry.snapshot(),
+                "compile": telemetry.compile_stats(),
+                "process": telemetry.process_info()}
+
+    def tracez(self, query: dict | None = None) -> dict:
+        query = query or {}
+        flight = self.flight if self.flight is not None \
+            else tracing.recorder()
+        records = flight.snapshot()
+
+        def _one(name, cast, default=None):
+            vals = query.get(name)
+            try:
+                return cast(vals[0]) if vals else default
+            except (TypeError, ValueError):
+                return default
+
+        trace_id = _one("trace_id", str)
+        if trace_id:
+            spans = tracing.traces_from_records(records).get(trace_id, [])
+            return {"trace_id": trace_id, "spans": spans,
+                    "tree_spans": tracing.trace_tree(spans)["spans"]}
+        slow_ms = _one("slow_ms", float)
+        limit = _one("limit", int, 50)
+        errored = bool(_one("errored", int, 0))
+        return {
+            "traces": tracing.trace_summaries(
+                records, limit=limit,
+                slow_s=None if slow_ms is None else slow_ms / 1e3,
+                errored_only=errored),
+            "ring_records": len(records),
+        }
+
+
+class OpsHandle:
+    """An OpsServer running on its own event-loop thread."""
+
+    def __init__(self, server: OpsServer, loop: asyncio.AbstractEventLoop,
+                 thread: threading.Thread):
+        self.server = server
+        self._loop = loop
+        self._thread = thread
+
+    @property
+    def address(self) -> tuple[str, int]:
+        return (self.server.host, self.server.port)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.server.stop(), self._loop).result(timeout)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=timeout)
+
+
+def spawn_server_loop(start, thread_name: str, what: str):
+    """Run an asyncio server on a fresh daemon-thread event loop; returns
+    ``(loop, thread)`` once the awaited ``start()`` accepted.  A failed
+    start (e.g. bind) is re-raised in the caller, and the loop is closed
+    either way so a failed bind cannot leak its fds.  Shared by
+    ``start_ops_thread`` and ``serve.server.start_server_thread``."""
+    loop = asyncio.new_event_loop()
+    started = threading.Event()
+    box: dict = {}
+
+    def run():
+        asyncio.set_event_loop(loop)
+        try:
+            try:
+                loop.run_until_complete(start())
+            except Exception as exc:  # surface bind failures to the caller
+                box["error"] = exc
+                return
+            started.set()
+            loop.run_forever()
+        finally:
+            started.set()
+            loop.close()  # a failed bind must not leak the loop's fds
+
+    thread = threading.Thread(target=run, daemon=True, name=thread_name)
+    thread.start()
+    if not started.wait(timeout=30.0):
+        raise RuntimeError(f"{what} failed to start within 30s")
+    if "error" in box:
+        raise box["error"]
+    return loop, thread
+
+
+def start_ops_thread(batcher=None, slo: SLOEngine | None = None,
+                     host: str = "127.0.0.1", port: int = 0) -> OpsHandle:
+    """Start the ops plane on a daemon thread; returns once it accepts."""
+    server = OpsServer(batcher=batcher, slo=slo, host=host, port=port)
+    loop, thread = spawn_server_loop(server.start, "qldpc-serve-ops",
+                                     "ops server")
+    return OpsHandle(server, loop, thread)

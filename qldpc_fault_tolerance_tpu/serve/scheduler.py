@@ -29,6 +29,16 @@ per-tenant request counters), ``serve.queue_depth`` gauge,
 histograms, and ``serve_request`` / ``serve_batch`` / ``serve_drain``
 events in the versioned schema scripts/telemetry_report.py and
 scripts/sweep_dashboard.py render.
+
+Per-request observability (ISSUE 11): a request carrying a trace context
+(utils.tracing, propagated from the wire frame by serve/server.py) records
+queue_wait / batch_assemble / pad / device_decode / slice stage spans
+(batch stages amortized, with the factor on the span); every accepted
+request lands in the process flight-recorder ring, and a dispatch that
+fails after retries ships a postmortem naming exactly the requests that
+were in flight.  An attached ``serve.ops.SLOEngine`` turns the per-request
+stream into admission signals: "shed" tenants are rejected at submit,
+"defer" tenants ride batches' spare capacity only.
 """
 from __future__ import annotations
 
@@ -40,7 +50,7 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from ..utils import resilience, telemetry
+from ..utils import faultinject, resilience, telemetry, tracing
 from .session import OCCUPANCY_BUCKETS, DecodeSession, SessionCache
 
 __all__ = ["DecodeResult", "ContinuousBatcher", "assemble_round_robin"]
@@ -64,6 +74,7 @@ class _Request:
     syndromes: np.ndarray
     future: Future
     t0: float
+    trace: "tracing.TraceContext | None" = None
 
     @property
     def shots(self) -> int:
@@ -96,31 +107,64 @@ class _SessionQueue:
 
 
 def assemble_round_robin(queue: _SessionQueue, max_shots: int,
-                         force: bool = False) -> list[_Request]:
+                         force: bool = False,
+                         deferred=frozenset()) -> list[_Request]:
     """Pop one flush's worth of requests, one request per tenant per
     rotation, until adding the next would exceed ``max_shots`` (the first
     request always goes in, so an oversize request still dispatches — the
     session chunks it).  ``force`` ignores the cap (drain).  Pure queue
     surgery, unit-tested directly for the fairness property: with tenants
-    A(flood) and B(one request), B's request rides the FIRST batch."""
+    A(flood) and B(one request), B's request rides the FIRST batch.
+
+    ``deferred`` tenants (the SLO engine's "defer" admission signal) are
+    DEPRIORITIZED, not starved: they are skipped on the first pass and
+    only ride the batch's spare capacity after every admitted tenant has
+    taken its rotating share — or dispatch alone when nothing else is
+    queued."""
     batch: list[_Request] = []
     taken = 0
-    while queue.order:
-        tenant = queue.order[0]
-        q = queue.tenants.get(tenant)
-        if not q:
-            queue.order.popleft()
-            queue.tenants.pop(tenant, None)
-            continue
-        nxt = q[0]
-        if batch and not force and taken + nxt.shots > max_shots:
-            break
-        q.popleft()
-        batch.append(nxt)
-        taken += nxt.shots
-        queue.order.rotate(-1)
-        if not force and taken >= max_shots:
-            break
+
+    def _pass(include) -> bool:
+        """One rotation pass over tenants matching ``include``; returns
+        False once capacity is used up.  Terminates: every iteration pops
+        a request, removes an exhausted tenant, or bumps ``skipped`` —
+        which a full excluded-tenants rotation bounds."""
+        nonlocal taken
+        skipped = 0
+        while queue.order and skipped < len(queue.order):
+            tenant = queue.order[0]
+            q = queue.tenants.get(tenant)
+            if not q:
+                queue.order.popleft()
+                queue.tenants.pop(tenant, None)
+                continue
+            if not include(tenant):
+                queue.order.rotate(-1)
+                skipped += 1
+                continue
+            nxt = q[0]
+            if batch and not force and taken + nxt.shots > max_shots:
+                return False
+            q.popleft()
+            batch.append(nxt)
+            taken += nxt.shots
+            queue.order.rotate(-1)
+            skipped = 0
+            if not force and taken >= max_shots:
+                return False
+        return True
+
+    if deferred:
+        _pass(lambda t: t not in deferred)
+        # spare capacity — not "the admitted pass ran dry" — decides
+        # whether deferred tenants ride: the admitted pass may stop
+        # because ITS next request is too big while a smaller deferred
+        # one still fits, and skipping the pass then would starve defer
+        # tenants outright under a sustained admitted flood
+        if force or taken < max_shots:
+            _pass(lambda t: t in deferred)
+    else:
+        _pass(lambda t: True)
     # trim exhausted tenants + refresh the aggregate bookkeeping
     for tenant in [t for t, q in queue.tenants.items() if not q]:
         queue.tenants.pop(tenant)
@@ -142,18 +186,26 @@ class ContinuousBatcher:
     (wrapped).  ``submit`` returns a ``concurrent.futures.Future`` that
     resolves to a ``DecodeResult`` (asyncio callers wrap it with
     ``asyncio.wrap_future`` — that is exactly what serve/server.py does).
+
+    ``slo``: an optional ``serve.ops.SLOEngine``.  When attached, every
+    submit consults its admission signal (a "shed" tenant's submit raises
+    ``AdmissionError`` — the server answers it as a structured error),
+    "defer" tenants are deprioritized at assembly, and every completed or
+    failed request feeds the engine's rolling window.
     """
 
     def __init__(self, sessions, *, max_batch_shots: int = 1024,
-                 max_wait_s: float = 0.002):
+                 max_wait_s: float = 0.002, slo=None):
         if isinstance(sessions, dict):
             cache = SessionCache(max_sessions=max(8, len(sessions)))
             for s in sessions.values():
                 cache.add(s)
             sessions = cache
         self.sessions: SessionCache = sessions
+        self.slo = slo
         self.max_batch_shots = max(1, int(max_batch_shots))
         self.max_wait_s = float(max_wait_s)
+        self._last_dispatch_t: float | None = None
         self._cv = threading.Condition()
         self._pending: dict[str, _SessionQueue] = {}
         self._queued_requests = 0
@@ -176,10 +228,14 @@ class ContinuousBatcher:
     # submission
     # ------------------------------------------------------------------
     def submit(self, session: str, syndromes, *, tenant: str = "default",
-               request_id: str | None = None) -> Future:
+               request_id: str | None = None, trace=None) -> Future:
         """Enqueue one decode request; returns its future.  Validation
         (unknown session, wrong width, empty batch) raises HERE, on the
-        caller's thread, so the queue only ever holds dispatchable work."""
+        caller's thread, so the queue only ever holds dispatchable work —
+        and so does the SLO admission gate: a shed tenant's submit raises
+        ``AdmissionError`` before anything is queued.  ``trace`` is an
+        optional ``tracing.TraceContext`` the request's stage spans record
+        under."""
         sess = self.sessions.get(str(session))
         arr = np.atleast_2d(np.asarray(syndromes, dtype=np.uint8))
         if arr.ndim != 2 or arr.shape[0] == 0:
@@ -188,21 +244,34 @@ class ContinuousBatcher:
             raise ValueError(
                 f"session {session!r} decodes width {sess.syndrome_width}, "
                 f"got {arr.shape[1]}")
+        if self.slo is not None:
+            self.slo.check_admission(str(tenant))  # raises AdmissionError
         req = _Request(request_id=request_id, tenant=str(tenant),
                        session=str(session), syndromes=arr,
-                       future=Future(), t0=time.perf_counter())
+                       future=Future(), t0=time.perf_counter(), trace=trace)
         with self._cv:
             if self._stopped or self._draining:
                 raise RuntimeError("scheduler is draining/stopped")
             self._pending.setdefault(req.session, _SessionQueue()).add(req)
             self._queued_requests += 1
+            depth = self._queued_requests
             if req.tenant not in self._tenant_labels:
                 if len(self._tenant_labels) < self.max_tenant_counters:
                     self._tenant_labels.add(req.tenant)
             label = (req.tenant if req.tenant in self._tenant_labels
                      else "__other__")
-            telemetry.set_gauge("serve.queue_depth", self._queued_requests)
+            telemetry.set_gauge("serve.queue_depth", depth)
             self._cv.notify()
+        if self.slo is not None:
+            self.slo.observe_queue_depth(depth)
+        # the flight recorder sees every accepted request (always on,
+        # lock-free): a crashed dispatch's postmortem names exactly what
+        # was in flight
+        tracing.flight_record(
+            "request", session=req.session, tenant=req.tenant,
+            shots=req.shots,
+            **({} if req.request_id is None else {"id": req.request_id}),
+            **({} if trace is None else {"trace_id": trace.trace_id}))
         telemetry.count("serve.requests")
         telemetry.count("serve.shots", req.shots)
         telemetry.count(f"serve.tenant.{label}.requests")
@@ -228,7 +297,10 @@ class ContinuousBatcher:
         if best is None:
             return None
         q = self._pending[best]
-        batch = assemble_round_robin(q, self.max_batch_shots, force=force)
+        deferred = (self.slo.deferred_tenants()
+                    if self.slo is not None else frozenset())
+        batch = assemble_round_robin(q, self.max_batch_shots, force=force,
+                                     deferred=deferred)
         if q.empty():
             self._pending.pop(best, None)
         return best, batch
@@ -262,10 +334,24 @@ class ContinuousBatcher:
             self._dispatch(*picked)
 
     def _dispatch(self, session_name: str, batch: list[_Request]) -> None:
+        t_assembled = time.perf_counter()
+        traced = [r for r in batch if r.trace is not None]
+        for r in traced:
+            # queue_wait: submit -> assembled into this flush
+            tracing.record_span(
+                "queue_wait", r.trace, dur_s=t_assembled - r.t0,
+                session=session_name, tenant=r.tenant,
+                **({} if r.request_id is None
+                   else {"request_id": r.request_id}))
         synd = (batch[0].syndromes if len(batch) == 1
                 else np.concatenate([r.syndromes for r in batch]))
         wait_s = time.perf_counter() - min(r.t0 for r in batch)
         t0 = time.perf_counter()
+        for r in traced:
+            tracing.record_span(
+                "batch_assemble", r.trace, dur_s=t0 - t_assembled,
+                requests=len(batch), shots=int(synd.shape[0]),
+                amortized_over=len(batch))
         try:
             # the lookup lives INSIDE the guard: a session evicted between
             # submit and flush must fail this batch's futures, not kill
@@ -276,22 +362,44 @@ class ContinuousBatcher:
             # — the rung that matters after a worker restart
             ladder = resilience.DegradationLadder(
                 [("serve_session_recompile", sess.invalidate)])
+
+            def _decode():
+                faultinject.site("serve_dispatch")
+                return sess.decode(synd)
+
             with telemetry.span("serve.dispatch"):
-                out = resilience.run_cell(lambda: sess.decode(synd),
-                                          label="serve_dispatch",
+                out = resilience.run_cell(_decode, label="serve_dispatch",
                                           degrade=ladder.step)
         except Exception as exc:  # noqa: BLE001 — answered, not dropped
             self.failed += len(batch)
             telemetry.count("serve.errors", len(batch))
+            err = f"{type(exc).__name__}: {exc}"
             telemetry.event("serve_batch", session=session_name,
                             requests=len(batch), shots=int(synd.shape[0]),
-                            bucket=0, ok=False,
-                            error=f"{type(exc).__name__}: {exc}")
+                            bucket=0, ok=False, error=err)
+            for r in traced:
+                tracing.record_span(
+                    "device_decode", r.trace,
+                    dur_s=time.perf_counter() - t0, ok=False, error=err,
+                    amortized_over=len(batch))
+            # the black box: name EXACTLY the requests that died with this
+            # dispatch, then ship the ring as a postmortem (no-op unless a
+            # postmortem dir is configured)
+            tracing.note_failure(
+                "serve_dispatch_failed", session=session_name, error=err,
+                requests=len(batch), shots=int(synd.shape[0]),
+                request_ids=[r.request_id for r in batch],
+                tenants=sorted({r.tenant for r in batch}))
+            now = time.perf_counter()
             for r in batch:
+                if self.slo is not None:
+                    self.slo.observe_request(r.tenant, now - r.t0, ok=False)
                 r.future.set_exception(exc)
             return
         dispatch_s = time.perf_counter() - t0
+        self._last_dispatch_t = time.monotonic()
         occupancy = out.shots / out.padded_shots if out.padded_shots else 0.0
+        stage_s = out.timings or {}
         now = time.perf_counter()
         lo = 0
         for r in batch:
@@ -304,6 +412,17 @@ class ContinuousBatcher:
                 request_id=r.request_id, latency_s=lat))
             lo = hi
             self.completed += 1
+            if self.slo is not None:
+                self.slo.observe_request(r.tenant, lat, ok=True)
+            if r.trace is not None:
+                # pad / device_decode / slice are BATCH stages; each traced
+                # request records them with the amortization factor so a
+                # span tree stays honest about shared work
+                for stage in ("pad", "device_decode", "slice"):
+                    tracing.record_span(
+                        stage, r.trace, dur_s=float(stage_s.get(stage, 0.0)),
+                        amortized_over=len(batch),
+                        bucket=int(max(out.buckets)), shots=r.shots)
             telemetry.observe("serve.latency_s", lat)
             telemetry.event("serve_request", session=session_name,
                             tenant=r.tenant, shots=r.shots,
@@ -322,6 +441,31 @@ class ContinuousBatcher:
                         tenants=len({r.tenant for r in batch}),
                         wait_s=round(wait_s, 6),
                         dispatch_s=round(dispatch_s, 6), ok=True)
+
+    # ------------------------------------------------------------------
+    # health (the ops plane's /healthz body)
+    # ------------------------------------------------------------------
+    def health(self) -> dict:
+        """Liveness snapshot for ``serve.ops.OpsServer``: queue depth,
+        session-cache occupancy, last-dispatch age, lifetime counters and
+        the draining/stopped flags (which drive the 503)."""
+        with self._cv:
+            depth = self._queued_requests
+            draining, stopped = self._draining, self._stopped
+            completed, failed = self.completed, self.failed
+            last_t = self._last_dispatch_t
+        return {
+            "queue_depth": int(depth),
+            "sessions": len(self.sessions),
+            "session_names": self.sessions.names(),
+            "completed": int(completed),
+            "failed": int(failed),
+            "draining": bool(draining),
+            "stopped": bool(stopped),
+            "last_dispatch_age_s": (
+                None if last_t is None
+                else round(time.monotonic() - last_t, 3)),
+        }
 
     # ------------------------------------------------------------------
     # shutdown

@@ -8,16 +8,26 @@ Wire protocol (no dependencies beyond the stdlib):
 
 Requests (client -> server):
     {"op": "decode", "id": <str>, "session": <name>, "tenant": <str>,
-     "syndromes": [[0,1,...], ...]}
+     "syndromes": [[0,1,...], ...],
+     "trace": {"trace_id": ..., "span_id": ...}}   # OPTIONAL (ISSUE 11)
     {"op": "ping"}
 
 Responses (server -> client; decode responses stream back in COMPLETION
 order, matched by "id" — a slow megabatch never head-of-line-blocks a fast
 one):
     {"id": ..., "ok": true, "corrections": [[...], ...],
-     "converged": [true, ...] | null, "latency_ms": <float>}
-    {"id": ..., "ok": false, "error": "..."}
+     "converged": [true, ...] | null, "latency_ms": <float>,
+     "trace_id": "..."}                            # echoed when traced
+    {"id": ..., "ok": false, "error": "...", "shed": true?}
     {"ok": true, "pong": true, "sessions": [...], "draining": false}
+
+A traced request (optional "trace" field, utils.tracing.TraceContext wire
+shape) gets a ``serve.request`` root span covering submit -> response
+serialized, parented to the client's span; the batcher records the stage
+spans (queue_wait / batch_assemble / pad / device_decode / slice) under
+it and the server adds the ``respond`` span.  A tenant shed by the SLO
+admission signal (serve.ops) is answered with ``"shed": true`` — refused
+loudly and cheaply, never queued and timed out.
 
 JSON keeps the protocol inspectable and dependency-free; the frame layer is
 codec-agnostic, so a binary payload (packed bitplanes) is a drop-in when
@@ -33,12 +43,14 @@ from __future__ import annotations
 import asyncio
 import json
 import threading
+import time
 
 import numpy as np
 
-from ..utils import telemetry
+from ..utils import telemetry, tracing
+from .ops import AdmissionError, spawn_server_loop
 from .scheduler import ContinuousBatcher
-from .wire import HEADER, MAX_FRAME_BYTES, encode_frame
+from .wire import HEADER, MAX_FRAME_BYTES, TRACE_FIELD, encode_frame
 
 __all__ = ["DecodeServer", "ServerHandle", "start_server_thread",
            "MAX_FRAME_BYTES", "encode_frame"]
@@ -128,27 +140,68 @@ class DecodeServer:
 
     async def _handle_decode(self, msg, writer, wlock) -> None:
         rid = msg.get("id")
+        # trace propagation (ISSUE 11): the optional wire field becomes a
+        # request context whose span id IS the serve.request root span —
+        # pre-minted here so the batcher's stage spans parent to it, and
+        # recorded at respond time with the client's span as ITS parent
+        client_ctx = tracing.TraceContext.from_wire(msg.get(TRACE_FIELD))
+        req_ctx = None if client_ctx is None else client_ctx.child()
+        t_accept = time.perf_counter()
         if self._draining:
-            await self._write(writer, wlock, {
-                "id": rid, "ok": False, "error": "server is draining"})
+            # refused like every other rejection: a traced request still
+            # gets its serve.request span and echoed trace id
+            await self._write(writer, wlock, self._rejection(
+                rid, RuntimeError("server is draining"),
+                req_ctx, client_ctx, t_accept))
             return
         try:
             fut = self.batcher.submit(
                 msg["session"],
                 np.asarray(msg["syndromes"], dtype=np.uint8),
                 tenant=str(msg.get("tenant", "default")),
-                request_id=None if rid is None else str(rid))
+                request_id=None if rid is None else str(rid),
+                trace=req_ctx)
+        except AdmissionError as exc:
+            # the SLO gate: shed traffic is answered with a structured
+            # flag so load generators can tell backpressure from bugs
+            await self._write(writer, wlock, self._rejection(
+                rid, exc, req_ctx, client_ctx, t_accept,
+                shed=True, tenant=exc.tenant, burn_rate=exc.burn_rate))
+            return
         except Exception as exc:  # noqa: BLE001 — answered, not dropped
-            await self._write(writer, wlock, {
-                "id": rid, "ok": False,
-                "error": f"{type(exc).__name__}: {exc}"})
+            await self._write(writer, wlock, self._rejection(
+                rid, exc, req_ctx, client_ctx, t_accept))
             return
         task = asyncio.ensure_future(
-            self._respond(rid, fut, writer, wlock))
+            self._respond(rid, fut, writer, wlock,
+                          client_ctx=client_ctx, req_ctx=req_ctx,
+                          t_accept=t_accept))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
-    async def _respond(self, rid, fut, writer, wlock) -> None:
+    @staticmethod
+    def _rejection(rid, exc, req_ctx, client_ctx, t_accept,
+                   **extra) -> dict:
+        """Error payload for a request refused at submit.  A TRACED
+        rejection still gets its serve.request root span (ok=False) and
+        the echoed trace id — the requests an operator most wants to
+        find in /tracez are the ones being refused."""
+        error = f"{type(exc).__name__}: {exc}"
+        payload = {"id": rid, "ok": False, "error": error, **extra}
+        if req_ctx is not None:
+            payload["trace_id"] = req_ctx.trace_id
+            tracing.record_span(
+                "serve.request", req_ctx, span_id=req_ctx.span_id,
+                parent_id=client_ctx.span_id,
+                dur_s=time.perf_counter() - t_accept, ok=False,
+                error=error,
+                **({} if rid is None else {"request_id": str(rid)}))
+        return payload
+
+    async def _respond(self, rid, fut, writer, wlock, *, client_ctx=None,
+                       req_ctx=None, t_accept=0.0) -> None:
+        ok = True
+        error = None
         try:
             res = await asyncio.wrap_future(fut)
             payload = {
@@ -160,12 +213,28 @@ class DecodeServer:
                 "latency_ms": round(res.latency_s * 1e3, 3),
             }
         except Exception as exc:  # noqa: BLE001
-            payload = {"id": rid, "ok": False,
-                       "error": f"{type(exc).__name__}: {exc}"}
+            ok, error = False, f"{type(exc).__name__}: {exc}"
+            payload = {"id": rid, "ok": False, "error": error}
+        if req_ctx is not None:
+            payload["trace_id"] = req_ctx.trace_id
+        t_write = time.perf_counter()
         try:
             await self._write(writer, wlock, payload)
         except (ConnectionError, RuntimeError):
             pass  # client went away; the decode itself completed
+        if req_ctx is not None:
+            now = time.perf_counter()
+            tracing.record_span(
+                "respond", req_ctx, dur_s=now - t_write,
+                **({} if rid is None else {"request_id": str(rid)}))
+            # the request's root span: accept -> response written, with
+            # the pre-minted span id the stage spans already parent to,
+            # itself parented to the CLIENT's span
+            tracing.record_span(
+                "serve.request", req_ctx, span_id=req_ctx.span_id,
+                parent_id=client_ctx.span_id, dur_s=now - t_accept,
+                ok=ok, **({} if error is None else {"error": error}),
+                **({} if rid is None else {"request_id": str(rid)}))
 
     @staticmethod
     async def _write(writer, wlock, obj) -> None:
@@ -259,30 +328,7 @@ class ServerHandle:
 def start_server_thread(batcher: ContinuousBatcher, host: str = "127.0.0.1",
                         port: int = 0) -> ServerHandle:
     """Start a DecodeServer on a daemon thread; returns once it accepts."""
-    loop = asyncio.new_event_loop()
     server = DecodeServer(batcher, host=host, port=port)
-    started = threading.Event()
-    box: dict = {}
-
-    def run():
-        asyncio.set_event_loop(loop)
-        try:
-            try:
-                loop.run_until_complete(server.start())
-            except Exception as exc:  # surface bind failures to the caller
-                box["error"] = exc
-                return
-            started.set()
-            loop.run_forever()
-        finally:
-            started.set()
-            loop.close()  # a failed bind must not leak the loop's fds
-
-    thread = threading.Thread(target=run, daemon=True,
-                              name="qldpc-serve-server")
-    thread.start()
-    if not started.wait(timeout=30.0):
-        raise RuntimeError("decode server failed to start within 30s")
-    if "error" in box:
-        raise box["error"]
+    loop, thread = spawn_server_loop(server.start, "qldpc-serve-server",
+                                     "decode server")
     return ServerHandle(server, loop, thread)

@@ -63,6 +63,11 @@ class DecodeOutput:
     shots: int                       # real request shots decoded
     padded_shots: int                # total padded shots dispatched
     buckets: tuple                   # bucket sizes the decode ran as
+    # per-stage wall clock summed over chunks (pad / device_decode /
+    # slice), consumed by the scheduler's trace spans — a traced request
+    # gets the batch's stage breakdown amortized, untraced callers ignore
+    # it (the perf_counter reads cost nanoseconds against a dispatch)
+    timings: dict | None = None
 
 
 class DecodeSession:
@@ -256,12 +261,16 @@ class DecodeSession:
                 f"{self.syndrome_width}, got {arr.shape[1]}")
         top = self.buckets[-1]
         cors, convs, buckets_used, padded = [], [], [], 0
+        pad_s = device_s = slice_s = 0.0
         for lo in range(0, arr.shape[0], top):
             chunk = arr[lo:lo + top]
             bucket = self.bucket_for(chunk.shape[0])
             prog = self.program(bucket)
+            t0 = time.perf_counter()
             pad = np.zeros((bucket, self.syndrome_width), np.uint8)
             pad[:chunk.shape[0]] = chunk
+            t1 = time.perf_counter()
+            pad_s += t1 - t0
             with telemetry.span("serve.decode"):
                 cor, aux = prog(self.state, jnp.asarray(pad))
                 conv = aux.get("converged")
@@ -272,10 +281,13 @@ class DecodeSession:
                 host = resilience.guarded_fetch(
                     lambda: jax.device_get((cor, conv)),
                     label="serve_fetch")
+            t2 = time.perf_counter()
+            device_s += t2 - t1
             cors.append(np.asarray(host[0])[:chunk.shape[0]])
             convs.append(None if host[1] is None
                          else np.asarray(host[1])[:chunk.shape[0]]
                          .astype(bool))
+            slice_s += time.perf_counter() - t2
             buckets_used.append(bucket)
             padded += bucket
         return DecodeOutput(
@@ -284,7 +296,9 @@ class DecodeSession:
                        else (np.concatenate(convs) if len(convs) > 1
                              else convs[0])),
             shots=int(arr.shape[0]), padded_shots=int(padded),
-            buckets=tuple(buckets_used))
+            buckets=tuple(buckets_used),
+            timings={"pad": pad_s, "device_decode": device_s,
+                     "slice": slice_s})
 
 
 class SessionCache:

@@ -7,16 +7,29 @@ serve/server.py (asyncio) and serve/client.py (blocking sockets) both
 import from here, so a protocol change — e.g. the binary payload codec the
 server docstring anticipates — cannot drift one-sided and silently break
 the wire.
+
+Trace context (ISSUE 11): a decode request MAY carry an OPTIONAL
+``"trace"`` field (``TRACE_FIELD``) holding ``{"trace_id": <hex str>,
+"span_id": <hex str>}`` — the ``utils.tracing.TraceContext`` wire shape.
+Old clients simply omit it and old servers ignore it, so the field is
+backward compatible in both directions; a malformed annotation is dropped
+server-side (``TraceContext.from_wire``), never an error — a bad trace
+must not fail the decode it rides on.  Traced responses echo the trace id
+back as ``"trace_id"`` so a client can join its result to the span tree.
 """
 from __future__ import annotations
 
 import json
 import struct
 
-__all__ = ["HEADER", "MAX_FRAME_BYTES", "encode_frame"]
+__all__ = ["HEADER", "MAX_FRAME_BYTES", "TRACE_FIELD", "encode_frame"]
 
 HEADER = struct.Struct(">I")
 MAX_FRAME_BYTES = 64 * 1024 * 1024  # a malformed length must not OOM us
+
+# the optional trace-context field of a decode request (and the echoed
+# trace id key of its response) — named here so neither end hard-codes it
+TRACE_FIELD = "trace"
 
 
 def encode_frame(obj) -> bytes:

@@ -582,6 +582,10 @@ class SweepRun:
             "cells": self.monitor.cells,
             "fits": self.fits,
             "anomalies": self.monitor.anomalies,
+            # environment provenance (ISSUE 11): lets sweep_dashboard
+            # --drift attribute a cross-round change to a jax/backend/
+            # host bump instead of the physics
+            "env": telemetry.process_info(),
         }
         if self.error is not None:
             record["error"] = self.error

@@ -43,7 +43,7 @@ import json
 import os
 import threading
 
-from . import telemetry
+from . import telemetry, tracing
 from .resilience import TransientFault, sleep_for
 
 __all__ = [
@@ -175,6 +175,10 @@ def _record(fault: Fault, site_name: str) -> None:
     telemetry.count(f"faultinject.{fault.kind}")
     telemetry.event("fault_injected", site=site_name, fault_kind=fault.kind,
                     seed=_ACTIVE.seed if _ACTIVE else 0)
+    # the injection itself goes into the flight-recorder ring so the
+    # postmortem a downstream failure ships names the fault that caused it
+    tracing.flight_record("fault_injected", site=site_name,
+                          fault_kind=fault.kind)
 
 
 def site(name: str) -> None:

@@ -24,7 +24,12 @@ This module is the single sanctioned home for ALL of it:
 Every retry / fail-fast / watchdog fire / degrade emits a telemetry counter
 and a JSONL event (utils.telemetry) plus one structured log line
 (utils.observability.log_record), so recovery behavior is observable and
-identical across parity sweeps, family sweeps, and user code.
+identical across parity sweeps, family sweeps, and user code.  The
+terminal failures — watchdog timeout, ladder degrade, exhausted retries —
+additionally hit the always-on flight recorder (utils.tracing): the ring
+records the failure and, when a postmortem directory is configured, dumps
+the last N in-flight spans/events to a postmortem JSONL, the black box
+explaining what died (ISSUE 11).
 
 Policy resolution: the module-level default policy is built from env vars
 (``QLDPC_RETRY_ATTEMPTS`` / ``QLDPC_RETRY_BASE_S`` / ``QLDPC_WATCHDOG_SECS``)
@@ -41,7 +46,7 @@ import random
 import threading
 import time
 
-from . import telemetry
+from . import telemetry, tracing
 
 __all__ = [
     "TransientFault",
@@ -158,6 +163,9 @@ class DegradationLadder:
         telemetry.count("resilience.degrades")
         telemetry.event("degrade", rung=name)
         _log("degrade", rung=name)
+        # black box: a degrade means a rung died — ship the in-flight ring
+        # (no-op unless a postmortem directory is configured)
+        tracing.note_failure("degrade", rung=name)
         # the statistical-observability monitor is notified DIRECTLY (not
         # via the event stream) so ladder anomalies fire in ledger-only
         # runs where telemetry is disabled
@@ -251,6 +259,8 @@ class RetryPolicy:
                     telemetry.count("resilience.deterministic_failures")
                     telemetry.event("fail_fast", label=label, error=summary)
                     _log("fail_fast", label=label, error=summary)
+                    tracing.flight_record("fail_fast", label=label,
+                                          error=summary)
                     raise
                 if kind == "resource":
                     # retrying the SAME rung cannot help (same program ->
@@ -278,6 +288,8 @@ class RetryPolicy:
                                     attempts=failures, error=summary)
                     _log("retry_exhausted", label=label, attempts=failures,
                          error=summary)
+                    tracing.note_failure("retry_exhausted", label=label,
+                                         attempts=failures, error=summary)
                     raise
                 if kind == "transient" and degrade is not None \
                         and failures % self.degrade_after == 0:
@@ -288,6 +300,8 @@ class RetryPolicy:
                                 wait_s=round(wait, 3), error=summary)
                 _log("retry", label=label, attempt=failures,
                      wait_s=round(wait, 3), error=summary)
+                tracing.flight_record("retry", label=label, attempt=failures,
+                                      error=summary)
                 if self.reset_caches:
                     try:
                         _reset_device_caches()
@@ -404,6 +418,8 @@ def fetch_with_watchdog(fn, *, label: str = "", timeout_s: float | None = None):
     telemetry.event("watchdog_timeout", label=label,
                     timeout_s=float(timeout_s))
     _log("watchdog_timeout", label=label, timeout_s=float(timeout_s))
+    tracing.note_failure("watchdog_timeout", label=label,
+                         timeout_s=float(timeout_s))
     raise WatchdogTimeout(
         f"host fetch {label or 'fetch'!r} exceeded {timeout_s}s "
         "(hung device->host transfer — dead or wedged worker)")

@@ -44,8 +44,10 @@ __all__ = [
     "count", "observe", "set_gauge", "span", "event",
     "counter", "gauge", "histogram", "snapshot", "prometheus_text",
     "registry", "add_sink", "remove_sink", "JsonlSink", "MemorySink",
-    "write_snapshot_event", "compile_stats",
-    "ITER_BUCKETS", "TELE_LEN", "device_tele_vec", "publish_device_tele",
+    "write_snapshot_event", "compile_stats", "process_info",
+    "ITER_BUCKETS", "LATENCY_BUCKETS", "set_default_buckets",
+    "default_buckets",
+    "TELE_LEN", "device_tele_vec", "publish_device_tele",
     "record_bp_aux",
     "EVENT_SCHEMA_VERSION", "EVENT_SCHEMAS", "validate_event",
 ]
@@ -65,6 +67,53 @@ DEFAULT_TIME_BUCKETS = (
 # shared by the device telemetry vector and the host-side recorder so the
 # two accumulation paths merge into ONE registry histogram
 ITER_BUCKETS = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64)
+
+# request-latency histogram edges: log-spaced, 4 per decade, 0.1 ms .. 10 s.
+# The DEFAULT_TIME_BUCKETS half-decade ladder was built for dispatch spans;
+# at TPU decode speeds an entire serve latency distribution lands inside
+# one or two of its buckets and the interpolated p50/p99 are useless —
+# these edges resolve sub-ms tails while still covering multi-second
+# stalls (ISSUE 11 satellite).
+LATENCY_BUCKETS = tuple(
+    round(10.0 ** (-4 + k / 4.0), 10) for k in range(21))
+
+# per-metric default bucket boundaries, consulted by ``histogram`` /
+# ``observe`` when the call site passes buckets=None: call sites stay
+# one-liners while operators retune boundaries process-wide
+# (``set_default_buckets`` or the QLDPC_HIST_BUCKETS env var, a JSON
+# object {"metric.name": [edge, ...]}).
+_BUCKET_SPECS: dict = {}
+
+
+def set_default_buckets(name: str, buckets) -> None:
+    """Register default histogram boundaries for ``name`` (None removes
+    the spec).  Takes effect for histograms not yet created — an existing
+    histogram keeps its boundaries (counts cannot be rebucketed)."""
+    if buckets is None:
+        _BUCKET_SPECS.pop(str(name), None)
+    else:
+        _BUCKET_SPECS[str(name)] = tuple(float(b) for b in buckets)
+
+
+def default_buckets(name: str):
+    """The registered default boundaries for ``name`` (None = the global
+    DEFAULT_TIME_BUCKETS ladder)."""
+    return _BUCKET_SPECS.get(str(name))
+
+
+def _install_env_bucket_specs() -> None:
+    text = os.environ.get("QLDPC_HIST_BUCKETS", "").strip()
+    if not text:
+        return
+    try:
+        spec = json.loads(text)
+        for name, edges in spec.items():
+            set_default_buckets(name, edges)
+    except (ValueError, TypeError, AttributeError):
+        import warnings
+
+        warnings.warn("QLDPC_HIST_BUCKETS is not a JSON object of "
+                      "{metric: [edges]}; ignoring", stacklevel=1)
 
 
 class Counter:
@@ -207,6 +256,7 @@ class MetricsRegistry:
 _REGISTRY = MetricsRegistry()
 _ENABLED = False            # the single hot-path check
 _SINKS: list = []
+_SINKS_SNAPSHOT: tuple = ()  # lock-free read copy for the event hot path
 _SINK_LOCK = threading.Lock()
 _SPAN_STACK = threading.local()
 
@@ -228,6 +278,8 @@ def gauge(name: str) -> Gauge:
 
 
 def histogram(name: str, buckets=None) -> Histogram:
+    if buckets is None:
+        buckets = _BUCKET_SPECS.get(name)
     return _REGISTRY.histogram(name, buckets)
 
 
@@ -258,6 +310,8 @@ def set_gauge(name: str, value) -> None:
 def observe(name: str, value, buckets=None) -> None:
     if not _ENABLED:
         return
+    if buckets is None:
+        buckets = _BUCKET_SPECS.get(name)
     _REGISTRY.histogram(name, buckets).observe(value)
 
 
@@ -321,12 +375,15 @@ def span(name: str):
 def event(kind: str, **fields) -> None:
     """Emit one structured run event to every installed sink (JSONL etc.).
     No-op when disabled."""
-    if not _ENABLED:
+    # sink emission is this function's ONLY effect, so no sinks = a pure
+    # no-op — return before building the record (the traced serve path
+    # emits thousands of events per second).  _SINKS_SNAPSHOT is an
+    # immutable tuple swapped whole under the sink lock; reading the
+    # reference is GIL-atomic, so the hot path pays no lock.
+    if not _ENABLED or not _SINKS_SNAPSHOT:
         return
     rec = {"ts": round(time.time(), 6), "kind": kind, **fields}
-    with _SINK_LOCK:
-        sinks = list(_SINKS)
-    for s in sinks:
+    for s in _SINKS_SNAPSHOT:
         try:
             s.emit(rec)
         except Exception:  # a broken sink must not kill the run
@@ -356,7 +413,14 @@ def event(kind: str, **fields) -> None:
 # ``cell_progress`` fields (log_weight_sum, ess, ess_failures, tilt) —
 # all OPTIONAL, so direct-MC events validate unchanged.  The v1 AND v2
 # kind sets are frozen below; the back-compat test extends to both.
-EVENT_SCHEMA_VERSION = 3
+#
+# v4 (ISSUE 11): the operational-observability layer adds ``trace`` (one
+# per request span — utils.tracing), ``slo_alert`` (serve.ops burn-rate
+# engine signal transitions) and ``process_info`` (once-per-enable
+# environment provenance so cross-round drift can be attributed to
+# jax/backend/host changes).  Purely additive again — the v1/v2/v3 kind
+# sets are frozen below and the back-compat tests cover all three.
+EVENT_SCHEMA_VERSION = 4
 
 # the v1 kind set, frozen for the back-compat guarantee: these kinds and
 # their required fields must keep validating across schema bumps
@@ -371,6 +435,9 @@ _V1_EVENT_KINDS = frozenset({
 _V2_EVENT_KINDS = frozenset({
     "serve_session", "serve_request", "serve_batch", "serve_drain",
 })
+
+# the v3 additions, frozen with the same guarantee at the v4 bump
+_V3_EVENT_KINDS = frozenset({"rare_stratum"})
 
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
@@ -510,6 +577,38 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "weight": _NUM, "rate": _NUM},
         "optional": {"contribution": _NUM},
     },
+    # --- v4: operational observability (ISSUE 11) -------------------------
+    # one request stage (utils.tracing.record_span): queue_wait /
+    # batch_assemble / pad / device_decode / slice / respond plus the
+    # server-side serve.request root — the span tree /tracez and the
+    # JSONL stream reassemble per trace id
+    "trace": {
+        "required": {"trace_id": str, "span_id": str, "name": str,
+                     "dur_s": _NUM},
+        "optional": {"parent_id": _OPT_STR, "t0": _NUM, "session": str,
+                     "tenant": str, "request_id": _OPT_STR, "shots": int,
+                     "requests": int, "bucket": int, "amortized_over": int,
+                     "ok": bool, "error": str},
+    },
+    # an SLO burn-rate signal transition (serve.ops.SLOEngine): the
+    # admission state the batcher consumes for the named tenant changed
+    "slo_alert": {
+        "required": {"tenant": str, "signal": str},
+        "optional": {"prev_signal": str, "burn_rate": _NUM,
+                     "burn_latency": _NUM, "burn_error": _NUM,
+                     "objective": str, "window_s": _NUM, "requests": int,
+                     "bad_fraction": _NUM, "queue_depth": int},
+    },
+    # environment provenance, once per telemetry enable (and embedded in
+    # every RunLedger record): lets sweep_dashboard --drift and
+    # bench_compare attribute cross-round drift to environment changes
+    "process_info": {
+        "required": {"pid": int, "hostname": str},
+        "optional": {"git_sha": _OPT_STR, "jax": _OPT_STR,
+                     "jaxlib": _OPT_STR, "backend": _OPT_STR,
+                     "python": _OPT_STR, "platform": _OPT_STR,
+                     "schema_version": int},
+    },
 }
 
 
@@ -589,14 +688,18 @@ class MemorySink:
 
 
 def add_sink(sink) -> None:
+    global _SINKS_SNAPSHOT
     with _SINK_LOCK:
         _SINKS.append(sink)
+        _SINKS_SNAPSHOT = tuple(_SINKS)
 
 
 def remove_sink(sink) -> None:
+    global _SINKS_SNAPSHOT
     with _SINK_LOCK:
         if sink in _SINKS:
             _SINKS.remove(sink)
+        _SINKS_SNAPSHOT = tuple(_SINKS)
 
 
 def write_snapshot_event(**extra_fields) -> dict:
@@ -606,6 +709,90 @@ def write_snapshot_event(**extra_fields) -> dict:
     stats = compile_stats()
     event("snapshot", metrics=snap, compile=stats, **extra_fields)
     return snap
+
+
+# ---------------------------------------------------------------------------
+# Process provenance
+# ---------------------------------------------------------------------------
+_PROCESS_INFO: dict | None = None
+_PROCESS_INFO_LOCK = threading.Lock()
+
+
+def _git_sha() -> str | None:
+    sha = os.environ.get("QLDPC_GIT_SHA", "").strip()
+    if sha:
+        return sha
+    try:
+        import subprocess
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        out = subprocess.run(
+            ["git", "-C", repo, "rev-parse", "--short=12", "HEAD"],
+            capture_output=True, text=True, timeout=5.0)
+        if out.returncode == 0 and out.stdout.strip():
+            return out.stdout.strip()
+    except Exception:
+        pass
+    return None
+
+
+def _fill_jax_info(info: dict) -> None:
+    """Fill the jax/jaxlib/backend fields when jax is ALREADY imported,
+    and the backend only once one is ALREADY initialized — provenance
+    must never import jax or trigger a backend initialization of its
+    own (on a TPU host that would block for seconds, grab the chip, and
+    lock in the platform choice before the program configures it)."""
+    import sys as _sys
+
+    if "jax" not in _sys.modules:
+        return
+    try:
+        import jax
+        import jaxlib
+
+        info["jax"] = str(jax.__version__)
+        info["jaxlib"] = str(getattr(jaxlib, "__version__", None))
+        bridge = _sys.modules.get("jax._src.xla_bridge")
+        if getattr(bridge, "_backends", None):
+            # backend cache non-empty: default_backend() is a cheap read
+            info["backend"] = str(jax.default_backend())
+    except Exception:
+        pass
+
+
+def process_info(refresh: bool = False) -> dict:
+    """Environment provenance for drift attribution: pid, hostname, git
+    SHA, jax/jaxlib versions, backend, python/platform strings.  Cached
+    per process (one git subprocess, ever); emitted as a ``process_info``
+    event on every ``enable()`` and embedded in run-ledger records so
+    ``sweep_dashboard --drift`` / ``bench_compare`` can tell an
+    environment change from a physics regression.  jax fields are
+    best-effort and only consulted when jax is ALREADY imported —
+    provenance must not trigger a backend initialization of its own."""
+    global _PROCESS_INFO
+    with _PROCESS_INFO_LOCK:
+        if _PROCESS_INFO is None or refresh:
+            import platform as _platform
+
+            _PROCESS_INFO = {
+                "pid": os.getpid(),
+                "hostname": _platform.node() or "unknown",
+                "python": _platform.python_version(),
+                "platform": _platform.platform(),
+                "git_sha": _git_sha(),
+                "jax": None, "jaxlib": None, "backend": None,
+                "schema_version": EVENT_SCHEMA_VERSION,
+            }
+        if _PROCESS_INFO["jax"] is None or _PROCESS_INFO["backend"] is None:
+            # an enable() that ran before the first jax import (or before
+            # backend init) cached None here; re-probe so later ledger
+            # records and /varz carry the real versions — still never
+            # importing jax or initializing a backend ourselves
+            _fill_jax_info(_PROCESS_INFO)
+        out = dict(_PROCESS_INFO)
+    out["pid"] = os.getpid()  # survive fork: everything else is host-level
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -650,6 +837,9 @@ def enable(jsonl_path: str | None = None) -> None:
         add_sink(s)
     _ENABLED = True
     event("telemetry_enabled", pid=os.getpid())
+    # provenance rides every stream's head so any JSONL artifact can be
+    # attributed to the environment that produced it (ISSUE 11 satellite)
+    event("process_info", **process_info())
 
 
 def disable() -> None:
@@ -899,6 +1089,15 @@ def publish_device_tele(vec) -> None:
     if it_sum < 0:  # int32 carry slot wrapped (see TELE_ITER_SUM bound)
         it_sum = _approx_iter_sum(counts)
     hist.merge_counts(counts, it_sum, int(counts.sum()))
+
+
+# metric-specific default boundaries: the serve latency histogram gets the
+# log-spaced ladder (p50/p99 stay meaningful at sub-ms decode latencies);
+# operators may retune any metric via QLDPC_HIST_BUCKETS (applied last, so
+# the env wins over the shipped specs)
+set_default_buckets("serve.latency_s", LATENCY_BUCKETS)
+set_default_buckets("serve.batch_wait_s", LATENCY_BUCKETS)
+_install_env_bucket_specs()
 
 
 def record_bp_aux(aux) -> None:

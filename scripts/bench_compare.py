@@ -66,10 +66,17 @@ GATED_FIELDS = (
     "vrf_equal_shots",
     "vrf_fixed_wallclock",
     "weighted_shots_per_s",
+    # request tracing (bench.py serve tracing A/B, ISSUE 11): the TRACED
+    # arm's throughput is the robust regression signal (overhead_pct sits
+    # near zero where percent-change gating is meaningless); its tail
+    # latency gates on increases.  Rounds before r06 lack the keys, so
+    # the checked-in history gates unchanged.
+    "tracing_ab.traced_shots_per_s",
+    "tracing_ab.traced_p99_ms",
 )
 
 # gated fields where a RISE is the regression (latencies)
-LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms"})
+LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms"})
 
 
 def _dig(d: dict, dotted: str):

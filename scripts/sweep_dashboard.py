@@ -351,7 +351,30 @@ def drift_report(records: list[dict]) -> dict | None:
         "fingerprint": cur.get("fingerprint"),
         "cells": rows,
         "max_abs_z": max((abs(r["z"]) for r in rows), default=0.0),
+        "env_changes": _env_changes(prior, cur),
     }
+
+
+# provenance keys worth flagging between runs (utils.telemetry
+# process_info; pid churns per process and means nothing for drift)
+_ENV_DRIFT_KEYS = ("git_sha", "jax", "jaxlib", "backend", "hostname",
+                   "python")
+
+
+def _env_changes(prior: dict, cur: dict) -> list[dict]:
+    """Provenance deltas between two ledger records' ``env`` blocks
+    (ISSUE 11): a WER shift that coincides with a jax/backend/host change
+    is an environment story, not a physics regression.  Records from
+    before the env block simply compare as no-change."""
+    a, b = prior.get("env"), cur.get("env")
+    if not a or not b:
+        # a record from before the env block carries no provenance to
+        # compare against — flagging every key as "changed" would blame
+        # the environment for drift on the first post-upgrade report
+        return []
+    return [{"key": k, "prior": a.get(k), "now": b.get(k)}
+            for k in _ENV_DRIFT_KEYS
+            if a.get(k) != b.get(k) and (a.get(k) or b.get(k))]
 
 
 def render_drift(report: dict) -> str:
@@ -364,6 +387,14 @@ def render_drift(report: dict) -> str:
         L.append(f"  {name:<44}{r['rate_prior']:>12.3e}"
                  f"{r['rate_now']:>12.3e}{r['z']:>8.2f}")
     L.append(f"max |z| = {report['max_abs_z']:.2f}")
+    changes = report.get("env_changes") or []
+    if changes:
+        L.append("environment changed between runs (drift may not be "
+                 "physics):")
+        for c in changes:
+            L.append(f"  {c['key']}: {c['prior']} -> {c['now']}")
+    else:
+        L.append("environment unchanged between runs")
     return "\n".join(L)
 
 

@@ -5,6 +5,8 @@ summary table.
     python scripts/telemetry_report.py RUN.jsonl --json     # summary json
     python scripts/telemetry_report.py RUN.jsonl --prometheus
     python scripts/telemetry_report.py RUN.jsonl --follow   # live re-render
+    python scripts/telemetry_report.py RUN.jsonl --traces   # slow/errored
+    python scripts/telemetry_report.py RUN.jsonl --trace ID # one span tree
 
 The stream is the one ``telemetry.enable(jsonl_path=...)`` (or
 ``QLDPC_TELEMETRY_JSONL=...``) writes: ``wer_run`` / ``cell_done`` events as
@@ -16,6 +18,13 @@ registry + compile stats (``telemetry.write_snapshot_event`` /
 incrementally (a partially-flushed tail line is left for the next poll)
 and the table re-renders in place every ``--interval`` seconds until
 Ctrl-C — no need to wait for the run to finish.
+
+``--traces`` / ``--trace ID`` (ISSUE 11) query the per-request ``trace``
+events the serve stack emits (utils.tracing): ``--traces`` lists recent
+traces newest-first (``--slow-ms`` / ``--errored`` filter like
+``/tracez``); ``--trace ID`` renders one request's full span tree —
+queue_wait / batch_assemble / pad / device_decode / slice / respond under
+its serve.request root — from the JSONL alone.
 """
 from __future__ import annotations
 
@@ -273,6 +282,52 @@ def summary_from_state(state: dict) -> dict:
     }
 
 
+def render_trace_tree(spans: list[dict]) -> str:
+    """One trace's spans as an indented tree (the --trace view): name,
+    duration, amortization factor and error per span."""
+    from qldpc_fault_tolerance_tpu.utils import tracing
+
+    tree = tracing.trace_tree(spans)
+
+    def _line(node, depth):
+        s = node["span"]
+        parts = [f"{'  ' * depth}{s.get('name')}",
+                 f"{1e3 * float(s.get('dur_s', 0.0)):.3f} ms"]
+        if s.get("amortized_over", 1) not in (None, 1):
+            parts.append(f"(amortized /{s['amortized_over']})")
+        if s.get("ok") is False or s.get("error"):
+            parts.append(f"ERROR: {s.get('error', '?')}")
+        rows.append("  ".join(parts))
+        for child in sorted(node["children"],
+                            key=lambda n: n["span"].get("ts") or 0.0):
+            _line(child, depth + 1)
+
+    rows: list[str] = []
+    for root in tree["roots"]:
+        _line(root, 0)
+    return "\n".join(rows) if rows else "(no spans)"
+
+
+def render_traces(events: list[dict], *, limit: int = 50,
+                  slow_s=None, errored_only: bool = False) -> str:
+    """Recent traces, newest-first (the --traces view)."""
+    from qldpc_fault_tolerance_tpu.utils import tracing
+
+    rows = tracing.trace_summaries(events, limit=limit, slow_s=slow_s,
+                                   errored_only=errored_only)
+    if not rows:
+        return "(no trace events)"
+    L = [f"{'trace_id':<34}{'spans':>6}{'max_ms':>10}{'total_ms':>10}"
+         f"  names"]
+    for r in rows:
+        L.append(f"{r['trace_id']:<34}{r['spans']:>6}"
+                 f"{1e3 * r['max_dur_s']:>10.3f}"
+                 f"{1e3 * r['total_dur_s']:>10.3f}"
+                 f"  {','.join(r['names'])}"
+                 + ("  [ERRORED]" if r["errored"] else ""))
+    return "\n".join(L)
+
+
 def _bar(n: int, peak: int, width: int = 30) -> str:
     return "#" * max(1 if n else 0, round(width * n / peak)) if peak else ""
 
@@ -372,15 +427,45 @@ def main(argv=None) -> int:
                          "(Ctrl-C to stop)")
     ap.add_argument("--interval", type=float, default=1.0,
                     help="--follow poll interval in seconds (default 1)")
+    ap.add_argument("--traces", action="store_true",
+                    help="list recent traces (newest-first) from the "
+                         "stream's trace events")
+    ap.add_argument("--trace", metavar="ID",
+                    help="render one trace id's span tree")
+    ap.add_argument("--slow-ms", type=float, default=None,
+                    help="--traces: only traces with a span at least this "
+                         "slow")
+    ap.add_argument("--errored", action="store_true",
+                    help="--traces: only traces with an errored span")
     args = ap.parse_args(argv)
 
     if args.follow:
+        if args.traces or args.trace:
+            # silently rendering the summary instead of the asked-for
+            # trace view would be the wrong output with no explanation
+            ap.error("--traces/--trace are not supported with --follow; "
+                     "run them against the stream without --follow")
         return follow(args.jsonl, args.interval)
 
     events = load_events(args.jsonl)
     if not events:
         print(f"no events in {args.jsonl}", file=sys.stderr)
         return 1
+    if args.trace:
+        from qldpc_fault_tolerance_tpu.utils import tracing
+
+        spans = tracing.traces_from_records(events).get(args.trace, [])
+        if not spans:
+            print(f"no spans for trace {args.trace!r}", file=sys.stderr)
+            return 1
+        print(render_trace_tree(spans))
+        return 0
+    if args.traces:
+        print(render_traces(
+            events, slow_s=(None if args.slow_ms is None
+                            else args.slow_ms / 1e3),
+            errored_only=args.errored))
+        return 0
     summary = summarize(events)
     if args.prometheus:
         from qldpc_fault_tolerance_tpu.utils import telemetry

@@ -479,6 +479,39 @@ def test_dashboard_drift_compare_and_gate(tmp_path):
     assert dash.main([str(tmp_path), "--drift", "--gate", "100"]) == 0
 
 
+def test_ledger_records_carry_env_and_drift_flags_changes(tmp_path):
+    """Provenance satellite (ISSUE 11): sweep_run embeds the process_info
+    block in every ledger record, and --drift surfaces environment deltas
+    between the compared runs so a WER shift that coincides with a
+    jax/backend/host change reads as an environment story."""
+    dash = importlib.import_module("scripts.sweep_dashboard")
+    led = diagnostics.RunLedger(str(tmp_path))
+    with diagnostics.sweep_run({"grid": 1}, ledger=led) as run:
+        run.note_cell(_cell_key(0.02), 0.01,
+                      diagnostics.ci_fields(10, 1000))
+    rec = led.load()[-1]
+    assert rec["env"]["pid"] == os.getpid()
+    assert rec["env"]["hostname"]
+    # same env: drift reports no changes
+    led.append(_synthetic_ledger_record("r1", "fp", [10, 20]))
+    led.append(_synthetic_ledger_record("r2", "fp", [12, 21]))
+    report = dash.drift_report(led.load())
+    assert report["env_changes"] == []
+    assert "environment unchanged" in dash.render_drift(report)
+    # a jax bump between runs is flagged by key with both values
+    r3 = _synthetic_ledger_record("r3", "fp", [12, 21])
+    r3["env"] = {"jax": "0.4.37", "git_sha": "aaa"}
+    r4 = _synthetic_ledger_record("r4", "fp", [13, 20])
+    r4["env"] = {"jax": "0.5.0", "git_sha": "aaa"}
+    led.append(r3)
+    led.append(r4)
+    report = dash.drift_report(led.load())
+    assert report["env_changes"] == [
+        {"key": "jax", "prior": "0.4.37", "now": "0.5.0"}]
+    text = dash.render_drift(report)
+    assert "environment changed" in text and "0.5.0" in text
+
+
 # ---------------------------------------------------------------------------
 # telemetry_report --follow
 # ---------------------------------------------------------------------------

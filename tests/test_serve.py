@@ -746,6 +746,39 @@ def test_v2_events_still_validate_after_v3_bump():
         assert telemetry.validate_event(rec) == [], (kind, fields)
 
 
+def test_v3_events_still_validate_after_v4_bump():
+    """The v4 (operational observability) bump is additive: the frozen v3
+    rare-event kind still validates, the three kind sets stay disjoint,
+    and representative v4 events validate."""
+    v3_samples = {
+        "rare_stratum": {"stratum": 3, "shots": 100, "failures": 2,
+                         "weight": 0.01, "rate": 0.02},
+    }
+    assert set(v3_samples) == set(telemetry._V3_EVENT_KINDS)
+    assert telemetry.EVENT_SCHEMA_VERSION >= 4
+    frozen = (telemetry._V1_EVENT_KINDS, telemetry._V2_EVENT_KINDS,
+              telemetry._V3_EVENT_KINDS)
+    for i, a in enumerate(frozen):
+        for b in frozen[i + 1:]:
+            assert not (a & b)
+    for kind, fields in v3_samples.items():
+        rec = {"ts": 1.0, "kind": kind, **fields}
+        assert telemetry.validate_event(rec) == [], (kind, fields)
+    v4_samples = {
+        "trace": {"trace_id": "t", "span_id": "s", "name": "queue_wait",
+                  "dur_s": 0.01, "parent_id": "p", "tenant": "t0",
+                  "amortized_over": 4, "ok": True},
+        "slo_alert": {"tenant": "t0", "signal": "shed",
+                      "prev_signal": "admit", "burn_rate": 8.5,
+                      "objective": "latency", "window_s": 30.0},
+        "process_info": {"pid": 1, "hostname": "h", "git_sha": None,
+                         "jax": "0.4.37", "backend": "cpu"},
+    }
+    for kind, fields in v4_samples.items():
+        rec = {"ts": 1.0, "kind": kind, **fields}
+        assert telemetry.validate_event(rec) == [], (kind, fields)
+
+
 # ---------------------------------------------------------------------------
 # Satellite: report + dashboard render serve events instead of dropping them
 # ---------------------------------------------------------------------------
@@ -816,3 +849,42 @@ def test_bench_compare_gates_serve_qps_and_p99(tmp_path):
     bad = [write_round(5, 500.0, 100.0, 8000.0),
            write_round(6, 300.0, 100.0, 8000.0)]
     assert bench_compare.main(bad + ["--gate", "--tolerance", "10"]) == 1
+
+
+def test_bench_compare_gates_tracing_ab_fields(tmp_path):
+    """ISSUE 11 satellite: the tracing A/B's robust companions join the
+    regression ledger — traced throughput regresses DOWN, traced tail
+    latency regresses UP; rounds without the block still gate."""
+    import importlib
+
+    bench_compare = importlib.import_module("bench_compare")
+
+    def write_round(n, traced_sps, traced_p99):
+        obj = {"schema": 2, "round": n,
+               "result": {"metric": "decode-service sustained QPS",
+                          "value": 500.0, "unit": "req/s",
+                          "tracing_ab": {
+                              "traced_shots_per_s": traced_sps,
+                              "traced_p99_ms": traced_p99,
+                              "overhead_pct": 1.0,
+                              "overhead_le_2pct": True}}}
+        p = tmp_path / f"BENCH_TRACE_r{n:02d}.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    # traced-arm throughput collapse fires
+    bad = [write_round(1, 8000.0, 100.0), write_round(2, 4000.0, 100.0)]
+    assert bench_compare.main(bad + ["--gate", "--tolerance", "10"]) == 1
+    # traced-arm tail-latency blowup fires
+    slow = [write_round(3, 8000.0, 100.0), write_round(4, 8000.0, 300.0)]
+    assert bench_compare.main(slow + ["--gate", "--tolerance", "10"]) == 1
+    # healthy pair passes; a legacy round without the block still gates
+    ok = [write_round(5, 8000.0, 100.0), write_round(6, 8100.0, 95.0)]
+    assert bench_compare.main(ok + ["--gate", "--tolerance", "10"]) == 0
+    legacy = {"schema": 2, "round": 7,
+              "result": {"metric": "decode-service sustained QPS",
+                         "value": 505.0, "unit": "req/s"}}
+    p7 = tmp_path / "BENCH_TRACE_r07.json"
+    p7.write_text(json.dumps(legacy))
+    assert bench_compare.main([ok[1], str(p7),
+                               "--gate", "--tolerance", "10"]) == 0

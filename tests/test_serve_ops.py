@@ -1,0 +1,557 @@
+"""Live ops plane + end-to-end tracing tests (ISSUE 11): SLO burn-rate
+evaluation and shed/defer admission signals (engine-level, batcher-level,
+and through the TCP front-end), deferred-tenant batch assembly, the
+/metrics /healthz /varz /tracez endpoints (direct and over live HTTP),
+the full traced-request span tree through the real TCP stack (retrievable
+by trace id from the JSONL stream and /tracez, with zero warm-path
+retraces), and the flight-recorder postmortem a faultinject-killed
+dispatch ships naming the in-flight requests."""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from concurrent.futures import Future
+
+import numpy as np
+import pytest
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+from qldpc_fault_tolerance_tpu.serve import (
+    AdmissionError,
+    ContinuousBatcher,
+    DecodeClient,
+    DecodeSession,
+    OpsServer,
+    SLOEngine,
+    SLOPolicy,
+    assemble_round_robin,
+    start_ops_thread,
+    start_server_thread,
+)
+from qldpc_fault_tolerance_tpu.serve.scheduler import _Request, _SessionQueue
+from qldpc_fault_tolerance_tpu.utils import faultinject, telemetry, tracing
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+DEC_CLS = BP_Decoder_Class(4, "minimum_sum", 0.625)
+CODE3 = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+P = 0.05
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    telemetry.reset()
+    tracing.recorder().clear()
+    tracing.configure(postmortem_dir="")
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    tracing.recorder().clear()
+    tracing.configure(postmortem_dir="")
+
+
+def _session(code=CODE3, buckets=(8, 32)):
+    return DecodeSession(code.name, decoder_class=DEC_CLS,
+                         params={"h": code.hx, "p_data": P},
+                         buckets=buckets)
+
+
+def _synd(code, k, rng):
+    err = (rng.random((k, code.N)) < P).astype(np.uint8)
+    return (err @ np.asarray(code.hx, np.uint8).T % 2).astype(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# SLO engine: burn rates, transitions, admission
+# ---------------------------------------------------------------------------
+def _engine(**pol):
+    pol.setdefault("min_requests", 10)
+    pol.setdefault("eval_interval_s", 0.0)
+    return SLOEngine(SLOPolicy(**pol))
+
+
+def test_burn_rate_math_latency_objective():
+    """100 requests, 4 over the latency target, 1% budget -> burn 4.0:
+    the defer band (>=2, <6) with the default thresholds."""
+    slo = _engine()
+    for i in range(100):
+        lat = 10.0 if i < 4 else 0.001
+        slo.observe_request("t", lat, ok=True, now=100.0)
+    report = slo.evaluate(now=100.0)["t"]
+    assert report["burn_rate"] == pytest.approx(4.0)
+    assert report["objective"] == "latency"
+    assert report["signal"] == "defer"
+    assert slo.admission("t", now=100.0) == "defer"
+    assert slo.deferred_tenants() == frozenset({"t"})
+
+
+def test_burn_rate_shed_and_error_objective():
+    slo = _engine()
+    for i in range(50):
+        slo.observe_request("t", 0.001, ok=(i % 2 == 0), now=5.0)
+    report = slo.evaluate(now=5.0)["t"]
+    # 50% errors against a 0.1% budget: deep into shed
+    assert report["objective"] == "errors"
+    assert report["signal"] == "shed"
+    with pytest.raises(AdmissionError) as exc:
+        slo.check_admission("t", now=5.0)
+    assert exc.value.tenant == "t"
+    assert exc.value.burn_rate > 6.0
+
+
+def test_cold_tenant_and_stale_window_admit():
+    slo = _engine(min_requests=20)
+    for _ in range(5):  # below min_requests: judged on nothing
+        slo.observe_request("cold", 99.0, now=1.0)
+    assert slo.evaluate(now=1.0)["cold"]["signal"] == "admit"
+    slo2 = _engine()
+    for _ in range(50):
+        slo2.observe_request("old", 99.0, now=1.0)
+    assert slo2.evaluate(now=1.0)["old"]["signal"] == "shed"
+    # the same observations aged out of the rolling window: the tenant
+    # recovers AND its state is garbage-collected from the report
+    assert "old" not in slo2.evaluate(now=1000.0)
+    assert slo2.admission("old", now=1000.0) == "admit"
+
+
+def test_tenant_state_is_bounded_and_stale_tenants_gc():
+    """Tenant names are wire input: beyond max_tenants new names are not
+    judged (admitted, counted as overflow), and tenants whose whole
+    window aged out are garbage-collected — a quiet shed tenant gets its
+    recovery transition on the way out."""
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    slo = _engine(max_tenants=2)
+    for _ in range(50):
+        slo.observe_request("t0", 99.0, now=1.0)
+        slo.observe_request("t1", 1e-4, now=1.0)
+        slo.observe_request("overflow", 99.0, now=1.0)  # beyond the cap
+    assert slo.evaluate(now=1.0)["t0"]["signal"] == "shed"
+    assert "overflow" not in slo._windows
+    assert slo.admission("overflow", now=1.0) == "admit"
+    assert telemetry.snapshot()[
+        "serve.slo.tenant_overflow"]["value"] == 50
+    # both tenants age out: state drops to zero and the shed tenant
+    # transitions back to admit
+    report = slo.evaluate(now=1000.0)
+    assert report == {}
+    assert slo._windows == {} and slo._signals == {}
+    alerts = [e for e in sink.records if e["kind"] == "slo_alert"]
+    assert ("shed", "admit") in {(a["prev_signal"], a["signal"])
+                                 for a in alerts}
+    # a returning tenant is judged fresh
+    for _ in range(50):
+        slo.observe_request("t0", 1e-4, now=1000.0)
+    assert slo.evaluate(now=1000.0)["t0"]["signal"] == "admit"
+
+
+def test_deferred_tenants_safe_against_concurrent_evaluate():
+    """deferred_tenants() snapshots under the engine lock: a first-ever
+    tenant's evaluate() inserting keys concurrently must never
+    RuntimeError the scheduler loop's iteration."""
+    import threading
+
+    slo = _engine(min_requests=1)
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        i = 0
+        while not stop.is_set():
+            for _ in range(2):
+                slo.observe_request(f"t{i}", 99.0, now=float(i))
+            slo.evaluate(now=float(i))
+            i += 1
+
+    def read():
+        try:
+            while not stop.is_set():
+                slo.deferred_tenants()
+        except RuntimeError as exc:  # pragma: no cover — the bug
+            errors.append(exc)
+
+    threads = [threading.Thread(target=churn),
+               threading.Thread(target=read)]
+    for t in threads:
+        t.start()
+    import time as _time
+
+    _time.sleep(0.3)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert errors == []
+
+
+def test_evaluate_prunes_aged_entries_from_live_windows():
+    """Evaluation cost must track the LIVE window, not the deque's
+    high-water mark: entries older than the window are popped during
+    evaluate (it runs synchronously inside submits, including on the
+    server's event-loop thread)."""
+    slo = _engine()
+    for _ in range(100):
+        slo.observe_request("t", 1e-4, now=1.0)
+    for _ in range(5):
+        slo.observe_request("t", 1e-4, now=100.0)
+    report = slo.evaluate(now=105.0)  # window_s=30: the 100 aged out
+    assert len(slo._windows["t"]) == 5
+    assert report["t"]["requests"] == 5
+
+
+def test_slo_alert_events_on_transitions_only():
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    slo = _engine()
+    for _ in range(50):
+        slo.observe_request("t", 99.0, now=1.0)
+    slo.evaluate(now=1.0)   # admit -> shed: one alert
+    slo.evaluate(now=2.0)   # steady state: silent
+    slo.evaluate(now=500.0)  # window aged out: shed -> admit
+    alerts = [e for e in sink.records if e["kind"] == "slo_alert"]
+    assert [(a["prev_signal"], a["signal"]) for a in alerts] == \
+        [("admit", "shed"), ("shed", "admit")]
+    assert all(telemetry.validate_event(a) == [] for a in alerts)
+    assert alerts[0]["tenant"] == "t"
+
+
+# ---------------------------------------------------------------------------
+# deferred-tenant assembly
+# ---------------------------------------------------------------------------
+def _req(tenant, shots, rng):
+    return _Request(request_id=None, tenant=tenant, session="s",
+                    syndromes=np.zeros((shots, 4), np.uint8),
+                    future=Future(), t0=0.0)
+
+
+def test_deferred_tenant_rides_spare_capacity_only():
+    rng = np.random.default_rng(0)
+    q = _SessionQueue()
+    for _ in range(3):
+        q.add(_req("noisy", 4, rng))
+    for _ in range(3):
+        q.add(_req("good", 4, rng))
+    batch = assemble_round_robin(q, max_shots=16,
+                                 deferred=frozenset({"noisy"}))
+    # every admitted request first; the deferred tenant gets the leftover
+    tenants = [r.tenant for r in batch]
+    assert tenants[:3] == ["good", "good", "good"]
+    assert tenants[3:] == ["noisy"]  # 16-shot cap: one deferred rides
+
+
+def test_deferred_tenant_rides_even_when_admitted_request_too_big():
+    """Spare capacity — not 'the admitted pass ran dry' — admits the
+    deferred pass: when the NEXT admitted request is too big to fit, a
+    smaller deferred request must still ride the leftover, else a
+    sustained admitted flood starves 'defer' tenants outright (worse
+    than shed, which at least fails fast)."""
+    rng = np.random.default_rng(0)
+    q = _SessionQueue()
+    q.add(_req("flood", 12, rng))
+    q.add(_req("flood", 12, rng))  # 12+12 > 16: ends the admitted pass
+    q.add(_req("noisy", 4, rng))
+    batch = assemble_round_robin(q, max_shots=16,
+                                 deferred=frozenset({"noisy"}))
+    assert [r.tenant for r in batch] == ["flood", "noisy"]
+    # the unfitted admitted request stays queued for the next flush
+    assert [r.tenant for qq in q.tenants.values() for r in qq] == ["flood"]
+
+
+def test_deferred_tenant_alone_still_dispatches():
+    rng = np.random.default_rng(0)
+    q = _SessionQueue()
+    q.add(_req("noisy", 4, rng))
+    batch = assemble_round_robin(q, max_shots=16,
+                                 deferred=frozenset({"noisy"}))
+    assert [r.tenant for r in batch] == ["noisy"]  # deprioritized != starved
+
+
+def test_batcher_sheds_offending_tenant_under_storm():
+    """The acceptance scenario: a tenant burning its SLO budget is shed at
+    submit while a healthy tenant keeps being admitted."""
+    slo = _engine()
+    bat = ContinuousBatcher({"hgp_rep3": _session()}, max_batch_shots=32,
+                            max_wait_s=0.005, slo=slo)
+    try:
+        # synthetic storm: the engine sees the bad tenant blowing the
+        # latency target, the good tenant well under it
+        for _ in range(50):
+            slo.observe_request("bad", 99.0)
+            slo.observe_request("good", 1e-4)
+        slo.evaluate()
+        rng = np.random.default_rng(3)
+        with pytest.raises(AdmissionError):
+            bat.submit("hgp_rep3", _synd(CODE3, 2, rng), tenant="bad")
+        fut = bat.submit("hgp_rep3", _synd(CODE3, 2, rng), tenant="good")
+        assert fut.result(timeout=60).corrections.shape[0] == 2
+    finally:
+        bat.drain()
+
+
+# ---------------------------------------------------------------------------
+# ops endpoints
+# ---------------------------------------------------------------------------
+def test_healthz_and_varz_direct():
+    bat = ContinuousBatcher({"hgp_rep3": _session()}, max_batch_shots=32,
+                            max_wait_s=0.005)
+    slo = _engine()
+    ops = OpsServer(batcher=bat, slo=slo)
+    rng = np.random.default_rng(1)
+    bat.submit("hgp_rep3", _synd(CODE3, 2, rng)).result(timeout=60)
+    body = ops.healthz()
+    assert body["ok"] is True
+    assert body["completed"] == 1 and body["failed"] == 0
+    assert body["sessions"] == 1
+    assert body["session_names"] == ["hgp_rep3"]
+    assert body["last_dispatch_age_s"] is not None
+    assert "slo" in body
+    telemetry.enable()
+    varz = ops.varz()
+    assert set(varz) == {"metrics", "compile", "process"}
+    assert varz["process"]["pid"] == os.getpid()
+    bat.drain()
+    assert ops.healthz()["ok"] is False  # stopped -> 503 body
+
+
+def test_tracez_direct_query_and_filters():
+    ctx = tracing.TraceContext()
+    tracing.record_span("device_decode", ctx, dur_s=0.4)
+    tracing.record_span("slice", ctx, dur_s=0.01)
+    other = tracing.TraceContext()
+    tracing.record_span("queue_wait", other, dur_s=0.001, ok=False,
+                        error="boom")
+    ops = OpsServer()
+    by_id = ops.tracez({"trace_id": [ctx.trace_id]})
+    assert by_id["trace_id"] == ctx.trace_id
+    assert len(by_id["spans"]) == 2
+    slow = ops.tracez({"slow_ms": ["100"]})
+    assert [t["trace_id"] for t in slow["traces"]] == [ctx.trace_id]
+    errored = ops.tracez({"errored": ["1"]})
+    assert [t["trace_id"] for t in errored["traces"]] == [other.trace_id]
+    assert ops.tracez({"limit": ["1"]})["traces"]
+
+
+def test_ops_plane_live_http_round_trip():
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session()}, max_batch_shots=32,
+                            max_wait_s=0.005, slo=_engine())
+    ops = start_ops_thread(batcher=bat, slo=bat.slo)
+    try:
+        host, port = ops.address
+        base = f"http://{host}:{port}"
+        rng = np.random.default_rng(2)
+        ctx = tracing.TraceContext()
+        bat.submit("hgp_rep3", _synd(CODE3, 3, rng),
+                   trace=ctx).result(timeout=60)
+
+        metrics = urllib.request.urlopen(base + "/metrics").read().decode()
+        assert "serve_requests" in metrics.replace(".", "_")
+        hz = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert hz["ok"] is True and hz["completed"] == 1
+        varz = json.loads(urllib.request.urlopen(base + "/varz").read())
+        assert "serve.requests" in varz["metrics"]
+        tz = json.loads(urllib.request.urlopen(
+            base + f"/tracez?trace_id={ctx.trace_id}").read())
+        assert {s["name"] for s in tz["spans"]} >= {
+            "queue_wait", "batch_assemble", "pad", "device_decode",
+            "slice"}
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/nope")
+        assert exc.value.code == 404
+        bat.drain()
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/healthz")
+        assert exc.value.code == 503  # stopped service answers unhealthy
+    finally:
+        ops.stop()
+        if not bat._stopped:
+            bat.drain()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end tracing through the TCP stack
+# ---------------------------------------------------------------------------
+def test_traced_request_full_stack_span_tree(tmp_path):
+    """The acceptance scenario: a traced request through the real TCP
+    server yields a COMPLETE span tree — stage spans under the
+    serve.request root — retrievable by trace id from the telemetry JSONL
+    and from /tracez, with zero warm-path retraces."""
+    from telemetry_report import load_events, render_trace_tree
+
+    jsonl = tmp_path / "serve.jsonl"
+    sess = _session()
+    sess.warm()
+    telemetry.enable(str(jsonl))
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=32,
+                            max_wait_s=0.005)
+    handle = start_server_thread(bat)
+    ops = start_ops_thread(batcher=bat)
+    try:
+        host, port = handle.address
+        before = telemetry.compile_stats().get("jax.retraces", 0)
+        with DecodeClient(host, port, traced=True) as cli:
+            rng = np.random.default_rng(5)
+            synd = _synd(CODE3, 4, rng)
+            res = cli.decode("hgp_rep3", synd)
+        assert res.trace_id  # echoed on the response
+        assert telemetry.compile_stats().get("jax.retraces", 0) == before
+
+        expected = {"queue_wait", "batch_assemble", "pad", "device_decode",
+                    "slice", "respond", "serve.request"}
+        # from the JSONL stream
+        events = load_events(str(jsonl))
+        spans = tracing.traces_from_records(events)[res.trace_id]
+        assert {s["name"] for s in spans} == expected
+        tree = tracing.trace_tree(spans)
+        assert len(tree["roots"]) == 1  # everything under serve.request
+        root = tree["roots"][0]
+        assert root["span"]["name"] == "serve.request"
+        assert {c["span"]["name"] for c in root["children"]} == \
+            expected - {"serve.request"}
+        rendered = render_trace_tree(spans)
+        assert "serve.request" in rendered and "device_decode" in rendered
+        # batch stages carry their amortization factor
+        dd = next(s for s in spans if s["name"] == "device_decode")
+        assert dd["amortized_over"] >= 1
+        # every span event validates against the v4 schema
+        assert all(telemetry.validate_event(s) == [] for s in spans)
+        # from /tracez
+        ohost, oport = ops.address
+        tz = json.loads(urllib.request.urlopen(
+            f"http://{ohost}:{oport}/tracez?trace_id={res.trace_id}")
+            .read())
+        assert {s["name"] for s in tz["spans"]} == expected
+    finally:
+        ops.stop()
+        handle.stop(drain=True)
+
+
+def test_untraced_frames_are_wire_compatible():
+    """Old clients (no trace field) keep working and produce NO spans."""
+    sess = _session()
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=32,
+                            max_wait_s=0.005)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        with DecodeClient(host, port) as cli:
+            rng = np.random.default_rng(6)
+            res = cli.decode("hgp_rep3", _synd(CODE3, 2, rng))
+        assert res.trace_id is None
+        assert tracing.traces_from_records(
+            tracing.recorder().snapshot()) == {}
+    finally:
+        handle.stop(drain=True)
+
+
+def test_malformed_trace_field_does_not_fail_decode():
+    sess = _session()
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=32,
+                            max_wait_s=0.005)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        with DecodeClient(host, port) as cli:
+            rng = np.random.default_rng(7)
+            fut = cli.submit("hgp_rep3", _synd(CODE3, 2, rng))
+            fut.result(timeout=60)
+            # hand-roll a frame with a junk trace annotation
+            cli._send({"op": "decode", "id": "junk-trace",
+                       "session": "hgp_rep3",
+                       "syndromes": _synd(CODE3, 2, rng).tolist(),
+                       "trace": {"trace_id": 42}})
+            fut2 = Future()
+            with cli._plock:
+                import time as _time
+
+                cli._pending["junk-trace"] = (fut2, _time.perf_counter())
+            res = fut2.result(timeout=60)
+            assert res.corrections.shape[0] == 2
+            assert res.trace_id is None  # dropped, not errored
+    finally:
+        handle.stop(drain=True)
+
+
+def test_shed_tenant_answered_with_structured_error_over_tcp():
+    slo = _engine()
+    bat = ContinuousBatcher({"hgp_rep3": _session()}, max_batch_shots=32,
+                            max_wait_s=0.005, slo=slo)
+    handle = start_server_thread(bat)
+    try:
+        for _ in range(50):
+            slo.observe_request("bad", 99.0)
+        slo.evaluate()
+        host, port = handle.address
+        with DecodeClient(host, port, tenant="bad") as cli:
+            rng = np.random.default_rng(8)
+            ctx = tracing.TraceContext()
+            with pytest.raises(RuntimeError) as exc:
+                cli.decode("hgp_rep3", _synd(CODE3, 2, rng), trace=ctx)
+            assert "AdmissionError" in str(exc.value)
+            assert "bad" in str(exc.value)
+        # a TRACED rejection still yields its root span: the refused
+        # requests are exactly the ones an operator hunts in /tracez
+        spans = tracing.traces_from_records(
+            tracing.recorder().snapshot())[ctx.trace_id]
+        assert len(spans) == 1
+        root = spans[0]
+        assert root["name"] == "serve.request"
+        assert root["ok"] is False
+        assert "AdmissionError" in root["error"]
+        assert root["parent_id"] == ctx.span_id
+    finally:
+        handle.stop(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# flight-recorder postmortem from a killed dispatch
+# ---------------------------------------------------------------------------
+def test_faultinject_killed_dispatch_ships_postmortem(tmp_path):
+    """The acceptance scenario: a dispatch killed by fault injection
+    produces a postmortem naming exactly the in-flight requests (ids,
+    tenants, and their trace)."""
+    tracing.configure(postmortem_dir=str(tmp_path))
+    bat = ContinuousBatcher({"hgp_rep3": _session()}, max_batch_shots=64,
+                            max_wait_s=0.02)
+    plan = faultinject.FaultPlan([faultinject.Fault(
+        site="serve_dispatch", kind="deterministic", after=0, count=1)])
+    try:
+        rng = np.random.default_rng(9)
+        ctx = tracing.TraceContext()
+        with plan.active():
+            futs = [bat.submit("hgp_rep3", _synd(CODE3, 2, rng),
+                               tenant="t0", request_id="req-a", trace=ctx),
+                    bat.submit("hgp_rep3", _synd(CODE3, 3, rng),
+                               tenant="t1", request_id="req-b")]
+            for f in futs:
+                with pytest.raises(faultinject.InjectedDeterministicFault):
+                    f.result(timeout=60)
+        dumps = list(tmp_path.glob(
+            "postmortem-*-serve_dispatch_failed.jsonl"))
+        assert len(dumps) == 1
+        lines = [json.loads(x) for x in dumps[0].read_text().splitlines()]
+        header = lines[0]
+        assert header["reason"] == "serve_dispatch_failed"
+        failure = next(r for r in lines if r["kind"] == "failure")
+        assert sorted(failure["request_ids"]) == ["req-a", "req-b"]
+        assert failure["tenants"] == ["t0", "t1"]
+        # the ring the dump shipped holds the accepted requests AND the
+        # injected fault that killed them
+        kinds = {r["kind"] for r in lines}
+        assert {"request", "fault_injected", "failure"} <= kinds
+        reqs = [r for r in lines if r.get("kind") == "request"]
+        assert any(r.get("trace_id") == ctx.trace_id for r in reqs)
+        # the traced request's device_decode span carries the error
+        spans = tracing.traces_from_records(
+            tracing.recorder().snapshot())[ctx.trace_id]
+        dd = next(s for s in spans if s["name"] == "device_decode")
+        assert dd["ok"] is False and "Injected" in dd["error"]
+    finally:
+        bat.drain()

@@ -456,3 +456,193 @@ def test_no_bare_print_in_library():
     assert not offenders, (
         "bare print() in library code (use utils.observability logging or "
         "utils.telemetry counters):\n" + "\n".join(offenders))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellites: configurable histogram buckets
+# ---------------------------------------------------------------------------
+def test_set_default_buckets_applies_to_new_histograms():
+    telemetry.enable()
+    telemetry.set_default_buckets("custom.metric", (1.0, 2.0, 4.0))
+    try:
+        assert telemetry.default_buckets("custom.metric") == (1.0, 2.0, 4.0)
+        telemetry.observe("custom.metric", 1.5)
+        h = telemetry.snapshot()["custom.metric"]
+        assert h["buckets"] == [1.0, 2.0, 4.0]
+        assert h["counts"] == [0, 1, 0, 0]
+        # an unregistered metric keeps the global time ladder
+        telemetry.observe("plain.metric", 1.5)
+        assert telemetry.snapshot()["plain.metric"]["buckets"] == \
+            list(telemetry.DEFAULT_TIME_BUCKETS)
+    finally:
+        telemetry.set_default_buckets("custom.metric", None)
+        assert telemetry.default_buckets("custom.metric") is None
+
+
+def test_serve_latency_gets_log_spaced_buckets():
+    """The shipped spec: serve.latency_s resolves sub-ms tails (the fixed
+    half-decade ladder lumped entire TPU-speed latency distributions into
+    one or two buckets, making p50/p99 useless)."""
+    telemetry.enable()
+    telemetry.observe("serve.latency_s", 5e-4)
+    h = telemetry.snapshot()["serve.latency_s"]
+    assert h["buckets"] == list(telemetry.LATENCY_BUCKETS)
+    assert len(telemetry.LATENCY_BUCKETS) == 21
+    assert telemetry.LATENCY_BUCKETS[0] == pytest.approx(1e-4)
+    assert telemetry.LATENCY_BUCKETS[-1] == pytest.approx(10.0)
+    # 4 edges per decade: 5 decades resolved
+    assert telemetry.LATENCY_BUCKETS[4] == pytest.approx(1e-3)
+
+
+def test_report_quantiles_correct_on_custom_buckets():
+    """telemetry_report's bucket-interpolated quantiles must follow the
+    histogram's OWN boundaries: with the log-spaced latency ladder, a
+    sub-ms distribution's p50/p99 resolve to the right sub-ms bucket
+    instead of saturating the first coarse edge."""
+    report = importlib.import_module("scripts.telemetry_report")
+    telemetry.enable()
+    for _ in range(100):
+        telemetry.observe("serve.latency_s", 5e-4)
+    m = telemetry.snapshot()["serve.latency_s"]
+    p50 = report._hist_quantile(m, 0.50)
+    p99 = report._hist_quantile(m, 0.99)
+    # 5e-4 lands in the (3.16e-4, 5.62e-4] bucket of LATENCY_BUCKETS
+    assert 3e-4 < p50 <= 5.7e-4
+    assert 3e-4 < p99 <= 5.7e-4
+    # overflow reports the top edge, not a fabricated value
+    for _ in range(1000):
+        telemetry.observe("over.metric", 99.0, buckets=(1.0, 2.0))
+    assert report._hist_quantile(
+        telemetry.snapshot()["over.metric"], 0.5) == 2.0
+
+
+def test_env_bucket_spec_override(monkeypatch):
+    monkeypatch.setenv("QLDPC_HIST_BUCKETS",
+                       json.dumps({"env.metric": [0.5, 5.0]}))
+    telemetry._install_env_bucket_specs()
+    try:
+        assert telemetry.default_buckets("env.metric") == (0.5, 5.0)
+    finally:
+        telemetry.set_default_buckets("env.metric", None)
+    monkeypatch.setenv("QLDPC_HIST_BUCKETS", "not json")
+    with pytest.warns(UserWarning):
+        telemetry._install_env_bucket_specs()
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellites: process provenance
+# ---------------------------------------------------------------------------
+def test_process_info_event_heads_every_stream(tmp_path):
+    report = importlib.import_module("scripts.telemetry_report")
+    path = str(tmp_path / "run.jsonl")
+    telemetry.enable(path)
+    telemetry.disable()
+    events = report.load_events(path)
+    info = [e for e in events if e["kind"] == "process_info"]
+    assert len(info) == 1
+    assert telemetry.validate_event(info[0]) == []
+    assert info[0]["pid"] == os.getpid()
+    assert info[0]["hostname"]
+    assert info[0]["schema_version"] == telemetry.EVENT_SCHEMA_VERSION
+    # this repo is a git checkout: the SHA is resolvable and cached
+    assert info[0]["git_sha"]
+    assert telemetry.process_info()["git_sha"] == info[0]["git_sha"]
+
+
+def test_process_info_reports_jax_when_loaded():
+    import jax  # noqa: F401 — ensure the module is live
+
+    info = telemetry.process_info(refresh=True)
+    assert info["jax"] and info["jaxlib"]
+    assert info["backend"] == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellites: concurrent JsonlSink writers
+# ---------------------------------------------------------------------------
+def test_jsonl_sink_concurrent_writers_no_torn_lines(tmp_path):
+    """8 threads hammering one JsonlSink: every line must parse (no torn
+    or interleaved writes) and FollowReader must round-trip the stream
+    intact."""
+    report = importlib.import_module("scripts.telemetry_report")
+    path = str(tmp_path / "hammer.jsonl")
+    telemetry.enable(path)
+    n_threads, per = 8, 250
+    payload = "x" * 200  # long enough that a torn write would shear JSON
+
+    def hammer(t):
+        for i in range(per):
+            telemetry.event("heartbeat", engine=f"t{t}", shots=i,
+                            blob=payload)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    telemetry.disable()
+
+    raw = open(path, encoding="utf-8").read().splitlines()
+    events = [json.loads(line) for line in raw]  # every line parses
+    beats = [e for e in events if e["kind"] == "heartbeat"]
+    assert len(beats) == n_threads * per
+    assert all(e["blob"] == payload for e in beats)  # no interleaving
+    # every (thread, i) pair arrived exactly once
+    seen = {(e["engine"], e["shots"]) for e in beats}
+    assert len(seen) == n_threads * per
+    # FollowReader round-trips the identical stream incrementally
+    reader = report.FollowReader(path)
+    followed = []
+    while True:
+        fresh = reader.poll()
+        if not fresh:
+            break
+        followed.extend(fresh)
+    assert followed == events
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 11 satellite: schema-coverage guard
+# ---------------------------------------------------------------------------
+def test_every_event_kind_is_emitted_and_test_validated():
+    """Tier-1 schema-coverage guard: every kind in EVENT_SCHEMAS must (a)
+    have a literal emission site in the library — a schema for an event
+    nothing emits is dead weight — and (b) appear in at least one test
+    file that validates events against the registry, so an added kind
+    cannot ship untested.  Adding a kind to EVENT_SCHEMAS without both
+    fails here."""
+    import re
+
+    lib_src = []
+    for dirpath, _dirnames, filenames in os.walk(LIB_ROOT):
+        for fn in filenames:
+            if fn.endswith(".py"):
+                with open(os.path.join(dirpath, fn),
+                          encoding="utf-8") as fh:
+                    lib_src.append(fh.read())
+    lib_src = "\n".join(lib_src)
+
+    dead = [k for k in telemetry.EVENT_SCHEMAS
+            if not re.search(r'event\(\s*["\']' + re.escape(k) + r'["\']',
+                             lib_src)]
+    assert not dead, (
+        f"EVENT_SCHEMAS kinds never emitted by the library: {dead}")
+
+    tests_dir = os.path.dirname(os.path.abspath(__file__))
+    validated_src = []
+    for fn in os.listdir(tests_dir):
+        if not fn.endswith(".py"):
+            continue
+        with open(os.path.join(tests_dir, fn), encoding="utf-8") as fh:
+            text = fh.read()
+        if "validate_event" in text:
+            validated_src.append(text)
+    validated_src = "\n".join(validated_src)
+
+    untested = [k for k in telemetry.EVENT_SCHEMAS
+                if f'"{k}"' not in validated_src
+                and f"'{k}'" not in validated_src]
+    assert not untested, (
+        f"EVENT_SCHEMAS kinds not exercised by any schema-validating "
+        f"test: {untested}")

@@ -1,0 +1,327 @@
+"""Request-tracing + flight-recorder tests (ISSUE 11): trace-context wire
+round-trip and hostile-input hygiene, span recording into the ring and the
+telemetry event stream (schema-validated), span-tree reassembly, the
+bounded flight-recorder ring and its postmortem dumps, and the
+resilience/faultinject black-box hooks (watchdog timeout, ladder degrade,
+exhausted retries each ship the in-flight ring)."""
+import json
+import os
+import threading
+
+import pytest
+
+from qldpc_fault_tolerance_tpu.utils import (
+    faultinject,
+    resilience,
+    telemetry,
+    tracing,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    telemetry.reset()
+    tracing.recorder().clear()
+    tracing.configure(postmortem_dir="")
+    yield
+    telemetry.disable()
+    telemetry.reset()
+    tracing.recorder().clear()
+    tracing.configure(postmortem_dir="")
+
+
+# ---------------------------------------------------------------------------
+# ids + trace context
+# ---------------------------------------------------------------------------
+def test_new_id_unique_and_sized():
+    ids = {tracing.new_id() for _ in range(10_000)}
+    assert len(ids) == 10_000
+    assert all(len(i) == 16 for i in ids)
+    assert len(tracing.new_id(16)) == 32
+
+
+def test_trace_context_wire_round_trip():
+    ctx = tracing.TraceContext()
+    back = tracing.TraceContext.from_wire(ctx.to_wire())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+
+
+def test_trace_context_from_wire_drops_malformed():
+    """A bad trace annotation must never fail the decode it rides on:
+    wrong types, missing/oversized ids all parse to None (or a repaired
+    context), not an exception."""
+    assert tracing.TraceContext.from_wire(None) is None
+    assert tracing.TraceContext.from_wire("not-a-dict") is None
+    assert tracing.TraceContext.from_wire([1, 2]) is None
+    assert tracing.TraceContext.from_wire({}) is None
+    assert tracing.TraceContext.from_wire({"trace_id": 123}) is None
+    assert tracing.TraceContext.from_wire({"trace_id": ""}) is None
+    assert tracing.TraceContext.from_wire({"trace_id": "x" * 65}) is None
+    # a valid trace id with a junk span id gets a FRESH span id
+    fixed = tracing.TraceContext.from_wire(
+        {"trace_id": "abc", "span_id": {"nested": 1}})
+    assert fixed.trace_id == "abc"
+    assert isinstance(fixed.span_id, str) and fixed.span_id
+
+
+# ---------------------------------------------------------------------------
+# span recording
+# ---------------------------------------------------------------------------
+def test_record_span_none_ctx_is_noop():
+    assert tracing.record_span("queue_wait", None, dur_s=0.1) is None
+    assert len(tracing.recorder()) == 0
+
+
+def test_record_span_lands_in_ring_and_event_stream():
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    ctx = tracing.TraceContext()
+    rec = tracing.record_span("device_decode", ctx, dur_s=0.25,
+                              amortized_over=3, shots=7)
+    assert rec["trace_id"] == ctx.trace_id
+    assert rec["parent_id"] == ctx.span_id  # default parent: the request
+    ring = [r for r in tracing.recorder().snapshot()
+            if r["kind"] == "trace"]
+    assert len(ring) == 1 and ring[0]["name"] == "device_decode"
+    evs = [e for e in sink.records if e["kind"] == "trace"]
+    assert len(evs) == 1
+    assert telemetry.validate_event(evs[0]) == []
+
+
+def test_record_span_parent_and_span_id_overrides():
+    ctx = tracing.TraceContext()
+    root = tracing.record_span("serve.request", ctx, span_id=ctx.span_id,
+                               parent_id=None, dur_s=0.5)
+    assert root["span_id"] == ctx.span_id
+    assert "parent_id" not in root
+    explicit = tracing.record_span("respond", ctx, parent_id="pp",
+                                   dur_s=0.1)
+    assert explicit["parent_id"] == "pp"
+
+
+def test_span_context_manager_times_and_flags_errors():
+    ctx = tracing.TraceContext()
+    with tracing.span("slice", ctx, shots=4) as sp:
+        pass
+    assert sp.record["name"] == "slice" and sp.record["shots"] == 4
+    assert sp.record["dur_s"] >= 0.0
+    with pytest.raises(ValueError):
+        with tracing.span("bad_stage", ctx) as sp2:
+            raise ValueError("boom")
+    assert sp2.record["ok"] is False
+    assert "ValueError" in sp2.record["error"]
+    # untraced fast path: the shared no-op, no ring growth
+    before = len(tracing.recorder())
+    with tracing.span("ignored", None):
+        pass
+    assert len(tracing.recorder()) == before
+
+
+# ---------------------------------------------------------------------------
+# trace reassembly
+# ---------------------------------------------------------------------------
+def _mk_span(tid, sid, parent=None, name="s", dur=0.1, ts=1.0, **kw):
+    rec = {"kind": "trace", "trace_id": tid, "span_id": sid,
+           "name": name, "dur_s": dur, "ts": ts, **kw}
+    if parent is not None:
+        rec["parent_id"] = parent
+    return rec
+
+
+def test_traces_from_records_groups_by_trace_id():
+    records = [_mk_span("a", "1"), _mk_span("b", "2"),
+               _mk_span("a", "3"), {"kind": "request"}]
+    grouped = tracing.traces_from_records(records)
+    assert sorted(grouped) == ["a", "b"]
+    assert [s["span_id"] for s in grouped["a"]] == ["1", "3"]
+
+
+def test_trace_tree_links_children_and_orphan_roots():
+    spans = [
+        _mk_span("t", "root", parent="client-side", name="serve.request"),
+        _mk_span("t", "q", parent="root", name="queue_wait"),
+        _mk_span("t", "d", parent="root", name="device_decode"),
+    ]
+    tree = tracing.trace_tree(spans)
+    assert tree["spans"] == 3
+    # the client's span is not among the records -> serve.request is root
+    assert len(tree["roots"]) == 1
+    root = tree["roots"][0]
+    assert root["span"]["name"] == "serve.request"
+    assert sorted(c["span"]["name"] for c in root["children"]) == \
+        ["device_decode", "queue_wait"]
+
+
+def test_trace_summaries_filters_slow_and_errored():
+    records = [
+        _mk_span("fast", "1", dur=0.001, ts=1.0),
+        _mk_span("slow", "2", dur=0.5, ts=2.0),
+        _mk_span("bad", "3", dur=0.002, ts=3.0, ok=False, error="x"),
+    ]
+    rows = tracing.trace_summaries(records, limit=10)
+    assert [r["trace_id"] for r in rows] == ["bad", "slow", "fast"]
+    slow = tracing.trace_summaries(records, slow_s=0.1)
+    assert [r["trace_id"] for r in slow] == ["slow"]
+    errored = tracing.trace_summaries(records, errored_only=True)
+    assert [r["trace_id"] for r in errored] == ["bad"]
+    assert errored[0]["errored"] is True
+    assert len(tracing.trace_summaries(records, limit=1)) == 1
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: bounded ring + postmortems
+# ---------------------------------------------------------------------------
+def test_flight_recorder_ring_is_bounded():
+    fr = tracing.FlightRecorder(capacity=32)
+    for i in range(100):
+        fr.record("request", i=i)
+    snap = fr.snapshot()
+    assert len(snap) == 32
+    assert snap[0]["i"] == 68 and snap[-1]["i"] == 99  # newest N survive
+
+
+def test_flight_recorder_dump_format(tmp_path):
+    fr = tracing.FlightRecorder(capacity=16)
+    fr.record("request", id="r1")
+    fr.record("trace", trace_id="t", span_id="s", name="n", dur_s=0.1)
+    path = fr.dump("watchdog: fired!", str(tmp_path),
+                   extra={"label": "serve"})
+    assert os.path.basename(path).startswith("postmortem-")
+    assert "/" not in os.path.basename(path).replace(".jsonl", "") \
+        .split("postmortem-")[-1]
+    lines = [json.loads(x) for x in
+             open(path, encoding="utf-8").read().splitlines()]
+    header, records = lines[0], lines[1:]
+    assert header["kind"] == "postmortem"
+    assert header["reason"] == "watchdog: fired!"
+    assert header["label"] == "serve"
+    assert header["records"] == 2 == len(records)
+    assert [r["kind"] for r in records] == ["request", "trace"]
+
+
+def test_configure_resizes_ring_keeping_newest():
+    tracing.flight_record("request", i=0)
+    tracing.flight_record("request", i=1)
+    fr = tracing.configure(capacity=17)
+    assert fr.capacity == 17
+    assert [r["i"] for r in fr.snapshot()] == [0, 1]
+    assert tracing.recorder() is fr
+    # restore the default capacity for other tests
+    tracing.configure(capacity=4096)
+
+
+def test_dump_postmortem_noop_without_directory(tmp_path, monkeypatch):
+    monkeypatch.delenv("QLDPC_POSTMORTEM_DIR", raising=False)
+    tracing.flight_record("request", id="r")
+    assert tracing.dump_postmortem("reason") is None
+    # env var path
+    monkeypatch.setenv("QLDPC_POSTMORTEM_DIR", str(tmp_path))
+    path = tracing.dump_postmortem("envdir")
+    assert path is not None and os.path.dirname(path) == str(tmp_path)
+    # configure() wins over the env var
+    sub = tmp_path / "cfg"
+    tracing.configure(postmortem_dir=str(sub))
+    path2 = tracing.dump_postmortem("cfgdir")
+    assert os.path.dirname(path2) == str(sub)
+
+
+def test_note_failure_records_and_ships(tmp_path):
+    tracing.configure(postmortem_dir=str(tmp_path))
+    tracing.flight_record("request", id="inflight-1")
+    path = tracing.note_failure("serve_dispatch_failed",
+                                request_ids=["inflight-1"])
+    assert path is not None
+    lines = [json.loads(x) for x in
+             open(path, encoding="utf-8").read().splitlines()]
+    kinds = [r["kind"] for r in lines]
+    assert kinds[0] == "postmortem"
+    assert "request" in kinds and "failure" in kinds
+    failure = next(r for r in lines if r["kind"] == "failure")
+    assert failure["request_ids"] == ["inflight-1"]
+
+
+def test_ring_appends_are_safe_under_threads():
+    fr = tracing.FlightRecorder(capacity=512)
+    n_threads, per = 8, 200
+
+    def hammer(t):
+        for i in range(per):
+            fr.record("request", t=t, i=i)
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = fr.snapshot()
+    assert len(snap) == 512  # bounded, newest-on-top, no corruption
+    assert all(r["kind"] == "request" for r in snap)
+
+
+# ---------------------------------------------------------------------------
+# resilience/faultinject black-box hooks
+# ---------------------------------------------------------------------------
+def test_watchdog_timeout_ships_postmortem(tmp_path):
+    tracing.configure(postmortem_dir=str(tmp_path))
+    tracing.flight_record("request", id="hung-req")
+    with pytest.raises(resilience.WatchdogTimeout):
+        resilience.fetch_with_watchdog(
+            lambda: threading.Event().wait(30), label="hung_fetch",
+            timeout_s=0.05)
+    dumps = list(tmp_path.glob("postmortem-*-watchdog_timeout.jsonl"))
+    assert len(dumps) == 1
+    lines = [json.loads(x) for x in
+             dumps[0].read_text().splitlines()]
+    assert lines[0]["label"] == "hung_fetch"
+    assert any(r.get("id") == "hung-req" for r in lines)
+
+
+def test_retry_exhausted_ships_postmortem(tmp_path):
+    tracing.configure(postmortem_dir=str(tmp_path))
+    policy = resilience.RetryPolicy(max_attempts=2, base_delay=0.0,
+                                    jitter=0.0, reset_caches=False)
+
+    def die():
+        raise resilience.TransientFault("injected worker death")
+
+    with resilience.policy_override(policy):
+        with pytest.raises(resilience.TransientFault):
+            resilience.run_cell(die, label="doomed")
+    dumps = list(tmp_path.glob("postmortem-*-retry_exhausted.jsonl"))
+    assert len(dumps) == 1
+    records = [json.loads(x) for x in dumps[0].read_text().splitlines()]
+    # the retry that preceded exhaustion is in the ring the dump shipped
+    assert any(r["kind"] == "retry" for r in records)
+    assert any(r["kind"] == "failure"
+               and r["reason"] == "retry_exhausted" for r in records)
+
+
+def test_degrade_ships_postmortem(tmp_path):
+    tracing.configure(postmortem_dir=str(tmp_path))
+    ladder = resilience.DegradationLadder([("fused->xla", lambda: None)])
+    assert ladder.step() == "fused->xla"
+    dumps = list(tmp_path.glob("postmortem-*-degrade.jsonl"))
+    assert len(dumps) == 1
+    records = [json.loads(x) for x in dumps[0].read_text().splitlines()]
+    failure = next(r for r in records if r["kind"] == "failure")
+    assert failure["rung"] == "fused->xla"
+
+
+def test_faultinject_records_into_ring():
+    plan = faultinject.FaultPlan(
+        [faultinject.Fault(site="test_site", kind="raise")])
+    with plan.active():
+        with pytest.raises(faultinject.InjectedFault):
+            faultinject.site("test_site")
+    ring = tracing.recorder().snapshot()
+    hits = [r for r in ring if r["kind"] == "fault_injected"]
+    assert len(hits) == 1
+    assert hits[0]["site"] == "test_site"
+    assert hits[0]["fault_kind"] == "raise"
