@@ -1,0 +1,95 @@
+"""qldpc-lint: the project's AST-based invariant analyzer.
+
+The repo rests on a handful of hard invariants nothing used to check
+statically: the one-sync-per-megabatch discipline, PRNG single-use, the
+kernel/twin bit-exactness contracts, the versioned event schema, the lock
+discipline around serving state.  This package encodes them as rules over
+a shared parsed view of the codebase — parse once, run every rule — with
+inline ``# qldpc: ignore[RXXX]`` suppressions and a checked-in
+``analysis/baseline.json`` for justified pre-existing findings.
+
+Run it:
+
+    python -m qldpc_fault_tolerance_tpu.analysis          # text report
+    python scripts/lint.py --json                          # stable JSON
+    python scripts/lint.py --select R001,R005              # rule subset
+    python scripts/lint.py --update-baseline               # re-budget
+
+Rule vocabulary (README "Static analysis" has the full table):
+
+==== =====================================================================
+R000 engine-owned: unused suppression comment / unparsable file
+R001 host sync outside the blessed sync sites
+R002 PRNG key reuse / dead split result
+R003 tracer-unsafe construct in traced code
+R004 donated buffer referenced after dispatch
+R005 event-kind / frozen-schema drift
+R006 unlocked write to module-level mutable state
+R007 kernel/twin contract drift
+R101 bare print() in library code (migrated PR-2 grep guard)
+R102 bare sleep / ad-hoc retry loop (migrated PR-7 grep guard)
+==== =====================================================================
+"""
+from __future__ import annotations
+
+import os
+
+from .core import (
+    AnalysisContext,
+    AnalysisResult,
+    Baseline,
+    BaselineEntry,
+    Finding,
+    Rule,
+    SourceModule,
+    UNUSED_SUPPRESSION_RULE_ID,
+    collect_modules,
+    package_root,
+    repo_root,
+    run_analysis,
+)
+from .rules_jax import (DonationRule, HostSyncRule, PRNGKeyRule,
+                        TracerSafetyRule)
+from .rules_kernels import KERNEL_CONTRACTS, KernelContractRule
+from .rules_runtime import LockDisciplineRule, SchemaDriftRule
+from .rules_style import BarePrintRule, BareSleepRule
+
+__all__ = [
+    "AnalysisContext", "AnalysisResult", "Baseline", "BaselineEntry",
+    "Finding", "Rule", "SourceModule", "UNUSED_SUPPRESSION_RULE_ID",
+    "collect_modules", "run_analysis", "package_root", "repo_root",
+    "HostSyncRule", "PRNGKeyRule", "TracerSafetyRule", "DonationRule",
+    "SchemaDriftRule", "LockDisciplineRule", "KernelContractRule",
+    "KERNEL_CONTRACTS", "BarePrintRule", "BareSleepRule",
+    "default_rules", "default_baseline_path", "analyze_repo",
+]
+
+
+def default_rules() -> list:
+    """The shipped rule set, in id order.  Instantiated fresh per call so
+    callers may reconfigure individual rules without cross-talk."""
+    return [
+        HostSyncRule(),
+        PRNGKeyRule(),
+        TracerSafetyRule(),
+        DonationRule(),
+        SchemaDriftRule(),
+        LockDisciplineRule(),
+        KernelContractRule(),
+        BarePrintRule(),
+        BareSleepRule(),
+    ]
+
+
+def default_baseline_path() -> str:
+    return os.path.join(package_root(), "analysis", "baseline.json")
+
+
+def analyze_repo(paths=None, *, rules=None, baseline_path=None,
+                 base=None) -> AnalysisResult:
+    """One-call entry point: parse the default targets (library package +
+    scripts/), run the default rules against the checked-in baseline."""
+    modules = collect_modules(paths, base=base)
+    baseline = Baseline.load(baseline_path or default_baseline_path())
+    return run_analysis(modules, rules if rules is not None
+                        else default_rules(), baseline)

@@ -1,0 +1,114 @@
+"""qldpc-lint CLI: ``python -m qldpc_fault_tolerance_tpu.analysis``.
+
+Exit codes: 0 clean, 1 findings, 2 usage error.  ``--json`` output is
+deterministic (sorted findings, no timestamps) so rounds diff cleanly the
+way bench_compare diffs BENCH artifacts.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from . import (Baseline, collect_modules, default_baseline_path,
+               default_rules, run_analysis)
+
+
+def _parse_args(argv):
+    p = argparse.ArgumentParser(
+        prog="qldpc-lint",
+        description="AST-based invariant analyzer for the "
+                    "qldpc_fault_tolerance_tpu codebase")
+    p.add_argument("paths", nargs="*",
+                   help="files/directories to analyze (default: the "
+                        "library package and scripts/)")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output (stable across runs)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline file (default: analysis/baseline.json)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore the baseline: report every finding")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="rewrite the baseline from live findings, keeping "
+                        "reasons of surviving entries")
+    p.add_argument("--select", default=None, metavar="IDS",
+                   help="comma-separated rule ids to run (e.g. R001,R005)")
+    p.add_argument("--ignore", default=None, metavar="IDS",
+                   help="comma-separated rule ids to skip")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule table and exit")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv if argv is not None else sys.argv[1:])
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.id}  {r.title}")
+        return 0
+    if args.select:
+        wanted = {s.strip() for s in args.select.split(",") if s.strip()}
+        unknown = wanted - {r.id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.id in wanted]
+    if args.ignore:
+        dropped = {s.strip() for s in args.ignore.split(",") if s.strip()}
+        rules = [r for r in rules if r.id not in dropped]
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = Baseline() if args.no_baseline \
+        else Baseline.load(baseline_path)
+
+    t0 = time.perf_counter()
+    try:
+        modules = collect_modules(args.paths or None)
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    result = run_analysis(modules, rules, baseline)
+    elapsed = time.perf_counter() - t0
+
+    if args.update_baseline:
+        # regenerate budgets from what the rules found *before* baseline
+        # subtraction: rerun against an empty baseline.  Entries for
+        # files OUTSIDE the analyzed set are kept verbatim — a partial
+        # run (explicit paths / --select) must never delete the other
+        # files' curated budgets and reasons
+        raw = run_analysis(modules, rules, Baseline())
+        analyzed = {m.rel for m in modules}
+        ran_rules = {r.id for r in rules}
+        new = Baseline.from_findings(raw.findings, previous=baseline)
+        kept = [e for e in baseline.entries
+                if e.file not in analyzed or e.rule not in ran_rules]
+        new.entries.extend(kept)
+        new = Baseline(new.entries)
+        new.save(baseline_path)
+        print(f"baseline updated: {len(new.entries)} entries "
+              f"({len(kept)} outside this run kept) -> {baseline_path}")
+        return 0
+
+    if args.as_json:
+        print(json.dumps(result.to_dict(), indent=2, sort_keys=True))
+        return result.exit_code
+
+    for f in result.findings:
+        print(f.render())
+    for e in result.stale_baseline:
+        print(f"warning: stale baseline entry {e.file} [{e.rule}] "
+              f"(budget {e.count}) — ratchet it down with "
+              f"--update-baseline", file=sys.stderr)
+    status = "clean" if not result.findings else \
+        f"{len(result.findings)} finding(s)"
+    print(f"qldpc-lint: {status} — {result.files} files, "
+          f"{len(result.rules)} rules, {result.suppressed} suppressed, "
+          f"{result.baselined} baselined, {elapsed:.2f}s")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,249 @@
+"""Import maps and the cross-module call graph the rules share.
+
+Two layers:
+
+* ``ModuleImports`` — one module's view of the outside world: which local
+  names are bound to jax / jax.numpy / jax.lax / jax.random / numpy /
+  stdlib ``random`` / ``time`` / pallas, and which bare names were imported
+  *from* those modules.  Every jax-discipline rule keys its matching on
+  this map instead of guessing from spellings, so ``from jax import
+  random`` and ``import random`` are never confused.
+* the package symbol table + reachability (``reachable_symbols``) —
+  resolves ``from .bp_pallas import _run_minsum_tile``-style intra-package
+  imports and walks transitive references, so the kernel-contract rule can
+  ask "does this kernel still reach the shared loop body?" across module
+  boundaries.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+__all__ = ["ModuleImports", "dotted", "symbol_table", "reachable_symbols"]
+
+
+def dotted(node: ast.AST) -> list[str] | None:
+    """Flatten a Name/Attribute chain: ``jax.random.split`` ->
+    ``["jax", "random", "split"]``; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return parts[::-1]
+    return None
+
+
+class ModuleImports:
+    """Name-binding map for one module (module- and function-level
+    imports folded together; shadowing across scopes is rare enough in
+    library code that one map per file is the right trade)."""
+
+    #: jax.random helpers that may be imported bare
+    _JR_NAMES = {"split", "fold_in", "PRNGKey", "uniform", "normal",
+                 "bernoulli", "bits", "randint", "categorical",
+                 "permutation", "choice", "gumbel", "exponential",
+                 "poisson", "truncated_normal", "laplace"}
+
+    def __init__(self, tree: ast.Module):
+        self.jax: set[str] = set()
+        self.jnp: set[str] = set()
+        self.lax: set[str] = set()
+        self.jrandom: set[str] = set()
+        self.numpy: set[str] = set()
+        self.std_random: set[str] = set()
+        self.time: set[str] = set()
+        self.threading: set[str] = set()
+        self.pallas: set[str] = set()
+        self.functools: set[str] = set()
+        self.from_jax_random: set[str] = set()   # bare split/fold_in/...
+        self.from_jax: set[str] = set()          # bare jit/vmap/...
+        self.from_lax: set[str] = set()          # bare scan/cond/...
+        self.from_time: dict[str, str] = {}      # `from time import sleep`
+        self.from_random: dict[str, str] = {}    # `from random import x`
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self._bind_module(a.name, a.asname or
+                                      a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self._bind_from(node.module, a.name,
+                                    a.asname or a.name)
+        # `from jax import random` must never be treated as stdlib random
+        self.std_random -= self.jrandom
+
+    def _bind_module(self, module: str, name: str) -> None:
+        if module == "jax":
+            self.jax.add(name)
+        elif module == "jax.numpy":
+            self.jnp.add(name)
+        elif module == "jax.lax":
+            self.lax.add(name)
+        elif module == "jax.random":
+            self.jrandom.add(name)
+        elif module == "numpy":
+            self.numpy.add(name)
+        elif module == "random":
+            self.std_random.add(name)
+        elif module == "time":
+            self.time.add(name)
+        elif module == "threading":
+            self.threading.add(name)
+        elif module == "functools":
+            self.functools.add(name)
+        elif module in ("jax.experimental.pallas",):
+            self.pallas.add(name)
+
+    def _bind_from(self, module: str, orig: str, name: str) -> None:
+        if module == "jax":
+            if orig == "numpy":
+                self.jnp.add(name)
+            elif orig == "lax":
+                self.lax.add(name)
+            elif orig == "random":
+                self.jrandom.add(name)
+            else:
+                self.from_jax.add(name)
+        elif module == "jax.numpy":
+            self.from_jax.add(name)
+        elif module == "jax.lax":
+            self.from_lax.add(name)
+        elif module == "jax.random" and orig in self._JR_NAMES:
+            self.from_jax_random.add(name)
+        elif module == "jax.experimental":
+            if orig == "pallas":
+                self.pallas.add(name)
+        elif module == "time":
+            self.from_time[name] = orig
+        elif module == "random":
+            self.from_random[name] = orig
+
+    # -- classification helpers -------------------------------------------
+    def chain_root_module(self, func: ast.AST) -> str | None:
+        """Classify a call target's root: 'jax', 'jnp', 'lax', 'jrandom',
+        'numpy', 'random', 'time', 'pallas', or None."""
+        chain = dotted(func)
+        if not chain:
+            return None
+        root = chain[0]
+        # jax.numpy.x / jax.lax.x / jax.random.x via the jax root
+        if root in self.jax and len(chain) >= 3:
+            sub = chain[1]
+            if sub == "numpy":
+                return "jnp"
+            if sub == "lax":
+                return "lax"
+            if sub == "random":
+                return "jrandom"
+        for label in ("jnp", "lax", "jrandom", "numpy",
+                      "std_random", "time", "pallas", "jax"):
+            if root in getattr(self, label):
+                return {"std_random": "random"}.get(label, label)
+        return None
+
+    def is_jax_random_call(self, func: ast.AST) -> str | None:
+        """Return the jax.random helper name if ``func`` targets one."""
+        if isinstance(func, ast.Name) and func.id in self.from_jax_random:
+            return func.id
+        chain = dotted(func)
+        if not chain:
+            return None
+        if self.chain_root_module(func) == "jrandom":
+            return chain[-1]
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Package symbol table + reachability
+# ---------------------------------------------------------------------------
+def _module_rel_for(parts: list[str], by_rel: dict) -> str | None:
+    """Resolve dotted module parts to a parsed module's rel path."""
+    as_file = "/".join(parts) + ".py"
+    if as_file in by_rel:
+        return as_file
+    as_pkg = "/".join(parts) + "/__init__.py"
+    if as_pkg in by_rel:
+        return as_pkg
+    return None
+
+
+class ModuleSymbols:
+    """Top-level defs plus the resolved intra-package import map of one
+    module: name -> (target_rel, original_name)."""
+
+    def __init__(self, rel: str, tree: ast.Module, by_rel: dict):
+        self.rel = rel
+        self.defs: dict[str, ast.AST] = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                self.defs[node.name] = node
+        self.import_map: dict[str, tuple[str, str]] = {}
+        pkg_parts = rel.split("/")[:-1]
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom):
+                continue
+            if node.level:
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)]
+            else:
+                base = []
+            mod_parts = base + (node.module.split(".")
+                                if node.module else [])
+            target = _module_rel_for(mod_parts, by_rel)
+            for a in node.names:
+                name = a.asname or a.name
+                if target is not None:
+                    self.import_map[name] = (target, a.name)
+                else:
+                    # `from .pkg import submodule` style
+                    sub = _module_rel_for(mod_parts + [a.name], by_rel)
+                    if sub is not None:
+                        self.import_map[name] = (sub, "*module*")
+
+
+def symbol_table(ctx) -> dict:
+    """rel -> ModuleSymbols for every parsed module (cached on the ctx)."""
+    return ctx.cache("symbol_table", lambda: {
+        m.rel: ModuleSymbols(m.rel, m.tree, ctx.by_rel)
+        for m in ctx.modules})
+
+
+def _referenced_names(node: ast.AST) -> Iterable[tuple[str, str | None]]:
+    """(name, attr_or_None) pairs referenced inside a def: bare Name loads
+    and the first attribute of Name.attr chains (for module.func refs)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            yield n.id, None
+        elif isinstance(n, ast.Attribute) and \
+                isinstance(n.value, ast.Name):
+            yield n.value.id, n.attr
+
+
+def reachable_symbols(ctx, rel: str, func: str) -> set[tuple[str, str]]:
+    """Transitive closure of (module_rel, def_name) symbols referenced
+    from ``func`` in ``rel``, following intra-package imports."""
+    table = symbol_table(ctx)
+    seen: set[tuple[str, str]] = set()
+    work = [(rel, func)]
+    while work:
+        cur = work.pop()
+        if cur in seen:
+            continue
+        mod = table.get(cur[0])
+        node = mod.defs.get(cur[1]) if mod else None
+        if node is None:
+            continue
+        seen.add(cur)
+        for name, attr in _referenced_names(node):
+            if name in mod.defs and name != cur[1]:
+                work.append((cur[0], name))
+            elif name in mod.import_map:
+                target_rel, orig = mod.import_map[name]
+                if orig == "*module*":
+                    if attr is not None:
+                        work.append((target_rel, attr))
+                else:
+                    work.append((target_rel, orig))
+    return seen

@@ -1,0 +1,386 @@
+"""qldpc-lint core: the rule framework the invariant rules plug into.
+
+The analyzer is deliberately shaped like the repo's other device pipelines:
+one expensive pass (``collect_modules`` parses every file into a shared
+``SourceModule`` — source text, AST, import map, suppression table) and then
+every rule runs over the SAME parsed artifacts, so adding a rule costs one
+AST walk, not one filesystem walk.  Tier-1 runs the whole analyzer in a few
+seconds on the 2-core container (BASELINE.md records the measured figure).
+
+Vocabulary:
+
+* ``Finding`` — one violation: file:line, rule id, message.  Sort order and
+  ``to_dict`` are stable so ``--json`` output diffs cleanly across rounds
+  (the same contract bench_compare relies on for BENCH artifacts).
+* suppression — ``# qldpc: ignore[R001]`` (comma-separate for several
+  rules) on the offending line, or on a comment-only line directly above
+  it.  Suppressions are load-bearing: one that no longer masks a live
+  finding is itself reported (rule id ``R000``), so stale escapes cannot
+  accumulate.
+* baseline — ``analysis/baseline.json`` entries ``{file, rule, count,
+  reason}`` budgeting justified pre-existing findings per (file, rule).
+  Findings beyond an entry's ``count`` are reported; stale entries are
+  surfaced as warnings by the CLI so the budget ratchets down over time.
+"""
+from __future__ import annotations
+
+import ast
+import io
+import json
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator
+
+__all__ = [
+    "Finding", "Rule", "SourceModule", "AnalysisContext", "AnalysisResult",
+    "Baseline", "BaselineEntry", "collect_modules", "run_analysis",
+    "package_root", "repo_root", "DEFAULT_TARGETS",
+    "UNUSED_SUPPRESSION_RULE_ID",
+]
+
+# the engine-owned pseudo-rule: a suppression comment that masks nothing
+UNUSED_SUPPRESSION_RULE_ID = "R000"
+
+_IGNORE_RE = re.compile(r"#\s*qldpc:\s*ignore\[([A-Z0-9,\s]+)\]")
+
+
+@dataclass(frozen=True, order=True)
+class Finding:
+    """One rule violation at a source location."""
+    file: str          # repo-relative posix path
+    line: int
+    rule: str
+    message: str
+    col: int = 0
+
+    def to_dict(self) -> dict:
+        return {"file": self.file, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}:{self.col}: {self.rule} " \
+               f"{self.message}"
+
+
+@dataclass
+class Suppression:
+    """One ``# qldpc: ignore[...]`` comment and the line(s) it masks."""
+    file: str
+    comment_line: int   # where the comment physically sits
+    target_line: int    # the code line it applies to
+    rules: frozenset
+    used: set = field(default_factory=set)  # rule ids it actually masked
+
+
+class SourceModule:
+    """One parsed file: text, AST, and the per-line suppression table.
+
+    Parsed exactly once; every rule receives the same instance.
+    """
+
+    def __init__(self, rel: str, text: str, tree: ast.Module):
+        self.rel = rel
+        self.text = text
+        self.tree = tree
+        self.lines = text.splitlines()
+        self.suppressions: list[Suppression] = list(
+            self._extract_suppressions())
+
+    @classmethod
+    def parse(cls, rel: str, text: str) -> "SourceModule":
+        return cls(rel, text, ast.parse(text, filename=rel))
+
+    def _extract_suppressions(self) -> Iterator[Suppression]:
+        try:
+            tokens = list(tokenize.generate_tokens(
+                io.StringIO(self.text).readline))
+        except tokenize.TokenizeError:  # pragma: no cover - ast parsed ok
+            return
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _IGNORE_RE.search(tok.string)
+            if not m:
+                continue
+            rules = frozenset(
+                r.strip() for r in m.group(1).split(",") if r.strip())
+            line = tok.start[0]
+            # a comment-only line guards the next line of code; a trailing
+            # comment guards its own line
+            code_before = self.lines[line - 1][:tok.start[1]].strip()
+            target = line if code_before else line + 1
+            yield Suppression(self.rel, line, target, rules)
+
+    def suppression_for(self, line: int, rule: str) -> Suppression | None:
+        for s in self.suppressions:
+            if s.target_line == line and rule in s.rules:
+                return s
+        return None
+
+
+class AnalysisContext:
+    """Shared state rules may consult: every parsed module, keyed by
+    repo-relative path, plus lazily-built cross-module indexes."""
+
+    def __init__(self, modules: list[SourceModule],
+                 schema_module_rel: str =
+                 "qldpc_fault_tolerance_tpu/utils/telemetry.py"):
+        self.modules = modules
+        self.by_rel = {m.rel: m for m in modules}
+        self.schema_module_rel = schema_module_rel
+        self._caches: dict = {}
+
+    def cache(self, key, build):
+        """Memoize an expensive cross-module index (e.g. the call graph)."""
+        if key not in self._caches:
+            self._caches[key] = build()
+        return self._caches[key]
+
+
+class Rule:
+    """Base class: subclasses set ``id``/``title`` and yield ``Finding``s
+    from ``check``.  ``applies`` scopes the rule to a file subset."""
+
+    id: str = "R???"
+    title: str = ""
+
+    def applies(self, rel: str) -> bool:
+        return True
+
+    def check(self, module: SourceModule,
+              ctx: AnalysisContext) -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+# ---------------------------------------------------------------------------
+# Baseline
+# ---------------------------------------------------------------------------
+@dataclass
+class BaselineEntry:
+    file: str
+    rule: str
+    count: int
+    reason: str
+
+    def to_dict(self) -> dict:
+        return {"file": self.file, "rule": self.rule, "count": self.count,
+                "reason": self.reason}
+
+
+class Baseline:
+    """Budget of justified findings per (file, rule)."""
+
+    def __init__(self, entries: Iterable[BaselineEntry] = ()):
+        self.entries = list(entries)
+        self._budget = {(e.file, e.rule): e for e in self.entries}
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls()
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        return cls(BaselineEntry(e["file"], e["rule"], int(e["count"]),
+                                 e.get("reason", ""))
+                   for e in doc.get("entries", []))
+
+    def save(self, path: str) -> None:
+        doc = {"version": 1,
+               "entries": [e.to_dict() for e in sorted(
+                   self.entries, key=lambda e: (e.file, e.rule))]}
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(doc, fh, indent=2, sort_keys=True)
+            fh.write("\n")
+
+    def entry_for(self, file: str, rule: str) -> BaselineEntry | None:
+        return self._budget.get((file, rule))
+
+    @classmethod
+    def from_findings(cls, findings: Iterable[Finding],
+                      previous: "Baseline" = None) -> "Baseline":
+        """Regenerate budgets from live findings, keeping the reasons of
+        surviving (file, rule) entries from ``previous``."""
+        counts: dict = {}
+        for f in findings:
+            counts[(f.file, f.rule)] = counts.get((f.file, f.rule), 0) + 1
+        entries = []
+        for (file, rule), n in sorted(counts.items()):
+            prev = previous.entry_for(file, rule) if previous else None
+            reason = prev.reason if prev else \
+                "unreviewed (added by --update-baseline)"
+            entries.append(BaselineEntry(file, rule, n, reason))
+        return cls(entries)
+
+
+# ---------------------------------------------------------------------------
+# File collection
+# ---------------------------------------------------------------------------
+def package_root() -> str:
+    """Absolute path of the qldpc_fault_tolerance_tpu package."""
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def repo_root() -> str:
+    return os.path.dirname(package_root())
+
+
+DEFAULT_TARGETS = ("qldpc_fault_tolerance_tpu", "scripts")
+
+
+def _iter_py_files(root: str, base: str) -> Iterator[str]:
+    if os.path.isfile(root):
+        if root.endswith(".py"):
+            yield os.path.relpath(root, base).replace(os.sep, "/")
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.relpath(os.path.join(dirpath, fn),
+                                      base).replace(os.sep, "/")
+
+
+def collect_modules(paths: Iterable[str] = None, *,
+                    base: str = None) -> list[SourceModule]:
+    """Parse every target file once.  ``paths`` are files or directories
+    (absolute, or relative to ``base``, which defaults to the repo root);
+    the default target set is the library package plus ``scripts/``."""
+    base = base or repo_root()
+    if not paths:
+        paths = [os.path.join(base, t) for t in DEFAULT_TARGETS]
+    rels: list[str] = []
+    for raw in paths:
+        # resolve against the repo root, falling back to the invoker's
+        # cwd; a path matching nothing is an ERROR, never a silent
+        # "0 files, clean" (a typo'd CI hook must not pass forever)
+        candidates = [raw] if os.path.isabs(raw) else \
+            [os.path.join(base, raw), os.path.abspath(raw)]
+        p = next((c for c in candidates if os.path.exists(c)), None)
+        if p is None:
+            raise FileNotFoundError(
+                f"lint target {raw!r} does not exist "
+                f"(tried {', '.join(candidates)})")
+        found = list(_iter_py_files(p, base))
+        if not found:
+            raise FileNotFoundError(
+                f"lint target {raw!r} contains no Python files")
+        rels.extend(found)
+    modules = []
+    for rel in dict.fromkeys(rels):  # de-dup, keep order
+        with open(os.path.join(base, rel), encoding="utf-8") as fh:
+            text = fh.read()
+        try:
+            modules.append(SourceModule.parse(rel, text))
+        except SyntaxError as e:
+            # a file the analyzer cannot parse is itself a finding target;
+            # represent it with an empty AST and let the engine report it
+            mod = SourceModule(rel, "", ast.Module(body=[], type_ignores=[]))
+            mod.parse_error = f"syntax error: {e.msg} (line {e.lineno})"
+            modules.append(mod)
+    return modules
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+@dataclass
+class AnalysisResult:
+    findings: list          # unsuppressed, unbaselined — what fails CI
+    suppressed: int         # masked by inline suppressions
+    baselined: int          # absorbed by baseline budgets
+    stale_baseline: list    # BaselineEntry with zero live findings
+    files: int
+    rules: list             # rule ids that ran
+
+    @property
+    def exit_code(self) -> int:
+        return 1 if self.findings else 0
+
+    def to_dict(self) -> dict:
+        counts: dict = {}
+        for f in self.findings:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "version": 1,
+            "files": self.files,
+            "rules": self.rules,
+            "findings": [f.to_dict() for f in sorted(self.findings)],
+            "counts": {k: counts[k] for k in sorted(counts)},
+            "suppressed": self.suppressed,
+            "baselined": self.baselined,
+            "stale_baseline": [e.to_dict() for e in self.stale_baseline],
+        }
+
+
+def run_analysis(modules: list[SourceModule], rules: Iterable[Rule],
+                 baseline: Baseline = None, *,
+                 ctx: AnalysisContext = None) -> AnalysisResult:
+    """Run ``rules`` over pre-parsed ``modules``: collect raw findings,
+    apply inline suppressions (tracking use), report unused suppressions
+    as R000, then apply the baseline budgets."""
+    rules = list(rules)
+    ctx = ctx or AnalysisContext(modules)
+    baseline = baseline or Baseline()
+
+    raw: list[Finding] = []
+    for module in modules:
+        if getattr(module, "parse_error", None):
+            raw.append(Finding(module.rel, 1, "R000", module.parse_error))
+            continue
+        for rule in rules:
+            if rule.applies(module.rel):
+                raw.extend(rule.check(module, ctx))
+
+    # inline suppressions
+    kept: list[Finding] = []
+    suppressed = 0
+    for f in raw:
+        module = ctx.by_rel.get(f.file)
+        sup = module.suppression_for(f.line, f.rule) if module else None
+        if sup is not None:
+            sup.used.add(f.rule)
+            suppressed += 1
+        else:
+            kept.append(f)
+
+    # a suppression that masked nothing (for any rule that actually ran)
+    # is stale — report it so escapes cannot outlive their finding
+    ran_ids = {r.id for r in rules}
+    for module in modules:
+        for sup in module.suppressions:
+            dead = [r for r in sorted(sup.rules)
+                    if r in ran_ids and r not in sup.used]
+            if dead:
+                kept.append(Finding(
+                    module.rel, sup.comment_line, UNUSED_SUPPRESSION_RULE_ID,
+                    f"unused suppression for {', '.join(dead)} — the "
+                    f"finding it masked is gone; delete the comment"))
+
+    # baseline budgets
+    by_key: dict = {}
+    for f in kept:
+        by_key.setdefault((f.file, f.rule), []).append(f)
+    final: list[Finding] = []
+    baselined = 0
+    seen_keys = set()
+    for key, fs in by_key.items():
+        seen_keys.add(key)
+        entry = baseline.entry_for(*key)
+        budget = entry.count if entry else 0
+        fs.sort()
+        baselined += min(budget, len(fs))
+        final.extend(fs[budget:])
+    # only entries whose rule actually ran can be judged stale — a
+    # --select subset run must not smear "stale" over the other rules
+    stale = [e for e in baseline.entries
+             if e.rule in ran_ids
+             and ((e.file, e.rule) not in seen_keys
+                  or len(by_key[(e.file, e.rule)]) < e.count)]
+
+    return AnalysisResult(
+        findings=sorted(final), suppressed=suppressed, baselined=baselined,
+        stale_baseline=stale, files=len(modules),
+        rules=sorted(r.id for r in rules))

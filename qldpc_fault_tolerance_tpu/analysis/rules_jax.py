@@ -1,0 +1,807 @@
+"""JAX discipline rules: host-sync sites, PRNG key hygiene, tracer
+safety, donation safety.
+
+All four rules share one heuristic: a per-scope "jax origin" set — names
+that were assigned from jnp/lax/jax.random expressions (propagated through
+arithmetic, comparisons, subscripts and the usual array-method chains).
+The analyzer is a linter, not a type checker: the origin set is
+deliberately conservative, so a ``.tolist()`` on a numpy array never
+fires, and a ``.tolist()`` on something the AST cannot prove is a jax
+value doesn't either.  The invariants the rules encode are described in
+README "Static analysis".
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Iterator
+
+from .callgraph import ModuleImports, dotted
+from .core import Finding, Rule, SourceModule
+
+__all__ = ["HostSyncRule", "PRNGKeyRule", "TracerSafetyRule",
+           "DonationRule"]
+
+
+def module_imports(module: SourceModule, ctx) -> ModuleImports:
+    return ctx.cache(("imports", module.rel),
+                     lambda: ModuleImports(module.tree))
+
+
+def module_nodes(module: SourceModule, ctx) -> list:
+    """Flat node list of the module AST, walked once and shared by every
+    rule that scans whole files (the parse-once discipline, applied to
+    the walk as well — ast.walk dominates the analyzer's profile)."""
+    return ctx.cache(("nodes", module.rel),
+                     lambda: list(ast.walk(module.tree)))
+
+
+# ---------------------------------------------------------------------------
+# jax-origin inference
+# ---------------------------------------------------------------------------
+# array methods that keep a jax value a jax value
+_ARRAY_METHODS = {
+    "reshape", "astype", "sum", "min", "max", "mean", "prod", "ravel",
+    "flatten", "squeeze", "transpose", "swapaxes", "dot", "cumsum",
+    "argmin", "argmax", "any", "all", "round", "clip", "take", "set",
+    "add", "get", "copy",
+}
+# attribute hops that keep jax-ness (".shape"/".dtype" deliberately NOT
+# here: those are static metadata, branching on them is trace-safe)
+_ARRAY_ATTRS = {"T", "at", "real", "imag"}
+
+
+class OriginTracker:
+    """Names plausibly bound to device values inside one scope."""
+
+    def __init__(self, imports: ModuleImports, seed: set[str] = ()):
+        self.imports = imports
+        self.names: set[str] = set(seed)
+
+    def jaxish(self, node: ast.AST) -> bool:
+        imp = self.imports
+        if isinstance(node, ast.Name):
+            return node.id in self.names
+        if isinstance(node, ast.Call):
+            root = imp.chain_root_module(node.func)
+            if root in ("jnp", "lax", "jrandom"):
+                return True
+            chain = dotted(node.func)
+            if root == "jax" and chain and len(chain) >= 2 and \
+                    chain[1] in ("device_put", "tree_map",
+                                 "block_until_ready"):
+                # still device values (block_until_ready returns its
+                # argument); jax.device_get is deliberately NOT in the
+                # tuple — its result lives on the host
+                return True
+            if isinstance(node.func, ast.Name) and (
+                    node.func.id in imp.from_jax_random
+                    or node.func.id in imp.from_lax):
+                return True
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _ARRAY_METHODS:
+                return self.jaxish(node.func.value)
+            return False
+        if isinstance(node, ast.BinOp):
+            return self.jaxish(node.left) or self.jaxish(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.jaxish(node.operand)
+        if isinstance(node, ast.Compare):
+            return self.jaxish(node.left) or any(
+                self.jaxish(c) for c in node.comparators)
+        if isinstance(node, ast.BoolOp):
+            return any(self.jaxish(v) for v in node.values)
+        if isinstance(node, ast.Subscript):
+            return self.jaxish(node.value)
+        if isinstance(node, ast.Attribute):
+            return node.attr in _ARRAY_ATTRS and self.jaxish(node.value)
+        if isinstance(node, ast.IfExp):
+            return self.jaxish(node.body) or self.jaxish(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return any(self.jaxish(e) for e in node.elts)
+        return False
+
+    def absorb_assignments(self, scope: ast.AST) -> None:
+        """Fixpoint over the scope's assignments (order-insensitive; two
+        or three passes close any realistic chain)."""
+        assigns = [n for n in ast.walk(scope)
+                   if isinstance(n, (ast.Assign, ast.AugAssign,
+                                     ast.AnnAssign, ast.NamedExpr))]
+        for _ in range(4):
+            before = len(self.names)
+            for node in assigns:
+                value = node.value
+                if value is None or not self.jaxish(value):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for el in (t.elts if isinstance(t, (ast.Tuple, ast.List))
+                               else [t]):
+                        if isinstance(el, ast.Name):
+                            self.names.add(el.id)
+            if len(self.names) == before:
+                break
+
+
+def _scopes(tree: ast.Module) -> Iterator[ast.AST]:
+    """Top-level function scopes (module-level code is handled separately
+    by the rules that care)."""
+    def rec(node, in_func):
+        for child in ast.iter_child_nodes(node):
+            is_func = isinstance(child, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+            if is_func and not in_func:
+                yield child
+            yield from rec(child, in_func or is_func)
+    yield from rec(tree, False)
+
+
+def _walk_skip_lambdas(node: ast.AST, *,
+                       in_lambda: bool = False) -> Iterator[tuple]:
+    """(node, in_lambda) pairs; descendants of a Lambda are tagged so the
+    deferred-fetch idiom (``lambda: jax.device_get(c)`` handed to the
+    resilience drain machinery) is distinguishable from an eager sync."""
+    yield node, in_lambda
+    for child in ast.iter_child_nodes(node):
+        yield from _walk_skip_lambdas(
+            child, in_lambda=in_lambda or isinstance(node, ast.Lambda))
+
+
+# ---------------------------------------------------------------------------
+# R001: host-sync discipline
+# ---------------------------------------------------------------------------
+class HostSyncRule(Rule):
+    """The one-sync-per-megabatch discipline: blocking device->host
+    transfers live in the blessed drain sites only.  Deferred fetches
+    (inside a lambda handed to resilience.guarded_fetch) are exempt — the
+    blessed sites are where they run."""
+
+    id = "R001"
+    title = "host sync outside a blessed sync site"
+
+    DEFAULT_ALLOWED = (
+        "qldpc_fault_tolerance_tpu/parallel/",
+        "qldpc_fault_tolerance_tpu/sim/common.py",
+        "qldpc_fault_tolerance_tpu/serve/session.py",
+    )
+
+    def __init__(self, allowed: tuple = DEFAULT_ALLOWED,
+                 package_prefix: str = "qldpc_fault_tolerance_tpu/"):
+        self.allowed = allowed
+        self.package_prefix = package_prefix
+
+    def applies(self, rel: str) -> bool:
+        return rel.startswith(self.package_prefix) and \
+            not any(rel.startswith(a) for a in self.allowed)
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        imp = module_imports(module, ctx)
+        if not (imp.jax | imp.jnp | imp.lax | imp.jrandom):
+            return
+        for scope in _scopes(module.tree):
+            origins = OriginTracker(imp)
+            origins.absorb_assignments(scope)
+            yield from self._check_scope(scope, module, imp, origins)
+
+    def _check_scope(self, scope, module, imp, origins):
+        for node, in_lambda in _walk_skip_lambdas(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = self._sync_desc(node, imp, origins)
+            if desc is None:
+                continue
+            if in_lambda:
+                continue  # deferred callable: runs at the blessed site
+                # (resilience.guarded_fetch drains, run_signature
+                # fingerprints), not eagerly in the dispatch loop
+            yield Finding(
+                module.rel, node.lineno, self.id,
+                f"host sync ({desc}) outside the blessed sync sites "
+                f"(parallel/, sim/common.py, serve/session.py) — route "
+                f"device reads through the megabatch drain", node.col_offset)
+
+    @staticmethod
+    def _sync_desc(node: ast.Call, imp: ModuleImports,
+                   origins: OriginTracker) -> str | None:
+        func = node.func
+        chain = dotted(func)
+        if chain and len(chain) == 2 and chain[0] in imp.jax and \
+                chain[1] in ("device_get", "block_until_ready"):
+            return f"jax.{chain[1]}"
+        if isinstance(func, ast.Attribute):
+            if func.attr == "block_until_ready":
+                return ".block_until_ready()"
+            if func.attr in ("item", "tolist") and \
+                    origins.jaxish(func.value):
+                return f".{func.attr}() on a jax value"
+        if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                      "bool") \
+                and len(node.args) == 1 and origins.jaxish(node.args[0]):
+            return f"{func.id}() on a jax value"
+        if chain and chain[0] in imp.numpy and \
+                chain[-1] in ("asarray", "array") and node.args and \
+                origins.jaxish(node.args[0]):
+            return f"np.{chain[-1]}() on a jax value"
+        return None
+
+
+# ---------------------------------------------------------------------------
+# R002: PRNG key hygiene
+# ---------------------------------------------------------------------------
+_KEY_PARAM_HINTS = ("key", "rng", "subkey")
+# jax.random helpers that CREATE keys (tracking starts, argument untouched)
+_KEY_CREATORS = {"PRNGKey", "key", "wrap_key_data", "clone"}
+# helpers that DERIVE without consuming: the positional fold_in stream
+# (fold_in(key, offset + j)) is the repo's replay contract, so the parent
+# key legitimately appears in many fold_in calls
+_KEY_DERIVERS = {"fold_in"}
+
+
+def _is_key_name(name: str) -> bool:
+    return name in _KEY_PARAM_HINTS or name.endswith("_key") or \
+        name.endswith("_rng")
+
+
+class PRNGKeyRule(Rule):
+    """Single-use keys: a key passed to a sampler (or split) is consumed;
+    consuming it again without an intervening rebind is the
+    correlated-streams bug every resume/replay proof assumes away.  Also
+    flags dead split results — an unused child key usually means the
+    wrong key is being sampled somewhere else."""
+
+    id = "R002"
+    title = "PRNG key reuse / dead split result"
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        imp = module_imports(module, ctx)
+        if not (imp.jrandom | imp.from_jax_random | imp.jax):
+            return
+        for node in module_nodes(module, ctx):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield from self._check_function(node, module, imp)
+
+    # -- helpers -----------------------------------------------------------
+    @staticmethod
+    def _helper_name(call: ast.Call, imp: ModuleImports) -> str | None:
+        name = imp.is_jax_random_call(call.func)
+        if name is None:
+            chain = dotted(call.func)
+            if chain and len(chain) >= 3 and chain[0] in imp.jax and \
+                    chain[1] == "random":
+                name = chain[-1]
+        return name
+
+    def _check_function(self, func, module, imp) -> Iterator[Finding]:
+        tracked = {a.arg for a in (func.args.args + func.args.kwonlyargs
+                                   + func.args.posonlyargs)
+                   if _is_key_name(a.arg)}
+        state = {n: "fresh" for n in tracked}
+        yield from self._run_block(func.body, state, module, imp,
+                                   loop_depth=0)
+        yield from self._dead_splits(func, module, imp)
+
+    def _iter_calls(self, stmt) -> Iterator[ast.Call]:
+        """Calls inside one statement, not descending into nested defs or
+        lambdas (their scopes are analyzed separately / not at all)."""
+        def rec(node):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue
+                if isinstance(child, ast.Call):
+                    yield child
+                yield from rec(child)
+        yield from rec(stmt)
+
+    def _consume(self, call, state, module, imp) -> Iterator[Finding]:
+        helper = self._helper_name(call, imp)
+        if helper is None or helper in _KEY_CREATORS or \
+                helper in _KEY_DERIVERS:
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(arg, ast.Name) and arg.id in state:
+                if state[arg.id] == "used":
+                    yield Finding(
+                        module.rel, call.lineno, self.id,
+                        f"PRNG key {arg.id!r} reused by "
+                        f"jax.random.{helper} — it was already consumed; "
+                        f"split or fold_in first", call.col_offset)
+                state[arg.id] = "used"
+
+    def _bind(self, stmt, state, imp) -> None:
+        if not isinstance(stmt, (ast.Assign, ast.AnnAssign)):
+            return
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else [stmt.target]
+        value = stmt.value
+        key_origin = isinstance(value, ast.Call) and \
+            self._helper_name(value, imp) in (
+                _KEY_CREATORS | _KEY_DERIVERS | {"split"}) or \
+            isinstance(value, ast.Name) and value.id in state
+        for t in targets:
+            elts = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+            for el in elts:
+                if not isinstance(el, ast.Name):
+                    continue
+                if key_origin:
+                    state[el.id] = "fresh"
+                elif el.id in state:
+                    del state[el.id]  # rebound to a non-key value
+
+    @staticmethod
+    def _terminates(stmts) -> bool:
+        return bool(stmts) and isinstance(
+            stmts[-1], (ast.Return, ast.Raise, ast.Break, ast.Continue))
+
+    def _rebound_names(self, stmts) -> set[str]:
+        out = set()
+        for stmt in stmts:
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for el in (t.elts if isinstance(
+                                t, (ast.Tuple, ast.List)) else [t]):
+                            if isinstance(el, ast.Name):
+                                out.add(el.id)
+        return out
+
+    def _run_block(self, stmts, state, module, imp, *,
+                   loop_depth) -> Iterator[Finding]:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(stmt, ast.If):
+                for call in self._iter_calls(stmt.test):
+                    yield from self._consume(call, state, module, imp)
+                s_body, s_else = dict(state), dict(state)
+                yield from self._run_block(stmt.body, s_body, module, imp,
+                                           loop_depth=loop_depth)
+                yield from self._run_block(stmt.orelse, s_else, module,
+                                           imp, loop_depth=loop_depth)
+                # a branch that terminates (return/raise/break/continue)
+                # never reaches the fall-through code, so its consumption
+                # must not leak there — the `if kind == ...: return`
+                # dispatch ladder is exclusive paths, not reuse
+                merge = []
+                if not self._terminates(stmt.body):
+                    merge.append(s_body)
+                if not stmt.orelse or not self._terminates(stmt.orelse):
+                    merge.append(s_else)
+                for s in merge:
+                    for name, st in s.items():
+                        if st == "used" and name in state:
+                            state[name] = "used"
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                head = stmt.iter if isinstance(stmt, ast.For) else stmt.test
+                for call in self._iter_calls(head):
+                    yield from self._consume(call, state, module, imp)
+                rebound = self._rebound_names(stmt.body)
+                outer = {n for n, s in state.items() if n not in rebound}
+                flagged: set = set()
+                for sub in stmt.body:
+                    for call in self._iter_calls(sub):
+                        helper = self._helper_name(call, imp)
+                        if helper is None or helper in _KEY_CREATORS or \
+                                helper in _KEY_DERIVERS:
+                            continue
+                        for arg in list(call.args) + \
+                                [kw.value for kw in call.keywords]:
+                            if isinstance(arg, ast.Name) and \
+                                    arg.id in outer:
+                                yield Finding(
+                                    module.rel, call.lineno, self.id,
+                                    f"PRNG key {arg.id!r} consumed inside "
+                                    f"a loop without a per-iteration "
+                                    f"split/fold_in — every iteration "
+                                    f"replays the same stream",
+                                    call.col_offset)
+                                state[arg.id] = "used"
+                                outer.discard(arg.id)
+                                flagged.add(arg.id)
+                # names already flagged by the loop-invariant check are
+                # untracked in the body pass so one bug reports once
+                s_body = {n: s for n, s in state.items()
+                          if n not in flagged}
+                yield from self._run_block(stmt.body, s_body, module, imp,
+                                           loop_depth=loop_depth + 1)
+                for name, st in s_body.items():
+                    if st == "used" and name in state:
+                        state[name] = "used"
+                continue
+            if isinstance(stmt, ast.With):
+                for item in stmt.items:
+                    for call in self._iter_calls(item.context_expr):
+                        yield from self._consume(call, state, module, imp)
+                yield from self._run_block(stmt.body, state, module, imp,
+                                           loop_depth=loop_depth)
+                continue
+            if isinstance(stmt, ast.Try):
+                yield from self._run_block(stmt.body, state, module, imp,
+                                           loop_depth=loop_depth)
+                for h in stmt.handlers:
+                    s_h = dict(state)
+                    yield from self._run_block(h.body, s_h, module, imp,
+                                               loop_depth=loop_depth)
+                yield from self._run_block(stmt.finalbody, state, module,
+                                           imp, loop_depth=loop_depth)
+                continue
+            for call in self._iter_calls(stmt):
+                yield from self._consume(call, state, module, imp)
+            self._bind(stmt, state, imp)
+
+    def _dead_splits(self, func, module, imp) -> Iterator[Finding]:
+        loads: dict[str, int] = {}
+        for node in ast.walk(func):
+            if isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                loads[node.id] = loads.get(node.id, 0) + 1
+        for node in ast.walk(func):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)
+                    and self._helper_name(node.value, imp) == "split"
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], (ast.Tuple, ast.List))):
+                continue
+            for el in node.targets[0].elts:
+                if isinstance(el, ast.Name) and \
+                        not el.id.startswith("_") and \
+                        loads.get(el.id, 0) == 0:
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f"dead split result {el.id!r} — the child key is "
+                        f"never consumed; either use it or name it with "
+                        f"a leading underscore", node.col_offset)
+
+
+# ---------------------------------------------------------------------------
+# R003: tracer safety
+# ---------------------------------------------------------------------------
+_LAX_TRACERS = {"scan", "fori_loop", "while_loop", "cond", "switch",
+                "map", "associative_scan"}
+_JAX_TRACERS = {"jit", "vmap", "pmap", "checkpoint", "grad",
+                "value_and_grad"}
+_CLOCK_ATTRS = {"time", "perf_counter", "monotonic", "time_ns",
+                "perf_counter_ns"}
+
+
+class TracerSafetyRule(Rule):
+    """Inside jit/scan/vmap bodies and Pallas kernels: no Python branches
+    on traced values, no host clocks, no stdlib/numpy RNG.  Keyword-only
+    parameters and declared ``static_argnames`` are treated as static
+    (the ``functools.partial`` closure idiom every kernel here uses)."""
+
+    id = "R003"
+    title = "tracer-unsafe construct in traced code"
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        imp = module_imports(module, ctx)
+        if not (imp.jax | imp.jnp | imp.lax | imp.pallas | imp.from_lax):
+            return
+        traced = self._traced_functions(module_nodes(module, ctx), imp)
+        for func, statics in traced:
+            yield from self._check_traced(func, statics, module, imp)
+
+    # -- traced-function discovery ----------------------------------------
+    def _is_tracing_entry(self, func_expr, imp) -> bool:
+        if isinstance(func_expr, ast.Name):
+            return func_expr.id in (imp.from_lax & _LAX_TRACERS) or \
+                func_expr.id in (imp.from_jax & _JAX_TRACERS)
+        chain = dotted(func_expr)
+        if not chain:
+            return False
+        root = imp.chain_root_module(func_expr)
+        if root == "lax" and chain[-1] in _LAX_TRACERS:
+            return True
+        if root == "jax" and len(chain) >= 2 and (
+                chain[-1] in _JAX_TRACERS
+                or (chain[1] == "lax" and chain[-1] in _LAX_TRACERS)):
+            return True
+        if root == "pallas" and chain[-1] == "pallas_call":
+            return True
+        return False
+
+    @staticmethod
+    def _is_jit_expr(node, imp) -> bool:
+        chain = dotted(node)
+        return bool(chain) and (
+            (chain[0] in imp.jax and chain[-1] == "jit")
+            or (isinstance(node, ast.Name) and node.id in imp.from_jax
+                and node.id == "jit"))
+
+    @staticmethod
+    def _static_argnames(call: ast.Call) -> set:
+        """Declared statics: strings stay names; ints (static_argnums)
+        stay positions and are resolved against the FunctionDef later."""
+        out: set = set()
+        for kw in call.keywords:
+            if kw.arg not in ("static_argnames", "static_argnums"):
+                continue
+            values = kw.value.elts if isinstance(
+                kw.value, (ast.Tuple, ast.List)) else [kw.value]
+            out |= {e.value for e in values
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, (str, int))}
+        return out
+
+    def _traced_functions(self, nodes, imp):
+        """(FunctionDef, static_param_names) pairs believed to run under
+        trace.  Names are discovered from decorator form, direct use as
+        an argument to a tracing entry point, and one level of
+        ``functools.partial`` / ``jax.jit`` indirection."""
+        defs: dict[str, list] = {}
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+
+        traced_names: set[str] = set()
+        statics_by_name: dict[str, set[str]] = {}
+        decorated: list = []
+
+        for node in nodes:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    statics = set()
+                    target = dec
+                    if isinstance(dec, ast.Call):
+                        chain = dotted(dec.func)
+                        if chain and chain[-1] == "partial" and dec.args \
+                                and self._is_jit_expr(dec.args[0], imp):
+                            statics = self._static_argnames(dec)
+                            decorated.append((node, statics))
+                            continue
+                        target = dec.func
+                        statics = self._static_argnames(dec)
+                    if self._is_jit_expr(target, imp):
+                        decorated.append((node, statics))
+            if isinstance(node, ast.Call) and \
+                    self._is_tracing_entry(node.func, imp):
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        traced_names.add(arg.id)
+
+        # one indirection level: x = functools.partial(f, ...) / jax.jit(f)
+        for _ in range(2):
+            for node in nodes:
+                if not (isinstance(node, ast.Assign)
+                        and isinstance(node.value, ast.Call)):
+                    continue
+                call = node.value
+                chain = dotted(call.func)
+                is_wrap = (chain and chain[-1] == "partial") or \
+                    self._is_jit_expr(call.func, imp)
+                if not is_wrap or not call.args:
+                    continue
+                inner = call.args[0]
+                wraps_jit = self._is_jit_expr(call.func, imp)
+                target_names = [t.id for t in node.targets
+                                if isinstance(t, ast.Name)] + \
+                               [t.attr for t in node.targets
+                                if isinstance(t, ast.Attribute)]
+                if isinstance(inner, ast.Name) and (
+                        wraps_jit
+                        or any(t in traced_names for t in target_names)):
+                    traced_names.add(inner.id)
+                    statics_by_name.setdefault(inner.id, set()).update(
+                        self._static_argnames(call))
+
+        out = []
+        seen = set()
+        for node, statics in decorated:
+            out.append((node, statics))
+            seen.add(id(node))
+        for name in traced_names:
+            for node in defs.get(name, []):
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    out.append((node, statics_by_name.get(name, set())))
+        return out
+
+    # -- checks inside a traced body --------------------------------------
+    def _check_traced(self, func, statics, module, imp) -> Iterator[Finding]:
+        kwonly = {a.arg for a in func.args.kwonlyargs}
+        positional = func.args.posonlyargs + func.args.args
+        static_names = {s for s in statics if isinstance(s, str)} | {
+            positional[i].arg for i in statics
+            if isinstance(i, int) and i < len(positional)}
+        traced_params = {a.arg for a in positional
+                         if a.arg not in static_names and a.arg != "self"}
+        origins = OriginTracker(imp, seed=traced_params - kwonly)
+        origins.absorb_assignments(func)
+
+        for node, in_lambda in _walk_skip_lambdas(func):
+            if isinstance(node, ast.Call):
+                root = imp.chain_root_module(node.func)
+                chain = dotted(node.func)
+                bare = node.func.id if isinstance(node.func, ast.Name) \
+                    else None
+                clock = rand = None
+                if root == "time" and chain and \
+                        chain[-1] in _CLOCK_ATTRS:
+                    clock = chain[-1]
+                elif bare and imp.from_time.get(bare) in _CLOCK_ATTRS:
+                    clock = imp.from_time[bare]
+                if root == "random" and chain:
+                    rand = chain[-1]
+                elif bare and bare in imp.from_random:
+                    rand = imp.from_random[bare]
+                if clock is not None:
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f"host clock time.{clock}() inside traced "
+                        f"code — the value is baked in at trace time",
+                        node.col_offset)
+                elif rand is not None:
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f"stdlib random.{rand}() inside traced code "
+                        f"— use jax.random with an explicit key",
+                        node.col_offset)
+                elif root == "numpy" and chain and len(chain) >= 2 and \
+                        chain[1] == "random":
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f"np.random.{chain[-1]}() inside traced code — "
+                        f"use jax.random with an explicit key",
+                        node.col_offset)
+                elif isinstance(node.func, ast.Name) and \
+                        node.func.id in ("bool", "float", "int") and \
+                        len(node.args) == 1 and \
+                        origins.jaxish(node.args[0]):
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f"{node.func.id}() on a traced value — "
+                        f"concretization error at trace time",
+                        node.col_offset)
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in ("item", "tolist") and \
+                        origins.jaxish(node.func.value):
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f".{node.func.attr}() on a traced value inside "
+                        f"traced code", node.col_offset)
+            elif isinstance(node, (ast.If, ast.While)):
+                name = self._traced_test_name(node.test, origins)
+                if name is not None:
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        f"Python `{kind}` on traced value {name!r} — use "
+                        f"jnp.where / lax.cond / lax.while_loop",
+                        node.col_offset)
+
+    @staticmethod
+    def _traced_test_name(test: ast.AST, origins: OriginTracker):
+        """A name from the origin set that the test truly branches on.
+        ``x is None`` / ``isinstance(x, T)`` forms are static structure
+        checks and stay legal."""
+        def scan(node):
+            if isinstance(node, ast.Compare) and any(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in node.comparators):
+                return None
+            if isinstance(node, ast.Call):
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id in ("isinstance", "hasattr", "len",
+                                         "getattr"):
+                    return None
+            if isinstance(node, ast.Name) and node.id in origins.names:
+                return node.id
+            if isinstance(node, ast.Attribute):
+                return None  # .shape / .dtype style static metadata
+            for child in ast.iter_child_nodes(node):
+                hit = scan(child)
+                if hit is not None:
+                    return hit
+            return None
+        return scan(test)
+
+
+# ---------------------------------------------------------------------------
+# R004: donation safety
+# ---------------------------------------------------------------------------
+class DonationRule(Rule):
+    """A buffer donated into a jitted dispatch is dead the moment the call
+    is issued; touching it afterwards is undefined on TPU even though CPU
+    happens to keep it alive.  Flags straight-line use-after-donation for
+    jit wrappers created in the same scope."""
+
+    id = "R004"
+    title = "donated buffer referenced after dispatch"
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        imp = module_imports(module, ctx)
+        if not imp.jax and not imp.from_jax:
+            return
+        for scope in _scopes(module.tree):
+            yield from self._check_block(scope.body, module, imp, {})
+        # module-level jit wrappers
+        yield from self._check_block(module.tree.body, module, imp, {})
+
+    @staticmethod
+    def _donated_positions(call: ast.Call):
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                v = kw.value
+                if isinstance(v, ast.Constant) and \
+                        isinstance(v.value, int):
+                    return (v.value,)
+                if isinstance(v, (ast.Tuple, ast.List)):
+                    pos = tuple(e.value for e in v.elts
+                                if isinstance(e, ast.Constant)
+                                and isinstance(e.value, int))
+                    return pos or None
+        return None
+
+    def _check_block(self, stmts, module, imp, donors) -> Iterator[Finding]:
+        donors = dict(donors)
+        for i, stmt in enumerate(stmts):
+            # record `g = jax.jit(f, donate_argnums=...)`
+            if isinstance(stmt, ast.Assign) and \
+                    isinstance(stmt.value, ast.Call) and \
+                    TracerSafetyRule._is_jit_expr(stmt.value.func, imp):
+                pos = self._donated_positions(stmt.value)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and pos:
+                        donors[t.id] = pos
+                    elif isinstance(t, ast.Name):
+                        donors.pop(t.id, None)
+            # nested blocks inherit the donor map
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if isinstance(sub, list) and sub and \
+                        not isinstance(stmt, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)):
+                    yield from self._check_block(sub, module, imp, donors)
+            # dispatch through a recorded donor
+            for call in ast.walk(stmt):
+                if not (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and call.func.id in donors):
+                    continue
+                rebinds = set()
+                if isinstance(stmt, ast.Assign):
+                    for t in stmt.targets:
+                        for el in (t.elts if isinstance(
+                                t, (ast.Tuple, ast.List)) else [t]):
+                            if isinstance(el, ast.Name):
+                                rebinds.add(el.id)
+                for p in donors[call.func.id]:
+                    if p >= len(call.args) or \
+                            not isinstance(call.args[p], ast.Name):
+                        continue
+                    buf = call.args[p].id
+                    if buf in rebinds:
+                        continue  # `carry = g(carry, ...)` fold idiom
+                    yield from self._uses_after(
+                        stmts[i + 1:], stmt, buf, call.func.id, module)
+
+    @staticmethod
+    def _uses_after(rest, dispatch_stmt, buf, fn, module):
+        # a rebind of the buffer name ends its donated lifetime
+        for stmt in rest:
+            rebound = False
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = node.targets if isinstance(node, ast.Assign) \
+                        else [node.target]
+                    for t in targets:
+                        for el in (t.elts if isinstance(
+                                t, (ast.Tuple, ast.List)) else [t]):
+                            if isinstance(el, ast.Name) and el.id == buf:
+                                rebound = True
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and node.id == buf and \
+                        isinstance(node.ctx, ast.Load):
+                    yield Finding(
+                        module.rel, node.lineno, "R004",
+                        f"buffer {buf!r} was donated into {fn}() at line "
+                        f"{dispatch_stmt.lineno} and is referenced "
+                        f"afterwards — XLA may already have reused its "
+                        f"memory", node.col_offset)
+                    return
+            if rebound:
+                return

@@ -1,0 +1,117 @@
+"""R007: kernel-contract registry.
+
+Every Pallas kernel in this repo ships a bit-exact XLA twin built from the
+SAME jnp loop body (README "BP kernel v2", PARITY_*.md).  The parity tests
+prove equality numerically — but only for the shapes they run; the
+structural half of the contract is that kernel and twin keep CALLING the
+shared body, because the day someone copy-pastes the loop "just for this
+variant" the twins can drift one edit at a time while small-shape parity
+still passes.  This rule pins each declared pair to the shared symbols it
+must reach (transitively, across intra-package imports), so copy-paste
+drift is a lint failure with a file:line, not a parity-archaeology
+session on a TPU.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterable, NamedTuple
+
+from .callgraph import reachable_symbols, symbol_table
+from .core import Finding, Rule, SourceModule
+
+__all__ = ["KernelContractRule", "KernelContract", "KERNEL_CONTRACTS"]
+
+
+class KernelContract(NamedTuple):
+    name: str       # human label for the pair
+    module: str     # repo-relative module holding both entry points
+    kernel: str     # Pallas-side entry (or one variant of a pair)
+    twin: str       # XLA-side entry (or the other variant)
+    shared: tuple   # body symbols BOTH must reach transitively
+
+
+_OPS = "qldpc_fault_tolerance_tpu/ops/"
+
+#: The declared pairs.  Adding a kernel/twin pair to the codebase without
+#: registering it here is reviewable; breaking a registered pair fails
+#: tier-1.
+KERNEL_CONTRACTS = (
+    # v2 BP head: Pallas kernel vs XLA twin tile share the whole min-sum
+    # tile body (bf16 plane loop AND the int8 loop)
+    KernelContract(
+        "bp_v2_head", _OPS + "bp_pallas.py",
+        "_sparse_head_kernel", "_sparse_twin_tile",
+        ("_run_minsum_tile", "_minsum_int8_loop")),
+    # v1 and v2 kernels share the bf16 iteration loop — the cross-variant
+    # bit-exactness contract (dense_onehot vs sparse_gather)
+    KernelContract(
+        "bp_v1_v2_loop", _OPS + "bp_pallas.py",
+        "_head_kernel", "_sparse_head_kernel",
+        ("_minsum_plane_loop",)),
+    # fused sampler: kernel and XLA twin draw through the same counter
+    # PRNG and error-cut mapping
+    KernelContract(
+        "fused_sample", _OPS + "gf2_pallas.py",
+        "_sample_syndrome_kernel", "_sample_syndrome_xla",
+        ("threefry2x32", "_errors_from_draws")),
+    # fused residual check: same regeneration contract
+    KernelContract(
+        "fused_residual", _OPS + "gf2_pallas.py",
+        "_residual_check_kernel", "_residual_check_xla",
+        ("threefry2x32", "_errors_from_draws")),
+    # whole-pipeline fused decode: sample + BP + residual — the twin must
+    # reach the same min-sum tile (via bp_pallas) and the same draws
+    KernelContract(
+        "fused_decode", _OPS + "gf2_pallas.py",
+        "_fused_decode_kernel", "_fused_decode_xla",
+        ("_run_minsum_tile", "_errors_from_draws")),
+    # packed residual stats vs per-shot flags: one flag-word algebra
+    KernelContract(
+        "packed_residual", _OPS + "gf2_packed.py",
+        "packed_residual_stats", "packed_residual_flags",
+        ("_residual_flag_words",)),
+)
+
+
+class KernelContractRule(Rule):
+    """Declared kernel/twin pairs must both (still) reach their shared
+    body symbols; missing entry points (renames) are findings too."""
+
+    id = "R007"
+    title = "kernel/twin contract drift"
+
+    def __init__(self, contracts: tuple = KERNEL_CONTRACTS):
+        self.contracts = contracts
+
+    def applies(self, rel: str) -> bool:
+        return any(c.module == rel for c in self.contracts)
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        table = symbol_table(ctx)
+        mod = table.get(module.rel)
+        for c in self.contracts:
+            if c.module != module.rel:
+                continue
+            for role, fn in (("kernel", c.kernel), ("twin", c.twin)):
+                if fn not in mod.defs:
+                    yield Finding(
+                        module.rel, 1, self.id,
+                        f"contract {c.name!r}: {role} entry point "
+                        f"{fn}() no longer exists — update the contract "
+                        f"registry in analysis/rules_kernels.py with the "
+                        f"rename, or restore the function")
+            if c.kernel not in mod.defs or c.twin not in mod.defs:
+                continue
+            for role, fn in (("kernel", c.kernel), ("twin", c.twin)):
+                reach = {name for _rel, name in
+                         reachable_symbols(ctx, module.rel, fn)}
+                for sym in c.shared:
+                    if sym not in reach:
+                        node = mod.defs[fn]
+                        yield Finding(
+                            module.rel, node.lineno, self.id,
+                            f"contract {c.name!r}: {role} {fn}() no "
+                            f"longer reaches shared body {sym}() — "
+                            f"kernel/twin bit-exactness rests on one "
+                            f"definition; re-route through it instead "
+                            f"of a private copy", node.col_offset)

@@ -318,8 +318,10 @@ def ThresholdEst_extrapolation(sweep_p_list, sweep_pl_total_list,
     if verbose:
         from ..utils.observability import get_logger, log_record
 
-        log_record(get_logger(), "threshold_fit", p_c=float(p_c),
-                   A=float(A))
+        # the legacy verbose path logs through the registered fit_report
+        # vocabulary (EVENT_SCHEMAS) — "threshold_fit" was schema drift
+        log_record(get_logger(), "fit_report", fit="legacy_threshold",
+                   converged=True, p_c=float(p_c), A=float(A))
     return p_c
 
 

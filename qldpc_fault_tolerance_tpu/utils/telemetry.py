@@ -83,16 +83,18 @@ LATENCY_BUCKETS = tuple(
 # (``set_default_buckets`` or the QLDPC_HIST_BUCKETS env var, a JSON
 # object {"metric.name": [edge, ...]}).
 _BUCKET_SPECS: dict = {}
+_BUCKET_LOCK = threading.Lock()
 
 
 def set_default_buckets(name: str, buckets) -> None:
     """Register default histogram boundaries for ``name`` (None removes
     the spec).  Takes effect for histograms not yet created — an existing
     histogram keeps its boundaries (counts cannot be rebucketed)."""
-    if buckets is None:
-        _BUCKET_SPECS.pop(str(name), None)
-    else:
-        _BUCKET_SPECS[str(name)] = tuple(float(b) for b in buckets)
+    with _BUCKET_LOCK:
+        if buckets is None:
+            _BUCKET_SPECS.pop(str(name), None)
+        else:
+            _BUCKET_SPECS[str(name)] = tuple(float(b) for b in buckets)
 
 
 def default_buckets(name: str):
@@ -438,6 +440,12 @@ _V2_EVENT_KINDS = frozenset({
 
 # the v3 additions, frozen with the same guarantee at the v4 bump
 _V3_EVENT_KINDS = frozenset({"rare_stratum"})
+
+# the v4 additions (ISSUE 11 observability layer), frozen with the same
+# guarantee for the eventual v5 bump.  qldpc-lint's R005 pins every
+# frozen set's size and membership against EVENT_SCHEMAS, so shrinking
+# any of these is a tier-1 failure before it is a consumer outage.
+_V4_EVENT_KINDS = frozenset({"trace", "slo_alert", "process_info"})
 
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
@@ -821,19 +829,22 @@ def enable(jsonl_path: str | None = None) -> None:
                                 for s in _SINKS)
             if not streaming:
                 s = JsonlSink(jsonl_path)
-                _OWNED_SINKS.append(s)
+                with _SINK_LOCK:
+                    _OWNED_SINKS.append(s)
                 add_sink(s)
         return
     _install_compile_tracker()
     if not _TRACKER_STATE["listener_fired"]:
         # scope the cache-miss fallback delta to this enabled region, not
         # process lifetime (warmups compile before the first enable)
-        _TRACKER_STATE["miss_baseline"] = _cache_miss_count()
+        with _TRACKER_LOCK:
+            _TRACKER_STATE["miss_baseline"] = _cache_miss_count()
     if jsonl_path is None:
         jsonl_path = os.environ.get("QLDPC_TELEMETRY_JSONL") or None
     if jsonl_path is not None:
         s = JsonlSink(jsonl_path)
-        _OWNED_SINKS.append(s)
+        with _SINK_LOCK:
+            _OWNED_SINKS.append(s)
         add_sink(s)
     _ENABLED = True
     event("telemetry_enabled", pid=os.getpid())
@@ -847,8 +858,10 @@ def disable() -> None:
     in the registry until ``reset()``."""
     global _ENABLED
     _ENABLED = False
-    while _OWNED_SINKS:
-        s = _OWNED_SINKS.pop()
+    with _SINK_LOCK:
+        owned = list(_OWNED_SINKS)
+        _OWNED_SINKS.clear()
+    for s in owned:
         remove_sink(s)
         try:
             s.close()
@@ -897,6 +910,9 @@ _COMPILE_EVENTS = {
 }
 _TRACKER_STATE = {"installed": False, "listener_fired": False,
                   "miss_baseline": None}
+# guards install-time check-and-set and baseline rewrites; the listener's
+# own flag flip stays lock-free (see the suppression at the write site)
+_TRACKER_LOCK = threading.Lock()
 
 
 def _cache_miss_count():
@@ -921,10 +937,11 @@ def _install_compile_tracker() -> None:
     backend compiles and their wall-clock.  Listeners cannot be
     unregistered individually, so they are installed once and check the
     enable switch themselves (one boolean when disabled)."""
-    if _TRACKER_STATE["installed"]:
-        return
-    _TRACKER_STATE["installed"] = True
-    _TRACKER_STATE["miss_baseline"] = _cache_miss_count()
+    with _TRACKER_LOCK:
+        if _TRACKER_STATE["installed"]:
+            return
+        _TRACKER_STATE["installed"] = True
+        _TRACKER_STATE["miss_baseline"] = _cache_miss_count()
     try:
         from jax import monitoring as _mon
 
@@ -934,7 +951,10 @@ def _install_compile_tracker() -> None:
             name = _COMPILE_EVENTS.get(ev)
             if name is None:
                 return
-            _TRACKER_STATE["listener_fired"] = True
+            # GIL-atomic boolean flip on the compile hot path; a lock here
+            # would serialize every jax compile event for no correctness
+            # gain (same swap-whole idiom as _SINKS_SNAPSHOT)
+            _TRACKER_STATE["listener_fired"] = True  # qldpc: ignore[R006]
             reg = _REGISTRY
             reg.counter(name).inc()
             reg.counter(name + ".seconds").inc(float(duration_secs))

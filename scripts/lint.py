@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""qldpc-lint launcher: ``python scripts/lint.py [--json] [--select ...]``.
+
+Thin wrapper over ``python -m qldpc_fault_tolerance_tpu.analysis`` so the
+analyzer runs from a fresh checkout without installing the package.  See
+README "Static analysis" for the rule table and suppression syntax.
+"""
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from qldpc_fault_tolerance_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -511,33 +511,31 @@ def test_sweep_eval_wer_resumes_through_checkpoint(tmp_path):
 # guard: no bare sleeps / ad-hoc retry loops outside utils/resilience.py
 # ---------------------------------------------------------------------------
 def test_no_bare_sleep_or_retry_loops_in_library():
-    """All backoff/retry machinery must live in utils/resilience.py so
-    retry behavior and counters stay identical across parity, sweeps, and
-    user code (mirrors the PR-2 no-bare-print guard).  scripts/parity.py is
-    included: its ad-hoc loop is what this PR replaced."""
-    allowed = {os.path.join("utils", "resilience.py")}
-    scripts_dir = os.path.join(os.path.dirname(LIB_ROOT), "scripts")
-    targets = []
-    for dirpath, _dirnames, filenames in os.walk(LIB_ROOT):
-        targets += [os.path.join(dirpath, fn) for fn in filenames
-                    if fn.endswith(".py")]
-    targets.append(os.path.join(scripts_dir, "parity.py"))
-    offenders = []
-    for path in targets:
-        rel = os.path.relpath(path, LIB_ROOT)
-        if rel in allowed:
-            continue
-        with open(path, encoding="utf-8") as fh:
-            for lineno, line in enumerate(fh, 1):
-                stripped = line.lstrip()
-                if stripped.startswith("#"):
-                    continue
-                if "time.sleep(" in stripped or \
-                        "for attempt in range" in stripped:
-                    offenders.append(f"{rel}:{lineno}: {stripped.rstrip()}")
-    assert not offenders, (
-        "bare sleep / ad-hoc retry loop outside utils/resilience.py "
-        "(use resilience.RetryPolicy / sleep_for):\n" + "\n".join(offenders))
+    """Thin shim (ISSUE 12): the PR-7 grep guard migrated into qldpc-lint
+    as rule R102 so guard logic lives in exactly one engine.  This asserts
+    the rule stays enabled with the same scope (library + scripts/parity.py,
+    utils/resilience.py exempt); enforcement over the real tree is
+    tests/test_analysis.py's full-package gate."""
+    from qldpc_fault_tolerance_tpu import analysis
+
+    rules = {r.id: r for r in analysis.default_rules()}
+    assert "R102" in rules, "bare-sleep rule dropped from default set"
+    r102 = rules["R102"]
+    assert not r102.applies("qldpc_fault_tolerance_tpu/utils/resilience.py")
+    assert r102.applies("qldpc_fault_tolerance_tpu/sweep/family.py")
+    assert r102.applies("scripts/parity.py")
+    # the migrated rule fires on what the grep guard fired on
+    from qldpc_fault_tolerance_tpu.analysis import (AnalysisContext,
+                                                    SourceModule,
+                                                    run_analysis)
+
+    mod = SourceModule.parse(
+        "scripts/parity.py",
+        "import time\n\ndef f():\n    for attempt in range(5):\n"
+        "        time.sleep(1.0)\n")
+    res = run_analysis([mod], [r102], ctx=AnalysisContext([mod]))
+    assert {f.rule for f in res.findings} == {"R102"}
+    assert len(res.findings) == 2
 
 
 # ---------------------------------------------------------------------------
