@@ -70,6 +70,14 @@ KERNEL_CONTRACTS = (
         "packed_residual", _OPS + "gf2_packed.py",
         "packed_residual_stats", "packed_residual_flags",
         ("_residual_flag_words",)),
+    # blocked OSD elimination (ISSUE 13): the VMEM kernel and the XLA twin
+    # that makes device OSD the default BPOSD backend off-TPU must both
+    # reach the shared phase-A micro-step and phase-B block update —
+    # bit-exactness of the whole BPOSD-on-device story rests on them
+    KernelContract(
+        "osd_elim_blocked", _OPS + "osd_device.py",
+        "_elim_blocked_kernel", "_eliminate_blocked_twin",
+        ("_blocked_stepA", "_blocked_phaseB_delta")),
 )
 
 

@@ -28,6 +28,7 @@ from .osd import osd_postprocess
 __all__ = [
     "device_syndrome_width",
     "kernel_variant",
+    "osd_compaction_tiers",
     "BPDecoder",
     "BPOSD_Decoder",
     "FirstMinBPDecoder",
@@ -57,6 +58,19 @@ _BP_METHOD_ALIASES = {
 
 def _norm_method(bp_method: str) -> str:
     return _BP_METHOD_ALIASES[str(bp_method).lower()]
+
+
+def osd_compaction_tiers(batch_size: int) -> tuple:
+    """Straggler-compaction capacities a ``bposd_dev`` decode of this batch
+    size instantiates, ascending (empty for batches too small to compact).
+    ONE definition shared by the dispatch logic in ``decode_device`` and
+    the telemetry tier-occupancy accounting (utils.telemetry
+    ``device_tele_vec``), so the occupancy counters can never drift from
+    the program the decode actually runs."""
+    B = int(batch_size)
+    return tuple(c for c in dict.fromkeys((max(B // 16, 128),
+                                           max(B // 4, 128)))
+                 if c < B and c % 128 == 0)
 
 
 def decode_device(static, state, syndromes):
@@ -98,10 +112,11 @@ def decode_device(static, state, syndromes):
 
         # straggler compaction (same trick as bp_decode_two_phase): OSD only
         # the BP-failed shots, gathered into a fixed-capacity sub-batch
-        # (one mid tier at B/4, then full batch): OSD cost is linear in the
-        # compacted size, so when most shots converge the tier wins;
-        # results never depend on which tier runs.  Tiers stay multiples of
-        # 128 (the Pallas elimination's batch-tile width).
+        # (a small tier at B/16 and a mid tier at B/4, then full batch):
+        # OSD cost is linear in the compacted size, so when most shots
+        # converge the tier wins; results never depend on which tier runs.
+        # Tiers stay multiples of 128 (the Pallas elimination's batch-tile
+        # width).
         def compacted_fn(capacity):
             def run(_):
                 idx = jnp.nonzero(bad, size=capacity, fill_value=B)[0]
@@ -126,13 +141,16 @@ def decode_device(static, state, syndromes):
             return err
 
         n_bad = bad.sum()
-        # one mid tier: each tier instantiates the full OSD program (pallas
-        # elimination + scoring) in the traced pipeline, so more tiers cost
-        # real trace/compile/cache-load time per (code, p) sweep shape
-        # one mid tier at B//4 (floored at 128, the Pallas batch-tile width,
-        # so small batches still compact — the Pallas elimination needs the
-        # multiple-of-128 capacity; non-conforming sizes fall back to XLA)
-        tiers = [c for c in (max(B // 4, 128),) if c < B and c % 128 == 0]
+        # two tiers (B//16, B//4), floored at 128 (the Pallas batch-tile
+        # width, so small batches still compact — the Pallas elimination
+        # needs the multiple-of-128 capacity; non-conforming sizes route to
+        # the XLA twin).  Each tier instantiates the full OSD program
+        # (elimination + scoring) in the traced pipeline, so the ladder is
+        # kept short; at flagship batch sizes the small tier covers the
+        # common low-p case (a few stragglers) at 1/16th the elimination
+        # cost.  Tier selection changes the program PATH only, never a
+        # shot's result — pinned by the tier-equivalence test.
+        tiers = list(osd_compaction_tiers(B))
         out = full
         for cap in reversed(tiers):
             out = (lambda cap, nxt: lambda o: jax.lax.cond(
@@ -489,18 +507,24 @@ class BPDecoder:
 class BPOSD_Decoder(BPDecoder):
     """BP + OSD (reference BPOSD_Decoder, src/Decoders.py:26-41).
 
-    BP runs on TPU for the whole batch.  OSD post-processing runs either
+    BP runs batched on device for the whole batch, and OSD post-processing
+    is **device-resident by default on every substrate** (ops/osd_device.py:
+    batched bit-packed GF(2) elimination — the blocked Pallas kernel on
+    TPU, its bit-exact XLA twin elsewhere — plus MXU-scored OSD-E
+    reprocessing).  That keeps BPOSD pipelines pure device code
+    (mesh-shardable, scan-chunkable, servable, megabatch-foldable with
+    ``osd.host_round_trips == 0``).
 
-      * **on device** (ops/osd_device.py: batched bit-packed GF(2)
-        elimination + MXU-scored OSD-E reprocessing) — the default on TPU,
-        where it removes the host round-trip entirely and keeps BPOSD
-        pipelines pure device code (mesh-shardable, scan-chunkable); or
-      * **on host** (native C++, _native/osd.cpp) for the shots whose BP
-        output misses the syndrome — the default on CPU backends and for
-        osd_cs (not implemented on device).
+    The host path (native C++ / numpy, _native/osd.cpp) is demoted to a
+    resilience-ladder rung and test oracle: ``decode_batch`` falls back to
+    it when the device OSD program faults, ``device_osd=False`` selects it
+    explicitly, and osd_cs (not implemented on device) still requires it.
 
-    ``device_osd``: True / False / "auto" (TPU => device).  Both paths
-    implement identical semantics (pinned against the same numpy oracle).
+    ``device_osd``: True / False / "auto" (device wherever the method is
+    device-implementable; ``QLDPC_DEVICE_OSD=0`` restores the host
+    default).  Both paths implement identical semantics (pinned against
+    the same numpy oracle; costs are float32 on device vs the C++
+    float64, so only float-tied candidates may differ).
     """
 
     def __init__(self, h, channel_probs, max_iter, bp_method="minimum_sum",
@@ -512,11 +536,7 @@ class BPOSD_Decoder(BPDecoder):
         _DEVICE_METHODS = ("osd_e", "osd0", "osd_0", "exhaustive")
         if device_osd == "auto":
             env = os.environ.get("QLDPC_DEVICE_OSD", "1")
-            try:
-                on_tpu = jax.default_backend() == "tpu"
-            except Exception:
-                on_tpu = False
-            device_osd = (env != "0" and on_tpu
+            device_osd = (env != "0"
                           and self.osd_method in _DEVICE_METHODS)
         elif device_osd and self.osd_method not in _DEVICE_METHODS:
             raise NotImplementedError(
@@ -584,18 +604,40 @@ class BPOSD_Decoder(BPDecoder):
 
         syndromes = np.atleast_2d(np.asarray(syndromes))
         if self.device_osd:
-            out, aux = self.decode_batch_device(jnp.asarray(syndromes))
+            try:
+                out, aux = self.decode_batch_device(jnp.asarray(syndromes))
+                # materialize INSIDE the try: device dispatches are async,
+                # so an execution-time worker fault surfaces at the fetch —
+                # the fallback must cover it, not just trace/compile errors
+                out = np.asarray(out)
+                aux = {k: np.asarray(v) for k, v in aux.items()
+                       if k in ("converged", "iterations")}
+            except Exception:
+                # resilience rung: the demoted host C++/numpy path serves
+                # the batch when the device OSD program faults (compile,
+                # dispatch, or execution) — same semantics, pinned against
+                # the same oracle, so the fallback is loud in telemetry
+                # but silent in results
+                telemetry.count("osd.host_fallbacks")
+                telemetry.event("degrade", rung="device_osd->host")
+                res = self.bp_batch_device(jnp.asarray(syndromes))
+                if telemetry.enabled():
+                    telemetry.record_bp_aux(
+                        {"converged": np.asarray(res.converged),
+                         "iterations": np.asarray(res.iterations)})
+                return self.osd_host(
+                    syndromes, np.asarray(res.error),
+                    np.asarray(res.converged),
+                    np.asarray(res.posterior_llr))
             if telemetry.enabled():
-                telemetry.record_bp_aux(
-                    {k: np.asarray(v) for k, v in aux.items()
-                     if k in ("converged", "iterations")})
+                telemetry.record_bp_aux(aux)
                 conv = aux.get("converged")
                 if conv is not None:
                     # mirror device_tele_vec: BP-failed shots routed to the
                     # device OSD stage count as OSD fallback pressure
                     telemetry.count("osd.device_shots",
-                                    int((~np.asarray(conv)).sum()))
-            return np.asarray(out)
+                                    int((~conv).sum()))
+            return out
         res = self.bp_batch_device(jnp.asarray(syndromes))
         if telemetry.enabled():
             telemetry.record_bp_aux(

@@ -464,23 +464,92 @@ def _eliminate_pallas(plan, perm, syndromes, bt: int = 128,
 
 
 # ---------------------------------------------------------------------------
-# Blocked Pallas elimination (the default on TPU): the _eliminate_blocked
-# algorithm with all per-block state VMEM-resident.  One kernel launch per
-# batch tile runs the whole elimination; the only HBM traffic is the initial
-# permuted-matrix read.  Additionally maintains the "free panel" F — for
-# every row, the bits at the first ``fcap`` pivotless (free) columns — so the
-# caller needs neither the reduced matrix nor a post-loop T extraction:
-# OSD-E's T is F gathered at the pivot rows.
+# Blocked elimination, shared kernel/twin bodies: the _eliminate_blocked
+# algorithm with all per-block state VMEM-resident (Pallas) or carried
+# through an XLA while_loop (twin).  Both entry points build their loops
+# over the SAME phase-A / phase-B bodies below — the bit-exactness contract
+# is structural (analysis/rules_kernels.py "osd_elim_blocked"), not just
+# numerically pinned, so the pair cannot drift one edit at a time.
+# Additionally maintains the "free panel" F — for every row, the bits at
+# the first ``fcap`` pivotless (free) columns — so the caller needs neither
+# the reduced matrix nor a post-loop T extraction: OSD-E's T is F gathered
+# at the pivot rows.
+def _blocked_stepA(j, c, *, t_word, n: int, fcap: int):
+    """One micro-elimination step (bit ``j`` of the current word block) —
+    THE shared phase-A body of the blocked Pallas kernel and its XLA twin:
+    both run their 32-step ``fori_loop`` over this function.  Integer ops
+    throughout, so kernel and twin are bit-identical by construction.
+    Carry: ``(cw, synd, used, fword, rank, fcnt, aug, pivword, pr, pc,
+    fpos)`` with the batch on the minor axis."""
+    i32 = jnp.int32
+    (cw, synd, used, fword, rank, fcnt, aug, pivword, pr, pc, fpos) = c
+    m, bt = cw.shape
+    r_star = pr.shape[0]
+    rows_m = jax.lax.broadcasted_iota(i32, (m, bt), 0)
+    slots = jax.lax.broadcasted_iota(i32, (r_star, bt), 0)
+    k32 = jax.lax.broadcasted_iota(i32, (32, bt), 0)
+    srl = jax.lax.shift_right_logical
+    t = t_word * 32 + j
+    bits = srl(cw, j) & 1
+    active = jnp.where(rank < r_star, 1, 0)            # (bt,)
+    avail = bits * (1 - used) * active[None, :]
+    cand = jnp.where(avail == 1, rows_m, m)
+    piv = jnp.min(cand, axis=0)                        # first avail
+    has = jnp.where((piv < m) & (t < n), 1, 0)
+    piv = jnp.where(piv < m, piv, 0)
+    onehot = jnp.where(rows_m == piv[None, :], has[None, :], 0)
+    prow = jnp.sum(onehot * cw, axis=0)                # (bt,)
+    ps = jnp.sum(onehot * synd, axis=0)
+    paug = jnp.sum(onehot * aug, axis=0)
+    pf = jnp.sum(onehot * fword, axis=0)
+    clear = bits * (1 - onehot) * has[None, :]
+    cw = cw ^ (clear * prow[None, :])
+    synd = synd ^ (clear * ps[None, :])
+    jbit = jax.lax.shift_left(jnp.int32(1), j)
+    aug = aug ^ (clear * ((paug ^ jbit)[None, :]))
+    fword = fword ^ (clear * pf[None, :])
+    pivword = pivword | jax.lax.shift_left(onehot, j)
+    # free-column panel: no pivot at a real column -> record its
+    # (current, reduced) bits at free slot fcnt
+    grow = (1 - has) * jnp.where((fcnt < fcap) & (t < n), 1, 0)
+    kshift = jnp.minimum(fcnt, 31)
+    fword = fword ^ (jax.lax.shift_left(bits, kshift[None, :])
+                     * grow[None, :])
+    fpos = jnp.where((k32 == fcnt[None, :]) & (grow[None, :] == 1),
+                     t, fpos)
+    # pivot slot bookkeeping (each slot written at most once ever)
+    at = jnp.where((slots == rank[None, :]) & (has[None, :] == 1), 1, 0)
+    pr = jnp.where(at == 1, piv[None, :], pr)
+    pc = jnp.where(at == 1, t, pc)
+    used = used | onehot
+    rank = rank + has
+    fcnt = fcnt + grow
+    return (cw, synd, used, fword, rank, fcnt, aug, pivword, pr, pc, fpos)
+
+
+def _blocked_phaseB_delta(row, pivword, aug):
+    """Fused 32-term block update for ONE packed word — THE shared phase-B
+    body of the blocked kernel/twin pair.  ``row`` must be the word's
+    block-START value: bit j of ``aug[r]`` selects step j's block-start
+    pivot row into row r's XOR accumulator, reproducing the phase-A
+    cascade exactly for any word of the matrix."""
+    srl = jax.lax.shift_right_logical
+
+    def term(j, acc):
+        oh = srl(pivword, j) & 1
+        g0 = jnp.sum(oh * row, axis=0)                 # (bt,)
+        sel = 0 - (srl(aug, j) & 1)
+        return acc ^ (sel & g0[None, :])
+
+    return jax.lax.fori_loop(0, 32, term, jnp.zeros_like(row))
+
+
 def _elim_blocked_kernel(packed_ref, synd_ref,
                          synd_out_ref, pr_ref, pc_ref, fword_ref, fpos_ref,
                          work_ref, used_ref, rank_ref, fcnt_ref,
                          *, W: int, m: int, n: int, r_star: int, fcap: int,
                          bt: int):
     i32 = jnp.int32
-    rows_m = jax.lax.broadcasted_iota(i32, (m, bt), 0)
-    slots = jax.lax.broadcasted_iota(i32, (r_star, bt), 0)
-    k32 = jax.lax.broadcasted_iota(i32, (32, bt), 0)
-    srl = jax.lax.shift_right_logical
 
     work_ref[:] = packed_ref[:]
     synd_out_ref[:] = synd_ref[:]
@@ -500,58 +569,21 @@ def _elim_blocked_kernel(packed_ref, synd_ref,
     def body(t_word):
         cw0 = work_ref[pl.ds(t_word, 1)][0]                    # (m, bt)
 
-        # phase A: 32 micro-elimination steps as a fori_loop (a traced bit
-        # index keeps the kernel ~30x smaller to trace/lower than a python
-        # unroll, which matters: every (tier, sector, shape) instantiates
-        # this kernel inside the simulators' jitted pipelines)
-        def stepA(j, c):
-            (cw, synd, used, fword, rank, fcnt, aug, pivword, pr, pc,
-             fpos) = c
-            t = t_word * 32 + j
-            bits = srl(cw, j) & 1
-            active = jnp.where(rank < r_star, 1, 0)            # (bt,)
-            avail = bits * (1 - used) * active[None, :]
-            cand = jnp.where(avail == 1, rows_m, m)
-            piv = jnp.min(cand, axis=0)                        # first avail
-            has = jnp.where((piv < m) & (t < n), 1, 0)
-            piv = jnp.where(piv < m, piv, 0)
-            onehot = jnp.where(rows_m == piv[None, :], has[None, :], 0)
-            prow = jnp.sum(onehot * cw, axis=0)                # (bt,)
-            ps = jnp.sum(onehot * synd, axis=0)
-            paug = jnp.sum(onehot * aug, axis=0)
-            pf = jnp.sum(onehot * fword, axis=0)
-            clear = bits * (1 - onehot) * has[None, :]
-            cw = cw ^ (clear * prow[None, :])
-            synd = synd ^ (clear * ps[None, :])
-            jbit = jax.lax.shift_left(jnp.int32(1), j)
-            aug = aug ^ (clear * ((paug ^ jbit)[None, :]))
-            fword = fword ^ (clear * pf[None, :])
-            pivword = pivword | jax.lax.shift_left(onehot, j)
-            # free-column panel: no pivot at a real column -> record its
-            # (current, reduced) bits at free slot fcnt
-            grow = (1 - has) * jnp.where((fcnt < fcap) & (t < n), 1, 0)
-            kshift = jnp.minimum(fcnt, 31)
-            fword = fword ^ (jax.lax.shift_left(bits, kshift[None, :])
-                             * grow[None, :])
-            fpos = jnp.where((k32 == fcnt[None, :]) & (grow[None, :] == 1),
-                             t, fpos)
-            # pivot slot bookkeeping (each slot written at most once ever)
-            at = jnp.where((slots == rank[None, :]) & (has[None, :] == 1),
-                           1, 0)
-            pr = jnp.where(at == 1, piv[None, :], pr)
-            pc = jnp.where(at == 1, t, pc)
-            used = used | onehot
-            rank = rank + has
-            fcnt = fcnt + grow
-            return (cw, synd, used, fword, rank, fcnt, aug, pivword, pr,
-                    pc, fpos)
-
+        # phase A: 32 micro-elimination steps as a fori_loop over the
+        # SHARED body (a traced bit index keeps the kernel ~30x smaller to
+        # trace/lower than a python unroll, which matters: every (tier,
+        # sector, shape) instantiates this kernel inside the simulators'
+        # jitted pipelines)
         init = (cw0, synd_out_ref[:], used_ref[:], fword_ref[:],
                 rank_ref[0, :], fcnt_ref[0, :],
                 jnp.zeros((m, bt), i32), jnp.zeros((m, bt), i32),
                 pr_ref[:], pc_ref[:], fpos_ref[:])
         (_, synd, used, fword, rank, fcnt, aug, pivword, pr, pc,
-         fpos) = jax.lax.fori_loop(0, 32, stepA, init)
+         fpos) = jax.lax.fori_loop(
+            0, 32,
+            functools.partial(_blocked_stepA, t_word=t_word, n=n,
+                              fcap=fcap),
+            init)
         synd_out_ref[:] = synd
         used_ref[:] = used
         fword_ref[:] = fword
@@ -574,15 +606,7 @@ def _elim_blocked_kernel(packed_ref, synd_ref,
         # kernel's dominant cost on average.
         def stepB(w_i, _):
             row = work_ref[pl.ds(w_i, 1)][0]                   # (m, bt)
-
-            def term(j, acc):
-                oh = srl(pivword, j) & 1
-                g0 = jnp.sum(oh * row, axis=0)                 # (bt,)
-                sel = 0 - (srl(aug, j) & 1)
-                return acc ^ (sel & g0[None, :])
-
-            acc = jax.lax.fori_loop(0, 32, term,
-                                    jnp.zeros((m, bt), i32))
+            acc = _blocked_phaseB_delta(row, pivword, aug)
             work_ref[pl.ds(w_i, 1)] = (row ^ acc)[None]
             return 0
 
@@ -653,6 +677,65 @@ def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
     return synd, pr, pc, fword, fpos
 
 
+def _eliminate_blocked_twin(plan, perm, syndromes, fcap: int):
+    """XLA twin of the blocked VMEM kernel, built from the SAME phase-A /
+    phase-B bodies (``_blocked_stepA`` / ``_blocked_phaseB_delta``) — the
+    structural contract is registered in analysis/rules_kernels.py
+    ("osd_elim_blocked") so copy-paste drift is a lint failure.  Integer
+    arithmetic throughout, so twin and kernel are bit-identical; this is
+    what lets ``device_osd`` engage (and default) off-TPU.
+
+    Same returns as ``_eliminate_pallas_blocked``: ``(synd (m, B) fully
+    reduced, pivot_rows (r*, B), pivot_cols_perm (r*, B), fword (m, B)
+    free-panel words, fpos (32, B) permuted free-column positions)``.
+    Phase B applies the fused block update only to words strictly RIGHT of
+    the current block — the same dead-word skip the kernel's ``stepB``
+    range encodes — so every word the loop later reads holds exactly the
+    value the kernel's VMEM scratch would."""
+    B = perm.shape[0]
+    m, n, r_star = plan.m, plan.n, plan.rank
+    W = (n + 31) // 32
+    i32 = jnp.int32
+    h01 = _unpack_rows(plan.packed, n)
+    packed0 = _permute_and_pack(h01, perm).astype(i32)         # (W, m, B)
+    synd0 = syndromes.astype(i32).T                            # (m, B)
+    words = jax.lax.broadcasted_iota(i32, (W, 1, 1), 0)
+
+    def cond(c):
+        t_word, rank, fcnt = c[0], c[5], c[6]
+        more_rank = jnp.min(rank) < r_star
+        more_free = jnp.min(fcnt) < int(fcap)
+        return (t_word < W) & (more_rank | more_free)
+
+    def body(c):
+        (t_word, packed, synd, used, fword, rank, fcnt, pr, pc, fpos) = c
+        cw0 = jax.lax.dynamic_slice(packed, (t_word, 0, 0), (1, m, B))[0]
+        init = (cw0, synd, used, fword, rank, fcnt,
+                jnp.zeros((m, B), i32), jnp.zeros((m, B), i32), pr, pc,
+                fpos)
+        (_, synd, used, fword, rank, fcnt, aug, pivword, pr, pc,
+         fpos) = jax.lax.fori_loop(
+            0, 32,
+            functools.partial(_blocked_stepA, t_word=t_word, n=n,
+                              fcap=int(fcap)),
+            init)
+        delta = jax.vmap(
+            lambda row: _blocked_phaseB_delta(row, pivword, aug))(packed)
+        live = 0 - (words > t_word).astype(i32)    # all-ones mask, w > t
+        packed = packed ^ (delta & live)
+        return (t_word + 1, packed, synd, used, fword, rank, fcnt, pr, pc,
+                fpos)
+
+    state = (jnp.int32(0), packed0, synd0,
+             jnp.zeros((m, B), i32), jnp.zeros((m, B), i32),
+             jnp.zeros((B,), i32), jnp.zeros((B,), i32),
+             jnp.zeros((r_star, B), i32), jnp.zeros((r_star, B), i32),
+             jnp.zeros((32, B), i32))
+    (_t, _packed, synd, _used, fword, _rank, _fcnt, pr, pc,
+     fpos) = jax.lax.while_loop(cond, body, state)
+    return synd, pr, pc, fword, fpos
+
+
 def osd_decode_device(plan: OsdPlan, syndromes, posterior_llrs,
                       osd_order: int = 10, pat_chunk: int = 256):
     """OSD-E decode a batch on device. Returns (B, n) uint8 errors.
@@ -689,15 +772,20 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     bt = 128
     w = min(int(osd_order), n - r_star, 20)
     # elimination strategy (QLDPC_OSD_ELIM): "pallas" (default) = the
-    # VMEM-resident blocked kernel, falling back to XLA when infeasible;
-    # "blocked" / "percol" = the XLA variants; "pallas_percol" = the
-    # original per-column experimental kernel.
+    # VMEM-resident blocked kernel; off-TPU (or at shapes the kernel's
+    # gates reject) it routes to "twin" — the XLA twin built from the SAME
+    # blocked body, which is what makes device OSD the default BPOSD
+    # backend on every substrate.  "blocked" / "percol" = the standalone
+    # XLA variants (test oracles); "pallas_percol" = the original
+    # per-column experimental kernel.
     if elim == "pallas" and not (
         B % bt == 0
         and r_star >= 1
         and _elim_blocked_pallas_ok(W, plan.m, n, r_star, bt)
         and jax.default_backend() == "tpu"
     ):
+        elim = "twin"
+    if elim == "twin" and r_star < 1:
         elim = "blocked"
     if elim == "pallas_percol" and not (
         B % bt == 0
@@ -707,10 +795,15 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     ):
         elim = "blocked"  # same fallback the old opt-in guard provided
 
-    if elim == "pallas":
-        synd_r, piv_rows_t, piv_cols_perm_t, fword_r, fpos = \
-            _eliminate_pallas_blocked(plan, perm, syndromes, fcap=max(w, 0),
-                                      bt=bt)
+    if elim in ("pallas", "twin"):
+        if elim == "pallas":
+            synd_r, piv_rows_t, piv_cols_perm_t, fword_r, fpos = \
+                _eliminate_pallas_blocked(plan, perm, syndromes,
+                                          fcap=max(w, 0), bt=bt)
+        else:
+            synd_r, piv_rows_t, piv_cols_perm_t, fword_r, fpos = \
+                _eliminate_blocked_twin(plan, perm, syndromes,
+                                        fcap=max(w, 0))
         u_piv_t = jnp.take_along_axis(synd_r, piv_rows_t, axis=0)  # (r*, B)
         free_perm = fpos[:w] if w > 0 else None                # (w, B)
         if w > 0:

@@ -155,6 +155,12 @@ class DecodeSession:
         # sparse-incidence routing automatically) — recorded so serving
         # dashboards can name the kernel behind a session
         self.kernel_variant = kernel_variant(self.static, self.state)
+        # whether the session's compiled program carries a device-resident
+        # OSD stage (ISSUE 13: BPOSD sessions serve paper-grade accuracy
+        # with zero warm-path retraces) — "host" can never appear, host-OSD
+        # configs are rejected at construction
+        self.osd_backend = ("device" if self.static[0] == "bposd_dev"
+                           else "none")
         telemetry.count("serve.session.builds")
 
     # ------------------------------------------------------------------
@@ -205,7 +211,8 @@ class DecodeSession:
                             # the compiled program's variant may differ
                             # from the session-level one
                             kernel_variant=kernel_variant(
-                                self.static, self.state, int(bucket)))
+                                self.static, self.state, int(bucket)),
+                            osd_backend=self.osd_backend)
             return prog
 
     def warm(self, max_shots: int | None = None) -> list[int]:
@@ -236,7 +243,8 @@ class DecodeSession:
             telemetry.event("serve_session", session=self.name,
                             event="invalidate",
                             syndrome_width=self.syndrome_width,
-                            kernel_variant=self.kernel_variant)
+                            kernel_variant=self.kernel_variant,
+                            osd_backend=self.osd_backend)
 
     # ------------------------------------------------------------------
     # serving

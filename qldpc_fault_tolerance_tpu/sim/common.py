@@ -718,8 +718,25 @@ def joint_kernel_variant(*decoders, batch_size: int | None = None) -> str:
     return vs.pop() if len(vs) == 1 else "mixed"
 
 
+def joint_osd_backend(*decoders) -> str:
+    """Where a simulator's OSD stages run (the ``wer_run`` ``osd_backend``
+    field): "device" when every OSD-bearing decoder keeps its OSD inside
+    the device program, "host" when every one still round-trips,
+    "mixed" on disagreement, "none" when no decoder has an OSD stage."""
+    backends = set()
+    for dec in decoders:
+        if getattr(dec, "osd_method", None) is None:
+            continue
+        backends.add("host" if getattr(dec, "needs_host_postprocess", False)
+                     else "device")
+    if not backends:
+        return "none"
+    return backends.pop() if len(backends) == 1 else "mixed"
+
+
 def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
-                   kernel_variant=None, weighted=None, tilt=None):
+                   kernel_variant=None, weighted=None, tilt=None,
+                   osd_backend=None):
     """Shared per-run telemetry bookkeeping for every engine's
     WordErrorRate path: the sim.* counters plus one ``wer_run`` event with
     a uniform schema (``dispatches`` is included only when the path tracks
@@ -748,6 +765,11 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
               "failures": int(failures), "wer": float(wer)}
     if dispatches is not None:
         fields["dispatches"] = int(dispatches)
+    if osd_backend is not None:
+        # where the run's OSD stage ran (joint_osd_backend): "device" is
+        # the ISSUE-13 default everywhere; "host" marks the demoted
+        # round-trip oracle path
+        fields["osd_backend"] = str(osd_backend)
     if weighted is not None:
         fields.update(weighted.event_fields(tilt=tilt))
     if kernel_variant is not None:
@@ -847,21 +869,24 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
 
 
 # The tunneled axon TPU worker deterministically crashes decode programs
-# containing an OSD stage at batch >= 4096 (environment regression since
-# round 2; retries land on the same crash — README "Known frontiers").
-# Batch 1024-2048 is the measured safe envelope.  The same configs run
-# correctly at full batch on the CPU backend (tests/test_worker_fence.py),
-# so this is a worker fence, not a framework limit.
+# containing a host-round-trip OSD stage at batch >= 4096 (environment
+# regression since round 2; retries land on the same crash — README "Known
+# frontiers").  Batch 1024-2048 is the measured safe envelope.  The same
+# configs run correctly at full batch on the CPU backend
+# (tests/test_worker_fence.py), so this is a worker fence, not a framework
+# limit.  Since ISSUE 13 the fence is scoped to decoders whose OSD stage
+# still round-trips to host (``needs_host_postprocess``): the crash
+# envelope was observed on the host-assisted dispatch shapes, and fully
+# device-resident BPOSD programs run at the flagship batch size.
 WORKER_OSD_BATCH_CRASH = 4096
 WORKER_OSD_BATCH_SAFE = 2048
 
 
 def _has_osd_stage(sim) -> bool:
-    return any(
-        getattr(v, "osd_method", None) is not None
-        or type(v).__name__.startswith(("BPOSD", "ST_BPOSD"))
-        for v in vars(sim).values()
-    )
+    """True when the simulator still carries a HOST-round-trip OSD stage.
+    Device-resident BPOSD (the default) is exempt from the worker fence."""
+    return any(getattr(v, "needs_host_postprocess", False)
+               for v in vars(sim).values())
 
 
 def _axon_tunnel_signal() -> bool:

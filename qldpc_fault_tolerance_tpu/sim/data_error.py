@@ -1,10 +1,13 @@
 """Code-capacity (data-noise) Monte-Carlo engine.
 
 Replaces reference ``CodeSimulator_DataError`` (src/Simulators.py:75-188).
-The per-shot pipeline — depolarizing sample, syndrome SpMV, BP decode of both
-sectors, residual stabilizer/logical checks — is one jitted batch on device;
-only decoders that need OSD post-processing (BPOSD) route the minority of
-BP-failed shots through the host between the decode and check stages.
+The per-shot pipeline — depolarizing sample, syndrome SpMV, decode of both
+sectors (including a BPOSD decoder's device-resident OSD stage,
+decode_device "bposd_dev"), residual stabilizer/logical checks — is one
+jitted batch on device; the whole pipeline folds through the megabatch
+carry with zero OSD host round-trips.  Host-postprocess (host-OSD)
+decoders have no engine path since ISSUE 13 — the host OSD survives as a
+resilience rung / test oracle behind ``decoder.decode_batch``.
 
 Parallelism: the reference's process-pool-over-shots (parmap,
 src/Simulators.py:45-61) becomes a batch axis on device; multi-chip scaling
@@ -63,7 +66,6 @@ from .common import (
     weight_moments,
     wer_single_shot,
     wer_single_shot_weighted,
-    windowed_count,
 )
 
 __all__ = ["CodeSimulator_DataError"]
@@ -933,26 +935,33 @@ class CodeSimulator_DataError:
         self.last_dispatches = driver.dispatches - before
         return carry[0], carry[1], (carry[2] if len(carry) > 2 else None)
 
+    def _reject_host_decoders(self) -> None:
+        """The engines run pure device code end to end: the BP->OSD->check
+        pipeline of a default BPOSD decoder lives inside the megabatch
+        carry (``decode_device`` "bposd_dev"), so the old host-assisted
+        in-flight counting path is gone (ISSUE 13) and its per-batch host
+        syncs with it."""
+        if self._needs_host:
+            raise ValueError(
+                "host-postprocess (host-OSD) decoders have no engine path: "
+                "BPOSD runs device-resident by default on every backend "
+                "(device_osd=True) with the whole BP->OSD->check pipeline "
+                "inside the megabatch carry; the host path remains a "
+                "resilience rung / test oracle via decoder.decode_batch")
+
     def _drain_batch(self, batch_out) -> np.ndarray:
-        """Host-postprocess one _sample_and_bp output tuple and return the
-        per-shot failure flags; updates min_logical_weight."""
-        ex, ez, sx, sz, cx, cz, ax, az = batch_out
-        if self.decoder_x.needs_host_postprocess:
-            cx = jnp.asarray(
-                self.decoder_x.host_postprocess(np.asarray(sx), np.asarray(cx),
-                                                jax.device_get(ax))
-            )
-        if self.decoder_z.needs_host_postprocess:
-            cz = jnp.asarray(
-                self.decoder_z.host_postprocess(np.asarray(sz), np.asarray(cz),
-                                                jax.device_get(az))
-            )
+        """Check one _sample_and_bp output tuple and return the per-shot
+        failure flags; updates min_logical_weight.  Corrections arrive
+        complete (device OSD included) — host-OSD decoders are rejected
+        before dispatch."""
+        ex, ez, _sx, _sz, cx, cz, _ax, _az = batch_out
         fail, min_w = self._check_failures(ex, ez, cx, cz)
         self.min_logical_weight = min(self.min_logical_weight, int(min_w))
         return np.asarray(fail)
 
     def run_batch(self, key, batch_size: int | None = None) -> np.ndarray:
         """Run one batch; returns per-shot failure flags (host bool array)."""
+        self._reject_host_decoders()
         bs = fence_batch_value(self, batch_size or self.batch_size)
         return self._drain_batch(self._sample_and_bp(key, bs))
 
@@ -1009,11 +1018,11 @@ class CodeSimulator_DataError:
         deterministic errors fail fast, and repeated faults step the
         degradation ladder (``_degrade_once``)."""
         apply_worker_batch_fence(self)
-        if target_failures is not None and (self._needs_host
-                                            or self._mesh is not None):
+        self._reject_host_decoders()
+        if target_failures is not None and self._mesh is not None:
             raise ValueError(
                 "target_failures early stopping requires the pure-device "
-                "single-chip path (no host-postprocess decoders, no mesh)")
+                "single-chip path (no mesh)")
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
 
@@ -1103,30 +1112,34 @@ class CodeSimulator_DataError:
             telemetry.publish_device_tele(carry[6])
         self.last_weighted = ws
         wer = wer_single_shot_weighted(ws, self.K)
-        from .common import joint_kernel_variant
+        from .common import joint_kernel_variant, joint_osd_backend
 
         record_wer_run("data", ws.failures, shots, wer[0],
                        dispatches=self.last_dispatches,
                        kernel_variant=joint_kernel_variant(
                            self.decoder_x, self.decoder_z,
                            batch_size=self.batch_size),
-                       weighted=ws, tilt=float(sum(tilt_probs)))
+                       weighted=ws, tilt=float(sum(tilt_probs)),
+                       osd_backend=joint_osd_backend(
+                           self.decoder_x, self.decoder_z))
         return wer
 
     def _wer_result(self, failures: int, shots: int):
         """WER + telemetry bookkeeping shared by every WordErrorRate path."""
-        from .common import joint_kernel_variant
+        from .common import joint_kernel_variant, joint_osd_backend
 
         wer = wer_single_shot(int(failures), int(shots), self.K)
         record_wer_run("data", failures, shots, wer[0],
                        dispatches=self.last_dispatches,
                        kernel_variant=joint_kernel_variant(
                            self.decoder_x, self.decoder_z,
-                           batch_size=self.batch_size))
+                           batch_size=self.batch_size),
+                       osd_backend=joint_osd_backend(
+                           self.decoder_x, self.decoder_z))
         return wer
 
     def _word_error_rate(self, num_run, key, target_failures, progress=None):
-        if self._mesh is not None and not self._needs_host:
+        if self._mesh is not None:
             tele_on = telemetry.enabled()
             count, total, min_w = mesh_batch_stats(
                 self, ("data", self.batch_size, self._packed,
@@ -1140,36 +1153,30 @@ class CodeSimulator_DataError:
                 self.batch_size * self._mesh.devices.size)
             return self._wer_result(count, total)
         batcher = ShotBatcher(num_run, self.batch_size)
-        if not self._needs_host:
-            # megabatch dispatches, one host sync; megabatches run whole, so
-            # the denominator rounds up to the chunk multiple actually run
-            chunk = min(batcher.num_batches, self._scan_chunk)
-            n_batches = -(-batcher.num_batches // chunk) * chunk
-            if target_failures is not None or progress is not None:
-                return self._streaming_run(key, batcher, chunk, n_batches,
-                                           target_failures, progress)
-            total, min_w, tele_vec = self._device_run_stats(
-                key, self.batch_size, n_batches
-            )
-            # the int() pair is the run's one blocking host sync — timed
-            # into the waterfall accounting (utils.profiling)
-            total, min_w = timed_host_sync(
-                lambda: (int(total), int(min_w)))
-            self.min_logical_weight = min(self.min_logical_weight, min_w)
-            if tele_vec is not None:
-                telemetry.publish_device_tele(tele_vec)
-            return self._wer_result(
-                total, n_batches * self.batch_size
-            )
-        keys = [jax.random.fold_in(key, i) for i in batcher]
-        self.last_dispatches = len(keys)  # windowed path: one launch per key
-        # host-postprocess (OSD) path: bounded in-flight window so device
-        # compute overlaps the host transfers
-        error_count = windowed_count(
-            lambda k: self._sample_and_bp(k, self.batch_size),
-            self._drain_batch, keys,
+        # megabatch dispatches, one host sync; megabatches run whole, so
+        # the denominator rounds up to the chunk multiple actually run.
+        # BPOSD rides the same path: decode_device "bposd_dev" folds the
+        # whole BP->OSD->check pipeline into the carry, so a sweep records
+        # osd.host_round_trips == 0 (the old host-assisted in-flight
+        # counting path is gone, ISSUE 13)
+        chunk = min(batcher.num_batches, self._scan_chunk)
+        n_batches = -(-batcher.num_batches // chunk) * chunk
+        if target_failures is not None or progress is not None:
+            return self._streaming_run(key, batcher, chunk, n_batches,
+                                       target_failures, progress)
+        total, min_w, tele_vec = self._device_run_stats(
+            key, self.batch_size, n_batches
         )
-        return self._wer_result(error_count, batcher.total)
+        # the int() pair is the run's one blocking host sync — timed
+        # into the waterfall accounting (utils.profiling)
+        total, min_w = timed_host_sync(
+            lambda: (int(total), int(min_w)))
+        self.min_logical_weight = min(self.min_logical_weight, min_w)
+        if tele_vec is not None:
+            telemetry.publish_device_tele(tele_vec)
+        return self._wer_result(
+            total, n_batches * self.batch_size
+        )
 
     def _streaming_run(self, key, batcher, chunk, n_batches, target_failures,
                        progress):

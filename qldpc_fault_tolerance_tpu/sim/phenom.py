@@ -6,11 +6,13 @@ each noisy round decoded against the extended matrix [H | I] with decoder 1,
 followed by one perfect round decoded with decoder 2 on the bare H.
 
 TPU structure: rounds are a ``lax.scan`` with the carried residual data error
-as state; the shot batch rides the leading axis through the whole scan.  The
-final decode runs outside the scan so a BPOSD decoder 2 can apply its host
-OSD stage to the minority of BP failures.  Decoder 1 must be pure device code
-(BP / FirstMin — the notebook configurations) for the scan path; a per-round
-host fallback covers host-postprocess decoders.
+as state; the shot batch rides the leading axis through the whole scan.  All
+decoders must be pure device code (BP / FirstMin / device-OSD BPOSD — the
+default on every backend since ISSUE 13): a BPOSD decoder 2's OSD stage runs
+inside the final-round device program (decode_device "bposd_dev"), so the
+whole pipeline folds through the megabatch carry with zero OSD host
+round-trips.  Host-postprocess decoders have no engine path — the host OSD
+survives as a resilience rung / test oracle behind ``decoder.decode_batch``.
 
 Bit-packed execution (default): the per-round syndrome SpMVs against the
 extended [H | I] matrices and the final-round / residual-check products run
@@ -62,7 +64,6 @@ from .common import (
     wer_per_cycle,
     wer_per_cycle_weighted,
     wer_single_shot,
-    windowed_count,
 )
 
 __all__ = ["CodeSimulator_Phenon"]
@@ -671,26 +672,21 @@ class CodeSimulator_Phenon:
         return _noisy_rounds(self._cfg(batch_size), self._dev_state, key,
                              num_rounds)
 
-    def _noisy_rounds_host(self, key, batch_size, num_rounds):
-        """Fallback when decoder 1 needs host post-processing each round."""
-        data_x = jnp.zeros((batch_size, self.N), jnp.uint8)
-        data_z = jnp.zeros((batch_size, self.N), jnp.uint8)
-        for i in range(num_rounds - 1):
-            k = jax.random.fold_in(key, i)
-            ex_ext, ez_ext = self._sample_ext(k, batch_size)
-            cur_x = ex_ext.at[:, : self.N].set(ex_ext[:, : self.N] ^ data_x)
-            cur_z = ez_ext.at[:, : self.N].set(ez_ext[:, : self.N] ^ data_z)
-            synd_z = gf2_matmul(cur_z, self._hx_ext_t)
-            synd_x = gf2_matmul(cur_x, self._hz_ext_t)
-            cz, az = self.decoder1_z.decode_batch_device(synd_z)
-            cx, ax = self.decoder1_x.decode_batch_device(synd_x)
-            cx = jnp.asarray(self.decoder1_x.host_postprocess(
-                np.asarray(synd_x), np.asarray(cx), jax.device_get(ax)))
-            cz = jnp.asarray(self.decoder1_z.host_postprocess(
-                np.asarray(synd_z), np.asarray(cz), jax.device_get(az)))
-            data_x = (cur_x ^ cx)[:, : self.N]
-            data_z = (cur_z ^ cz)[:, : self.N]
-        return data_x, data_z
+    def _reject_host_decoders(self) -> None:
+        """All four decoders must be pure device code: the whole round
+        scan, final decode (device OSD included) and checks fold through
+        the megabatch carry — the per-round and final-round host-OSD
+        fallbacks are gone (ISSUE 13) and their per-batch syncs with
+        them."""
+        if not self._dec1_on_device or (
+                self.decoder2_x.needs_host_postprocess
+                or self.decoder2_z.needs_host_postprocess):
+            raise ValueError(
+                "host-postprocess (host-OSD) decoders have no engine path: "
+                "BPOSD runs device-resident by default on every backend "
+                "(device_osd=True) with the whole pipeline inside the "
+                "megabatch carry; the host path remains a resilience rung "
+                "/ test oracle via decoder.decode_batch")
 
     def _final_round_sample(self, key, data_x, data_z, batch_size: int):
         return _final_round(self._cfg(batch_size), self._dev_state, key,
@@ -704,28 +700,21 @@ class CodeSimulator_Phenon:
     def _launch_batch(self, key, num_rounds: int, batch_size: int):
         """Device stage of one batch (async); returns the pending tuple."""
         k_rounds, k_final = jax.random.split(key)
-        if self._dec1_on_device:
-            data_x, data_z = self._noisy_rounds_device(
-                k_rounds, batch_size, num_rounds)
-        else:
-            data_x, data_z = self._noisy_rounds_host(
-                k_rounds, batch_size, num_rounds)
+        data_x, data_z = self._noisy_rounds_device(
+            k_rounds, batch_size, num_rounds)
         return self._final_round_sample(k_final, data_x, data_z, batch_size)
 
     def _finish_batch(self, pending):
-        """Host postprocess (if any) + failure flags for one pending batch."""
-        cur_x, cur_z, sx, sz, dx, dz, ax, az = pending
-        if self.decoder2_x.needs_host_postprocess:
-            dx = jnp.asarray(self.decoder2_x.host_postprocess(
-                np.asarray(sx), np.asarray(dx), jax.device_get(ax)))
-        if self.decoder2_z.needs_host_postprocess:
-            dz = jnp.asarray(self.decoder2_z.host_postprocess(
-                np.asarray(sz), np.asarray(dz), jax.device_get(az)))
+        """Failure flags for one pending batch (corrections arrive complete
+        — device OSD included; host-OSD decoders are rejected before
+        dispatch)."""
+        cur_x, cur_z, _sx, _sz, dx, dz, _ax, _az = pending
         fail, min_w = self._check_failures(cur_x, cur_z, dx, dz)
         self.min_logical_weight = min(self.min_logical_weight, int(min_w))
         return fail
 
     def run_batch(self, key, num_rounds: int, batch_size: int | None = None):
+        self._reject_host_decoders()
         bs = fence_batch_value(self, batch_size or self.batch_size)
         return np.asarray(self._finish_batch(self._launch_batch(key, num_rounds, bs)))
 
@@ -767,14 +756,11 @@ class CodeSimulator_Phenon:
         target (pure-device single-chip path only, exactly as the data
         engine's early stop)."""
         apply_worker_batch_fence(self)
-        dec2_host = (self.decoder2_x.needs_host_postprocess
-                     or self.decoder2_z.needs_host_postprocess)
-        if target_failures is not None and (
-                not self._dec1_on_device or dec2_host
-                or self._mesh is not None):
+        self._reject_host_decoders()
+        if target_failures is not None and self._mesh is not None:
             raise ValueError(
                 "target_failures early stopping requires the pure-device "
-                "single-chip path (no host-postprocess decoders, no mesh)")
+                "single-chip path (no mesh)")
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
 
@@ -786,98 +772,94 @@ class CodeSimulator_Phenon:
 
     def _count_failures_once(self, num_rounds, num_samples, key,
                              progress=None, target_failures=None):
-        dec2_host = (self.decoder2_x.needs_host_postprocess
-                     or self.decoder2_z.needs_host_postprocess)
-        if self._dec1_on_device and not dec2_host:
-            if self._mesh is not None:
-                tele_on = telemetry.enabled()
-                count, total, min_w = mesh_batch_stats(
-                    self, ("phenl", num_rounds, self.batch_size, self._packed,
-                           tele_on),
-                    lambda k: self._device_batch_stats(
-                        k, num_rounds, self.batch_size, tele=tele_on),
-                    num_samples, key, has_tele=tele_on,
-                )
-                self.min_logical_weight = min(self.min_logical_weight, min_w)
-                self.last_dispatches = total // (
-                    self.batch_size * self._mesh.devices.size)
-                return count, total
-            # dispatch-amortized megabatch driver: scan_chunk batches per
-            # compiled dispatch, donated carry, one host sync at the end.
-            # The chunk clamps to the batch count so small sweeps neither
-            # overshoot their shot budget nor change their shot stream.
-            batcher = ShotBatcher(num_samples, self.batch_size)
-            chunk = min(batcher.num_batches, self._scan_chunk)
-            n_batches = -(-batcher.num_batches // chunk) * chunk
+        if self._mesh is not None:
             tele_on = telemetry.enabled()
-            driver = _stats_driver(
-                self._cfg(self.batch_size, tele=tele_on), chunk)
-            before = driver.dispatches
-            if progress is not None or target_failures is not None:
-                # streamed path: per-megabatch carries (double-buffered),
-                # persisting the cursor and/or checking the early-stop
-                # target; the positional fold-in key stream makes a resume
-                # seed-for-seed identical to an uninterrupted run
-                # (sim/common.resumable_stream owns the cursor/fingerprint
-                # rules for every engine).  The early-stop semantics mirror
-                # sim/data_error._streaming_run: stop after the first
-                # megabatch whose cumulative count reaches the target, the
-                # denominator being the shots actually run.
-                fp = run_signature(
-                    "phenl", key, batch_size=self.batch_size, chunk=chunk,
-                    n_batches=n_batches, rounds=int(num_rounds))
-                (carry, done), stream = resumable_stream(
-                    driver, key, n_batches,
-                    (self._dev_state, jnp.asarray(num_rounds, jnp.int32)),
-                    signature=fp, progress=progress, tele_on=tele_on,
-                    min_init=self.N)
-
-                def _target_hit(c):
-                    return (target_failures is not None
-                            and int(c[0]) >= int(target_failures))
-
-                if _target_hit(carry):
-                    if done * self.batch_size < batcher.total:
-                        telemetry.count("driver.early_stops")
-                else:
-                    for carry, done in stream:
-                        if _target_hit(carry):
-                            if done * self.batch_size < batcher.total:
-                                telemetry.count("driver.early_stops")
-                            break
-                shots = done * self.batch_size
-            else:
-                carry, _ = driver.run(
-                    key, n_batches, self._dev_state,
-                    jnp.asarray(num_rounds, jnp.int32))
-                # one host round-trip — watchdog-guarded (utils.resilience)
-                carry = timed_host_sync(lambda: resilience.guarded_fetch(
-                    lambda: jax.device_get(carry), label="phenl_drain"))
-                shots = n_batches * self.batch_size
-            self.last_dispatches = driver.dispatches - before
-            cnt, mw = carry[0], carry[1]
-            if len(carry) > 2:
-                telemetry.publish_device_tele(carry[2])
-            self.min_logical_weight = min(self.min_logical_weight, int(mw))
-            return int(cnt), shots
+            count, total, min_w = mesh_batch_stats(
+                self, ("phenl", num_rounds, self.batch_size, self._packed,
+                       tele_on),
+                lambda k: self._device_batch_stats(
+                    k, num_rounds, self.batch_size, tele=tele_on),
+                num_samples, key, has_tele=tele_on,
+            )
+            self.min_logical_weight = min(self.min_logical_weight, min_w)
+            self.last_dispatches = total // (
+                self.batch_size * self._mesh.devices.size)
+            return count, total
+        # dispatch-amortized megabatch driver: scan_chunk batches per
+        # compiled dispatch, donated carry, one host sync at the end.
+        # The chunk clamps to the batch count so small sweeps neither
+        # overshoot their shot budget nor change their shot stream.
+        # BPOSD decoder-2 pairs ride this same path: their OSD stage runs
+        # inside the final-round device program (decode_device
+        # "bposd_dev"), so the old host-assisted windowed fallback is gone
+        # and a sweep records osd.host_round_trips == 0 (ISSUE 13).
         batcher = ShotBatcher(num_samples, self.batch_size)
-        keys = [jax.random.fold_in(key, i) for i in batcher]
-        self.last_dispatches = len(keys)  # windowed path: one launch per key
-        count = windowed_count(
-            lambda k: self._launch_batch(k, num_rounds, self.batch_size),
-            self._finish_batch, keys,
-        )
-        return count, batcher.total
+        chunk = min(batcher.num_batches, self._scan_chunk)
+        n_batches = -(-batcher.num_batches // chunk) * chunk
+        tele_on = telemetry.enabled()
+        driver = _stats_driver(
+            self._cfg(self.batch_size, tele=tele_on), chunk)
+        before = driver.dispatches
+        if progress is not None or target_failures is not None:
+            # streamed path: per-megabatch carries (double-buffered),
+            # persisting the cursor and/or checking the early-stop
+            # target; the positional fold-in key stream makes a resume
+            # seed-for-seed identical to an uninterrupted run
+            # (sim/common.resumable_stream owns the cursor/fingerprint
+            # rules for every engine).  The early-stop semantics mirror
+            # sim/data_error._streaming_run: stop after the first
+            # megabatch whose cumulative count reaches the target, the
+            # denominator being the shots actually run.
+            fp = run_signature(
+                "phenl", key, batch_size=self.batch_size, chunk=chunk,
+                n_batches=n_batches, rounds=int(num_rounds))
+            (carry, done), stream = resumable_stream(
+                driver, key, n_batches,
+                (self._dev_state, jnp.asarray(num_rounds, jnp.int32)),
+                signature=fp, progress=progress, tele_on=tele_on,
+                min_init=self.N)
+
+            def _target_hit(c):
+                return (target_failures is not None
+                        and int(c[0]) >= int(target_failures))
+
+            if _target_hit(carry):
+                if done * self.batch_size < batcher.total:
+                    telemetry.count("driver.early_stops")
+            else:
+                for carry, done in stream:
+                    if _target_hit(carry):
+                        if done * self.batch_size < batcher.total:
+                            telemetry.count("driver.early_stops")
+                        break
+            shots = done * self.batch_size
+        else:
+            carry, _ = driver.run(
+                key, n_batches, self._dev_state,
+                jnp.asarray(num_rounds, jnp.int32))
+            # one host round-trip — watchdog-guarded (utils.resilience)
+            carry = timed_host_sync(lambda: resilience.guarded_fetch(
+                lambda: jax.device_get(carry), label="phenl_drain"))
+            shots = n_batches * self.batch_size
+        self.last_dispatches = driver.dispatches - before
+        cnt, mw = carry[0], carry[1]
+        if len(carry) > 2:
+            telemetry.publish_device_tele(carry[2])
+        self.min_logical_weight = min(self.min_logical_weight, int(mw))
+        return int(cnt), shots
 
     def _record_run(self, count: int, total: int, wer: float) -> None:
-        from .common import joint_kernel_variant
+        from .common import joint_kernel_variant, joint_osd_backend
 
         record_wer_run("phenl", count, total, wer,
                        dispatches=self.last_dispatches,
                        kernel_variant=joint_kernel_variant(
                            self.decoder1_x, self.decoder1_z,
                            self.decoder2_x, self.decoder2_z,
-                           batch_size=self.batch_size))
+                           batch_size=self.batch_size),
+                       osd_backend=joint_osd_backend(
+                           self.decoder1_x, self.decoder1_z,
+                           self.decoder2_x, self.decoder2_z))
 
     def WordErrorRate(self, num_rounds: int, num_samples: int, key=None,
                       progress=None, target_failures=None):
@@ -941,7 +923,7 @@ class CodeSimulator_Phenon:
                         progress, target_rse),
                     label="wer.phenl_w", degrade=self._degrade_once)
             wer = wer_per_cycle_weighted(ws, self.K, num_rounds)
-            from .common import joint_kernel_variant
+            from .common import joint_kernel_variant, joint_osd_backend
 
             record_wer_run("phenl", ws.failures, ws.shots, wer[0],
                            dispatches=self.last_dispatches,
@@ -950,7 +932,10 @@ class CodeSimulator_Phenon:
                                self.decoder2_x, self.decoder2_z,
                                batch_size=self.batch_size),
                            weighted=ws,
-                           tilt=float(sum(tilt_probs)))
+                           tilt=float(sum(tilt_probs)),
+                           osd_backend=joint_osd_backend(
+                               self.decoder1_x, self.decoder1_z,
+                               self.decoder2_x, self.decoder2_z))
         return wer
 
     def _weighted_count(self, num_rounds, num_samples, tilt_probs, tilt_q,

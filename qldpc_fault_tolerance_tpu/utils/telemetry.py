@@ -476,8 +476,11 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "wer": _NUM},
         # kernel_variant: which BP kernel served the run (one of
         # ops.bp_pallas.KERNEL_VARIANTS, or "mixed") — silent routing to
-        # the XLA twin now leaves a named trace (ISSUE 9 satellite)
+        # the XLA twin now leaves a named trace (ISSUE 9 satellite).
+        # osd_backend (ISSUE 13, additive): where the run's OSD stage ran —
+        # "device" / "host" / "mixed" / "none" (no OSD decoder)
         "optional": {"dispatches": int, "kernel_variant": str,
+                     "osd_backend": str,
                      **_CI_FIELDS, **_WEIGHTED_FIELDS},
     },
     "heartbeat": {
@@ -558,8 +561,12 @@ EVENT_SCHEMAS: dict[str, dict] = {
     # --- v2: decode-service (serve/) events ------------------------------
     "serve_session": {
         "required": {"session": str, "event": str},
+        # osd_backend (ISSUE 13, additive): "device" for bposd_dev
+        # programs, "none" otherwise — host-OSD configs are rejected at
+        # session construction, so "host" never appears here
         "optional": {"bucket": int, "compile_s": _NUM,
-                     "syndrome_width": int, "kernel_variant": str},
+                     "syndrome_width": int, "kernel_variant": str,
+                     "osd_backend": str},
     },
     "serve_request": {
         "required": {"session": str, "tenant": str, "shots": int},
@@ -1038,7 +1045,13 @@ TELE_BP_CONVERGED = 1    # ... of which BP converged within max_iter
 TELE_OSD_SHOTS = 2       # shots routed to a device-OSD stage
 TELE_ITER_SUM = 3        # sum of iterations over CONVERGED shots
 TELE_ITER_HIST0 = 4      # + len(ITER_BUCKETS)+1 histogram slots
-TELE_LEN = TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1
+# device-OSD compaction-tier occupancy: which path a bposd_dev decode's
+# straggler compaction took, counted per decode stage (ISSUE 13) — the
+# tier ladder itself lives in decoders.bp_decoders.osd_compaction_tiers
+TELE_OSD_TIER_NONE = TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1  # all converged
+TELE_OSD_TIER_COMPACT = TELE_OSD_TIER_NONE + 1  # a compaction tier engaged
+TELE_OSD_TIER_FULL = TELE_OSD_TIER_NONE + 2     # full-batch elimination
+TELE_LEN = TELE_OSD_TIER_FULL + 1
 
 
 def device_tele_vec(aux_by_static) -> "object":
@@ -1059,6 +1072,9 @@ def device_tele_vec(aux_by_static) -> "object":
     osd = jnp.zeros((), jnp.int32)
     it_sum = jnp.zeros((), jnp.int32)
     hist = jnp.zeros((nb,), jnp.int32)
+    tier_none = jnp.zeros((), jnp.int32)
+    tier_compact = jnp.zeros((), jnp.int32)
+    tier_full = jnp.zeros((), jnp.int32)
     for static, aux in aux_by_static:
         c = aux.get("converged")
         if c is None:
@@ -1066,7 +1082,22 @@ def device_tele_vec(aux_by_static) -> "object":
         shots = shots + jnp.asarray(c.shape[0], jnp.int32)
         conv = conv + c.sum(dtype=jnp.int32)
         if static and static[0] == "bposd_dev":
-            osd = osd + (~c).sum(dtype=jnp.int32)
+            n_bad = (~c).sum(dtype=jnp.int32)
+            osd = osd + n_bad
+            # compaction-tier occupancy: mirror decode_device's dispatch
+            # through the SAME ladder definition (bp_decoders
+            # osd_compaction_tiers) — the smallest tier holding n_bad runs
+            from ..decoders.bp_decoders import osd_compaction_tiers
+
+            tiers = osd_compaction_tiers(int(c.shape[0]))
+            fits = jnp.zeros((), bool)
+            for cap in tiers:
+                fits = fits | (n_bad <= cap)
+            none_b = (n_bad == 0).astype(jnp.int32)
+            compact_b = ((n_bad > 0) & fits).astype(jnp.int32)
+            tier_none = tier_none + none_b
+            tier_compact = tier_compact + compact_b
+            tier_full = tier_full + (1 - none_b - compact_b)
         it = aux.get("iterations")
         if it is not None:
             cmask = c.astype(jnp.int32)
@@ -1075,6 +1106,7 @@ def device_tele_vec(aux_by_static) -> "object":
             hist = hist.at[idx].add(cmask)
     return jnp.concatenate([
         shots[None], conv[None], osd[None], it_sum[None], hist,
+        tier_none[None], tier_compact[None], tier_full[None],
     ]).astype(jnp.int32)
 
 
@@ -1103,6 +1135,12 @@ def publish_device_tele(vec) -> None:
     _REGISTRY.counter("bp.converged").inc(int(v[TELE_BP_CONVERGED]))
     if int(v[TELE_OSD_SHOTS]):
         _REGISTRY.counter("osd.device_shots").inc(int(v[TELE_OSD_SHOTS]))
+    if len(v) > TELE_OSD_TIER_FULL:  # older persisted carries lack these
+        for slot, name in ((TELE_OSD_TIER_NONE, "osd.tier_none"),
+                           (TELE_OSD_TIER_COMPACT, "osd.tier_compacted"),
+                           (TELE_OSD_TIER_FULL, "osd.tier_full")):
+            if int(v[slot]):
+                _REGISTRY.counter(name).inc(int(v[slot]))
     hist = _REGISTRY.histogram("bp.iterations", ITER_BUCKETS)
     counts = v[TELE_ITER_HIST0:TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1]
     it_sum = int(v[TELE_ITER_SUM])

@@ -73,10 +73,21 @@ GATED_FIELDS = (
     # the checked-in history gates unchanged.
     "tracing_ab.traced_shots_per_s",
     "tracing_ab.traced_p99_ms",
+    # device-resident BPOSD (bench.py bposd, ISSUE 13): the end-to-end
+    # BPOSD rate and both arms of the device-vs-host OSD A/B gate as rate
+    # fields; host round-trips gate on INCREASES (a reappearing host sync
+    # is the regression — 0-valued rounds skip percent gating, so the
+    # first nonzero round is what trips it).  Rounds before r06 lack every
+    # key, so the checked-in r01-r05 history gates unchanged.
+    "bposd.shots_per_s",
+    "osd_ab.device_shots_per_s",
+    "osd_ab.host_shots_per_s",
+    "bposd.host_round_trips",
 )
 
-# gated fields where a RISE is the regression (latencies)
-LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms"})
+# gated fields where a RISE is the regression (latencies, host round-trips)
+LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms",
+                                    "bposd.host_round_trips"})
 
 
 def _dig(d: dict, dotted: str):
@@ -160,9 +171,15 @@ def compare(rounds: list[dict], tolerance_pct: float) -> dict:
                 else prev["value"]
             b = _dig(cur["fields"], name) if name != "value" \
                 else cur["value"]
-            if a is None or b is None or a == 0:
+            if a is None or b is None:
                 continue
-            delta_pct = (b - a) / abs(a) * 100.0
+            if a == 0 and not (name in LOWER_IS_BETTER_FIELDS and b > 0):
+                # rate fields can't percent-gate off a zero baseline, but a
+                # lower-is-better COUNT going 0 -> nonzero is exactly the
+                # transition the gate exists for (e.g. a reappearing
+                # bposd.host_round_trips)
+                continue
+            delta_pct = (b - a) / (abs(a) if a else 1.0) * 100.0
             field_lower = (lower_is_better if name == "value"
                            else name in LOWER_IS_BETTER_FIELDS)
             regressed = (delta_pct > tolerance_pct if field_lower

@@ -396,17 +396,19 @@ def test_stack_from_overrides_matches_generic_stacking():
         simc.stack_from_overrides(rep, {("nope",): jnp.zeros(3)})
 
 
-def test_unfusable_bucket_falls_back_serially():
-    """Host-postprocess decoder2 (BPOSD on CPU) cannot fuse: the planner
-    must fall back per bucket and still return the serial result."""
+def test_bposd_bucket_fuses_and_matches_serial():
+    """ISSUE 13: a BPOSD bucket (device OSD by default on every backend)
+    now FUSES — the whole BP->OSD->check pipeline rides the cell-axis
+    megabatch carry — and the fused grid must equal the serial per-cell
+    run bit for bit, with zero OSD host round-trips and no fallback."""
     from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
 
     fam_args = dict(
         decoder1_class=BP_Decoder_Class(4, "minimum_sum", 0.625),
-        decoder2_class=BPOSD_Decoder_Class(6, "minimum_sum", 0.625,
+        decoder2_class=BPOSD_Decoder_Class(2, "minimum_sum", 0.625,
                                            "osd_e", 4),
         batch_size=64, seed=1)
-    p_list = [0.03, 0.06]
+    p_list = [0.06, 0.1]
     serial = CodeFamily([TINY[0]], **fam_args).EvalWER(
         "data", "Total", p_list, num_samples=128, if_plot=False,
         fused=False)
@@ -414,6 +416,34 @@ def test_unfusable_bucket_falls_back_serially():
     telemetry.enable()
     try:
         fused = CodeFamily([TINY[0]], **fam_args).EvalWER(
+            "data", "Total", p_list, num_samples=128, if_plot=False)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    np.testing.assert_array_equal(fused, serial)
+    assert snap.get("sweep.fused_fallback_cells", {}).get("value", 0) == 0
+    assert snap.get("osd.host_round_trips", {}).get("value", 0) == 0
+
+
+def test_unfusable_bucket_falls_back_serially(monkeypatch):
+    """A bucket whose builder cannot fuse must fall back per bucket and
+    still return the serial result.  (BPOSD buckets fuse since ISSUE 13,
+    so the unfusable condition is injected at the builder.)"""
+    def boom(*a, **kw):
+        # the builder signals "run serially" with ValueError (the same
+        # channel _check_rep_fusable and the static-mismatch guards use)
+        raise ValueError("injected: bucket cannot fuse")
+
+    monkeypatch.setattr(CodeFamily, "_data_bucket_program",
+                        lambda self, *a, **kw: boom())
+    p_list = [0.03, 0.06]
+    serial = family([TINY[0]]).EvalWER(
+        "data", "Total", p_list, num_samples=128, if_plot=False,
+        fused=False)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        fused = family([TINY[0]]).EvalWER(
             "data", "Total", p_list, num_samples=128, if_plot=False)
         snap = telemetry.snapshot()
     finally:

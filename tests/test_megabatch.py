@@ -102,10 +102,11 @@ def test_target_failures_early_stop():
                                target_failures=1)
     assert 0.0 < wer <= 1.0
     assert sim.last_dispatches < 8  # stopped before the 16-batch budget
-    # unsupported on the host-postprocess/mesh paths: loud, not silent
+    # host-postprocess decoders have no engine path at all (ISSUE 13):
+    # loud, not silent
     sim2 = _tiny_sim()
     sim2._needs_host = True
-    with pytest.raises(ValueError, match="target_failures"):
+    with pytest.raises(ValueError, match="host-OSD"):
         sim2.WordErrorRate(128, key=jax.random.PRNGKey(0), target_failures=1)
 
 

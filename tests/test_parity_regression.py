@@ -32,7 +32,12 @@ def test_toric_phenl_cell_pinned():
     # fixed fold_in streams, f32 BP, deterministic OSD tie-breaking.  The
     # value is backend-specific (XLA codegen changes with the virtual
     # device flag); the statistical-band test below is the env-robust one.
-    np.testing.assert_allclose(wer, 0.005333239320124417, rtol=1e-12)
+    # Re-pinned at ISSUE 13 (was 0.005333239320124417): BPOSD now runs its
+    # OSD stage device-resident by default on every backend, and float32
+    # device costs resolve a handful of ML ties differently from the host
+    # float64 path — a tie-breaking change inside the documented parity
+    # contract, not a physics change (the band test pins that).
+    np.testing.assert_allclose(wer, 0.005231307090348414, rtol=1e-12)
 
 
 def test_toric_phenl_cell_statistical_band():

@@ -100,14 +100,59 @@ def test_session_rejects_bad_input():
 
 def test_session_factory_path_rejects_host_osd_config():
     """The factory path must apply the same pure-device guard as the
-    decoder path: a CPU BPOSD factory resolves to host OSD, whose
-    device_static silently degrades to plain BP — serving it would break
-    the bit-exact-vs-offline guarantee instead of failing loudly."""
+    decoder path: an osd_cs BPOSD factory (no device implementation)
+    resolves to host OSD, whose device_static silently degrades to plain
+    BP — serving it would break the bit-exact-vs-offline guarantee
+    instead of failing loudly."""
     from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
 
-    cls = BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_e", 10)
+    cls = BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_cs", 10)
     with pytest.raises(ValueError, match="host"):
         DecodeSession("x", decoder_class=cls, params=_params(CODE3))
+
+
+def test_bposd_session_serves_device_osd_bit_exact():
+    """ISSUE 13 acceptance: a BPOSD DecodeSession (the default osd_e
+    factory, accepted on every backend now that device OSD is the default)
+    serves corrections matching offline ``decode_batch`` bit-for-bit, with
+    zero warm-path retraces and the session naming its OSD backend."""
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
+
+    cls = BPOSD_Decoder_Class(8, "minimum_sum", 0.625, "osd_e", 6)
+    sess = DecodeSession("bposd_dev", decoder_class=cls,
+                         params=_params(CODE3), buckets=(32, 64, 128))
+    assert sess.osd_backend == "device"
+    assert sess.static[0] == "bposd_dev"
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        sess.warm()
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+    compiles = [r for r in sink.records if r["kind"] == "serve_session"
+                and r.get("event") == "compile"]
+    assert compiles and all(r["osd_backend"] == "device" for r in compiles)
+    assert all(telemetry.validate_event(r) == [] for r in compiles)
+    rng = np.random.default_rng(7)
+    # high-weight errors so a fraction of shots actually reach the OSD
+    # stage inside the compiled program
+    h = CODE3.hx
+    errs = (rng.random((90, CODE3.N)) < 0.2).astype(np.uint8)
+    synds = (errs @ h.T % 2).astype(np.uint8)
+    offline = cls.GetDecoder(_params(CODE3)).decode_batch(synds)
+    telemetry.enable()
+    try:
+        before = telemetry.compile_stats().get("jax.retraces", 0)
+        compiles_before = sess.compiles
+        out = sess.decode(synds)
+        assert sess.compiles == compiles_before
+        assert telemetry.compile_stats().get("jax.retraces", 0) == before
+    finally:
+        telemetry.disable()
+    assert np.array_equal(out.corrections, offline)
+    assert out.converged is not None and not out.converged.all()
 
 
 def test_session_warm_cache_zero_retraces():

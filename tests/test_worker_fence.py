@@ -27,11 +27,15 @@ from qldpc_fault_tolerance_tpu.sim.common import (
 )
 
 
-def _bposd_sim(batch_size):
+def _bposd_sim(batch_size, device_osd=False):
+    """Host-OSD BPOSD sim (the fence's scope since ISSUE 13 narrowed it to
+    host-round-trip OSD stages); ``device_osd=True`` builds the default
+    device-resident config, which the fence must NOT clamp."""
     code = hgp(rep_code(5), rep_code(5))
     p = 0.02
     dec = lambda h: BPOSD_Decoder(  # noqa: E731
-        h, np.full(code.N, p), max_iter=12, osd_method="osd_0")
+        h, np.full(code.N, p), max_iter=12, osd_method="osd_0",
+        device_osd=device_osd)
     return CodeSimulator_DataError(
         code=code, decoder_x=dec(code.hz), decoder_z=dec(code.hx),
         pauli_error_probs=[p / 3] * 3, batch_size=batch_size, seed=3,
@@ -99,6 +103,20 @@ def test_fence_accepts_literal_axon_backend(monkeypatch):
     assert sim.batch_size == WORKER_OSD_BATCH_SAFE
 
 
+def test_fence_leaves_device_resident_bposd_alone(monkeypatch):
+    """ISSUE 13: the fence is scoped to HOST-round-trip OSD stages — the
+    default device-resident BPOSD program runs at the flagship batch size
+    even on the tunneled worker."""
+    sim = _bposd_sim(8192, device_osd=True)
+    assert not sim._needs_host
+    _as_tunneled_worker(monkeypatch)
+    assert on_tunneled_worker()
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        apply_worker_batch_fence(sim)
+    assert sim.batch_size == 8192
+
+
 def test_fence_leaves_plain_bp_alone(monkeypatch):
     code = hgp(rep_code(5), rep_code(5))
     p = 0.02
@@ -114,8 +132,10 @@ def test_fence_leaves_plain_bp_alone(monkeypatch):
 
 def test_full_batch_osd_runs_on_cpu():
     """The exact crash-envelope batch (8192 >= 4096, OSD stage) on the CPU
-    backend: must run and produce a sane WER — no clamp, no crash."""
-    sim = _bposd_sim(8192)
+    backend: must run and produce a sane WER — no clamp, no crash.  Uses
+    the default device-resident BPOSD (host-OSD configs have no engine
+    path since ISSUE 13)."""
+    sim = _bposd_sim(8192, device_osd=True)
     apply_worker_batch_fence(sim)
     assert sim.batch_size == 8192  # cpu backend: fence is a no-op
     wer, eb = sim.WordErrorRate(8192)
