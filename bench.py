@@ -1336,18 +1336,21 @@ def mode_serve():
     handle = start_server_thread(batcher)
     host, port = handle.address
 
-    def storm(n_reqs, collect, traced=False):
+    def storm(n_reqs, collect, traced=False, idem=False):
         """One storm: ``tenants`` client threads, each with its own
         connection, window-pipelined submits, codes alternating per
         request.  ``collect`` gathers (session, syndromes, corrections,
         latency) for the verification/latency stats.  ``traced`` clients
-        mint a trace context per request (the tracing A/B arm)."""
+        mint a trace context per request (the tracing A/B arm); ``idem``
+        clients mint an idempotency key per request, so every request
+        rides the scheduler's exactly-once journal (the ISSUE-14
+        journal-overhead A/B arm)."""
         errors = []
 
         def worker(idx):
             try:
                 cli = DecodeClient(host, port, tenant=f"tenant{idx}",
-                                   traced=traced)
+                                   traced=traced, idempotent=idem)
                 rng = np.random.default_rng(1000 + idx)
                 pending = deque()
 
@@ -1384,7 +1387,8 @@ def mode_serve():
 
     storm_reps = int(os.environ.get("BENCH_SERVE_STORM_REPS", "3"))
     all_results: list = []
-    best = {False: None, True: None}  # per tracing arm
+    ARMS = ("plain", "traced", "journal")
+    best = {arm: None for arm in ARMS}
     with _tele_region():
         # warmup discipline: compile every shape bucket, then warm the
         # wire/dispatch path with a short untimed storm
@@ -1398,18 +1402,21 @@ def mode_serve():
         # all from the same rep).  Each rep resets the registry so its
         # snapshot covers only its own traffic (warmup included in none).
         #
-        # Tracing A/B (ISSUE 11): each rep runs BOTH arms, order
-        # alternating per rep so neither arm systematically inherits a
-        # warmer (or more fragmented) process; per-arm best-rep
-        # throughputs give the overhead estimate, gated at <2%.
+        # Tracing A/B (ISSUE 11) + journal A/B (ISSUE 14): each rep runs
+        # all three arms — plain, traced, idempotency-journaled — with
+        # the arm order rotated per rep so no arm systematically
+        # inherits a warmer (or more fragmented) process; per-arm
+        # best-rep throughputs give the overhead estimates, gated at <2%.
         retraces_total = 0
         for rep in range(storm_reps):
-            arms = (False, True) if rep % 2 == 0 else (True, False)
-            for traced_arm in arms:
+            shift = rep % len(ARMS)
+            for arm in ARMS[shift:] + ARMS[:shift]:
                 telemetry.reset()
                 before = telemetry.compile_stats().get("jax.retraces", 0)
                 results: list = []
-                elapsed = storm(reqs, collect=results, traced=traced_arm)
+                elapsed = storm(reqs, collect=results,
+                                traced=(arm == "traced"),
+                                idem=(arm == "journal"))
                 retraces_total += (telemetry.compile_stats()
                                    .get("jax.retraces", 0) - before)
                 all_results.extend(results)
@@ -1417,18 +1424,21 @@ def mode_serve():
                        "shots_per_s": sum(s.shape[0] for _, s, _, _
                                           in results) / elapsed,
                        "results": results, "snap": telemetry.snapshot()}
-                if best[traced_arm] is None \
-                        or rec["qps"] > best[traced_arm]["qps"]:
-                    best[traced_arm] = rec
-        retraces = retraces_total  # 0 across EVERY timed rep AND both arms
-        snap = best[False]["snap"]  # headline stays the untraced arm
-        results, elapsed = best[False]["results"], best[False]["elapsed"]
+                if best[arm] is None or rec["qps"] > best[arm]["qps"]:
+                    best[arm] = rec
+        retraces = retraces_total  # 0 across EVERY timed rep AND all arms
+        snap = best["plain"]["snap"]  # headline stays the plain arm
+        results = best["plain"]["results"]
+        elapsed = best["plain"]["elapsed"]
 
     handle.stop(drain=True)
 
-    untraced_sps = best[False]["shots_per_s"]
-    traced_sps = best[True]["shots_per_s"]
+    untraced_sps = best["plain"]["shots_per_s"]
+    traced_sps = best["traced"]["shots_per_s"]
+    journal_sps = best["journal"]["shots_per_s"]
     overhead_pct = 100.0 * (1.0 - traced_sps / untraced_sps) \
+        if untraced_sps else 0.0
+    journal_overhead_pct = 100.0 * (1.0 - journal_sps / untraced_sps) \
         if untraced_sps else 0.0
 
     def val(name, field="value"):
@@ -1490,12 +1500,25 @@ def mode_serve():
         "tracing_ab": {
             "untraced_shots_per_s": round(untraced_sps, 1),
             "traced_shots_per_s": round(traced_sps, 1),
-            "traced_qps": round(best[True]["qps"], 1),
+            "traced_qps": round(best["traced"]["qps"], 1),
             "traced_p99_ms": round(float(np.percentile(
-                np.asarray([lat for *_, lat in best[True]["results"]])
+                np.asarray([lat for *_, lat in best["traced"]["results"]])
                 * 1e3, 99)), 2),
             "overhead_pct": round(overhead_pct, 2),
             "overhead_le_2pct": bool(overhead_pct <= 2.0),
+        },
+        # idempotency-journal on/off A/B (ISSUE 14): journaling every
+        # request (accept->answer journal + answered-LRU bookkeeping)
+        # must stay in the noise on the steady-state path — gate at <2%
+        # decoded-shots/s overhead vs the plain arm; bit-exactness folds
+        # into the global gate above (the journal arm's rows are in
+        # all_results like every other arm's)
+        "journal_ab": {
+            "plain_shots_per_s": round(untraced_sps, 1),
+            "journaled_shots_per_s": round(journal_sps, 1),
+            "journaled_qps": round(best["journal"]["qps"], 1),
+            "overhead_pct": round(journal_overhead_pct, 2),
+            "overhead_le_2pct": bool(journal_overhead_pct <= 2.0),
         },
     }
 
@@ -1658,6 +1681,203 @@ def mode_rare():
     }
 
 
+def mode_chaos():
+    """Chaos smoke (ISSUE 14): a short SEEDED fault schedule — a
+    device-restart dispatch death that exhausts the in-dispatch retries,
+    a dropped connection, a stalled dispatch, a dropped response —
+    against a LIVE decode server with the self-healing HealthProbe
+    attached, driven by reconnect+idempotent clients.
+
+    Headline: recovery wall clock — storm end until /healthz reports a
+    quiescent, healthy service (ok, empty queue, empty journal, no
+    unconsumed incidents) with ZERO operator action.  Gates: zero
+    dropped (every submitted request answered, none with an error), zero
+    duplicated (completed == logical accepted requests; resubmits and
+    hedges deduped by the journal), served corrections bit-exact vs the
+    offline decode path, recovery within BENCH_CHAOS_RECOVERY_S.
+    Env knobs: BENCH_CHAOS_REQS / BENCH_CHAOS_SEED /
+    BENCH_CHAOS_RECOVERY_S."""
+    import threading
+    import urllib.request
+    from collections import deque
+
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.serve import (
+        ContinuousBatcher,
+        DecodeClient,
+        DecodeSession,
+        HealthProbe,
+        start_ops_thread,
+        start_server_thread,
+    )
+    from qldpc_fault_tolerance_tpu.utils import (
+        faultinject,
+        resilience,
+        telemetry,
+    )
+
+    reqs = int(os.environ.get("BENCH_CHAOS_REQS", "40"))
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", "14"))
+    recovery_budget_s = float(os.environ.get("BENCH_CHAOS_RECOVERY_S",
+                                             "30"))
+    tenants = 2
+    window = 8
+    p = 0.05
+    code = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+    cls = BP_Decoder_Class(4, "minimum_sum", 0.625)
+    params = {"h": code.hx, "p_data": p}
+    h_t = np.asarray(code.hx, np.uint8).T
+
+    prev_policy = resilience.current_policy()
+    resilience.set_default_policy(resilience.RetryPolicy(
+        max_attempts=2, base_delay=0.05, backoff=1.0, jitter=0.0,
+        reset_caches=False, degrade_after=1))
+    try:
+        with _tele_region():
+            sess = DecodeSession("hgp_rep3", decoder_class=cls,
+                                 params=params, buckets=(32, 64, 128))
+            sess.warm()
+            bat = ContinuousBatcher({"hgp_rep3": sess},
+                                    max_batch_shots=64, max_wait_s=0.002,
+                                    max_dispatch_attempts=4)
+            probe = HealthProbe(bat, interval_s=0.05)
+            handle = start_server_thread(bat)
+            ops = start_ops_thread(batcher=bat, probe=probe)
+            host, port = handle.address
+            ohost, oport = ops.address
+            # the seeded schedule: deterministic given BENCH_CHAOS_SEED
+            sched_rng = np.random.default_rng(seed)
+            plan = faultinject.FaultPlan([
+                faultinject.Fault(site="serve_dispatch",
+                                  kind="device_restart",
+                                  after=int(sched_rng.integers(1, 3)),
+                                  count=2),
+                faultinject.Fault(site="serve_dispatch", kind="stall",
+                                  after=int(sched_rng.integers(4, 6)),
+                                  stall_s=0.2),
+                faultinject.Fault(site="serve_conn_rx", kind="conn_drop",
+                                  after=int(sched_rng.integers(2, 6))),
+                faultinject.Fault(site="serve_respond", kind="conn_drop",
+                                  after=int(sched_rng.integers(6, 12))),
+            ], seed=seed)
+            results, errors = [], []
+
+            def worker(idx):
+                try:
+                    cli = DecodeClient(host, port, tenant=f"tenant{idx}",
+                                       reconnect=True, timeout=60.0)
+                    rng = np.random.default_rng(1000 + idx)
+                    pending = deque()
+
+                    def finish_one():
+                        synd, fut = pending.popleft()
+                        res = fut.result(timeout=120)
+                        results.append((synd, res.corrections))
+
+                    for _ in range(reqs):
+                        k = int(rng.integers(1, 9))
+                        err = (rng.random((k, code.N)) < p).astype(
+                            np.uint8)
+                        synd = (err @ h_t % 2).astype(np.uint8)
+                        pending.append((synd,
+                                        cli.submit("hgp_rep3", synd)))
+                        if len(pending) >= window:
+                            finish_one()
+                    while pending:
+                        finish_one()
+                    cli.close()
+                except Exception as exc:  # noqa: BLE001 — gated below
+                    errors.append(f"{type(exc).__name__}: {exc}")
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(tenants)]
+            t0 = time.perf_counter()
+            with plan.active():
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            storm_s = time.perf_counter() - t0
+            # recovery: the service must report quiescent-healthy with
+            # zero operator action — queue drained, journal empty, every
+            # incident consumed by the probe
+            rec_t0 = time.perf_counter()
+            recovered = False
+            while time.perf_counter() - rec_t0 < recovery_budget_s:
+                try:
+                    hz = json.loads(urllib.request.urlopen(
+                        f"http://{ohost}:{oport}/healthz",
+                        timeout=5).read())
+                    if (hz.get("ok") and hz.get("queue_depth") == 0
+                            and hz.get("journal_inflight") == 0
+                            and hz.get("incidents_pending") == 0):
+                        recovered = True
+                        break
+                except Exception:  # noqa: BLE001 — poll until budget
+                    pass
+                resilience.sleep_for(0.05)
+            recovery_s = time.perf_counter() - rec_t0
+            snap = telemetry.snapshot()
+            heals = probe.heals
+            probe.stop()
+            ops.stop()
+            handle.stop(drain=True)
+    finally:
+        resilience.set_default_policy(prev_policy)
+
+    def val(name):
+        return snap.get(name, {}).get("value", 0)
+
+    answered = len(results)
+    submitted = reqs * tenants
+    synd = np.concatenate([s for s, _ in results]) if results else None
+    served = np.concatenate([c for _, c in results]) if results else None
+    offline = (cls.GetDecoder(params).decode_batch(synd)
+               if synd is not None else None)
+    bitexact = bool(results
+                    and np.array_equal(served, offline))
+    zero_dropped = bool(not errors and answered == submitted
+                        and bat.failed == 0)
+    # exactly-once: the server ACCEPTED each logical request exactly once
+    # (serve.requests counts journal-new accepts — a broken dedupe that
+    # re-accepted a resubmit would push it past the submitted count) and
+    # completed each exactly once.  completed==serve.requests alone would
+    # be tautological: both increment per accepted request.
+    zero_duplicated = bool(val("serve.requests") == submitted
+                           and bat.completed == submitted)
+    return {
+        "metric": f"chaos smoke recovery (seeded schedule seed={seed}, "
+                  f"{submitted} reqs x {tenants} reconnect tenants)",
+        "value": round(recovery_s, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "seed": seed,
+        "requests": submitted,
+        "answered": answered,
+        "storm_s": round(storm_s, 3),
+        "chaos_qps": round(answered / storm_s, 1) if storm_s else None,
+        "recovery_s": round(recovery_s, 3),
+        "recovery_budget_s": recovery_budget_s,
+        "faults_injected": val("faultinject.injected"),
+        "redispatches": val("serve.redispatches"),
+        "reconnects": val("serve.client.reconnects"),
+        "dedup_attached": val("serve.dedup.attached"),
+        "dedup_replayed": val("serve.dedup.replayed"),
+        "heals": int(heals),
+        "client_errors": errors[:4],
+        "gates": {
+            "zero_dropped": zero_dropped,
+            "zero_duplicated": zero_duplicated,
+            "bitexact_vs_offline": bitexact,
+            "recovered_in_budget": bool(recovered),
+            "faults_fired": bool(val("faultinject.injected") >= 4),
+        },
+    }
+
+
 MODES = {
     "bp": mode_bp,
     "bposd": mode_bposd,
@@ -1667,6 +1887,7 @@ MODES = {
     "sweep": mode_sweep,
     "serve": mode_serve,
     "rare": mode_rare,
+    "chaos": mode_chaos,
 }
 
 
@@ -1678,7 +1899,7 @@ def main():
         # TPU chip, so they must run before this process's own JAX
         # initialization claims it for the other modes
         for name in ("phenl_cell", "circuit_cell", "bp", "bposd",
-                     "st_circuit", "sweep", "serve", "rare"):
+                     "st_circuit", "sweep", "serve", "rare", "chaos"):
             results[name] = MODES[name]()
             print(json.dumps(results[name]))
         here = os.path.dirname(os.path.abspath(__file__))

@@ -108,6 +108,12 @@ def reset_device_state():
     except Exception:
         pass
     jax.clear_caches()
+    # bump the device-reset epoch LAST: the serve-side self-healing probe
+    # (serve/ops.py) watches it, and healing against half-cleared caches
+    # would re-memoize dead buffers
+    from .utils import resilience as _resilience
+
+    _resilience.note_device_reset()
 
 
 from . import codes

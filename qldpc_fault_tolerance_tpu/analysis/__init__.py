@@ -26,6 +26,7 @@ R004 donated buffer referenced after dispatch
 R005 event-kind / frozen-schema drift
 R006 unlocked write to module-level mutable state
 R007 kernel/twin contract drift
+R008 faultinject site not registered in SITES / not unique
 R101 bare print() in library code (migrated PR-2 grep guard)
 R102 bare sleep / ad-hoc retry loop (migrated PR-7 grep guard)
 ==== =====================================================================
@@ -51,7 +52,8 @@ from .core import (
 from .rules_jax import (DonationRule, HostSyncRule, PRNGKeyRule,
                         TracerSafetyRule)
 from .rules_kernels import KERNEL_CONTRACTS, KernelContractRule
-from .rules_runtime import LockDisciplineRule, SchemaDriftRule
+from .rules_runtime import (FaultSiteRule, LockDisciplineRule,
+                            SchemaDriftRule)
 from .rules_style import BarePrintRule, BareSleepRule
 
 __all__ = [
@@ -59,7 +61,8 @@ __all__ = [
     "Finding", "Rule", "SourceModule", "UNUSED_SUPPRESSION_RULE_ID",
     "collect_modules", "run_analysis", "package_root", "repo_root",
     "HostSyncRule", "PRNGKeyRule", "TracerSafetyRule", "DonationRule",
-    "SchemaDriftRule", "LockDisciplineRule", "KernelContractRule",
+    "SchemaDriftRule", "LockDisciplineRule", "FaultSiteRule",
+    "KernelContractRule",
     "KERNEL_CONTRACTS", "BarePrintRule", "BareSleepRule",
     "default_rules", "default_baseline_path", "analyze_repo",
 ]
@@ -76,6 +79,7 @@ def default_rules() -> list:
         SchemaDriftRule(),
         LockDisciplineRule(),
         KernelContractRule(),
+        FaultSiteRule(),
         BarePrintRule(),
         BareSleepRule(),
     ]

@@ -1,5 +1,6 @@
 """Runtime-contract rules: event-schema drift (static half of the
-telemetry schema guard) and lock discipline for module-level state.
+telemetry schema guard), lock discipline for module-level state, and
+fault-injection site discipline.
 
 R005 parses ``utils/telemetry.py``'s ``EVENT_SCHEMAS`` literal out of the
 AST — no import, no jax initialization — and checks every literal
@@ -8,6 +9,13 @@ site in the package against it, plus the frozen ``_V*_EVENT_KINDS``
 back-compat sets.  The runtime guard (tests/test_telemetry.py schema
 coverage) proves emitted events validate; this rule catches the drift
 *before* anything runs, including kinds only emitted on rare paths.
+
+R008 (ISSUE 14, same spirit as R005's schema drift): every LITERAL site
+name passed to ``faultinject.site()`` / ``faultinject.truncate_fraction``
+must be a key of the one ``SITES`` table in ``utils/faultinject.py``, and
+each name must be planted at exactly ONE call site — a typo'd or
+duplicated site name silently never fires (or fires somewhere a chaos
+schedule didn't aim), and nothing at runtime would ever notice.
 """
 from __future__ import annotations
 
@@ -18,7 +26,7 @@ from .callgraph import dotted
 from .core import Finding, Rule, SourceModule
 from .rules_jax import module_imports, module_nodes
 
-__all__ = ["SchemaDriftRule", "LockDisciplineRule"]
+__all__ = ["SchemaDriftRule", "LockDisciplineRule", "FaultSiteRule"]
 
 
 # ---------------------------------------------------------------------------
@@ -369,3 +377,133 @@ class LockDisciplineRule(Rule):
         if isinstance(stmt, ast.Global):
             return None  # the rebind itself is caught when it assigns
         return None
+
+
+# ---------------------------------------------------------------------------
+# R008: faultinject site discipline
+# ---------------------------------------------------------------------------
+class FaultSiteRule(Rule):
+    """Every literal ``faultinject.site("name")`` /
+    ``faultinject.truncate_fraction("name")`` must name a key of the one
+    ``SITES`` table in utils/faultinject.py, each name must be planted at
+    exactly one call site across the package, and every table entry must
+    be planted somewhere — three ways a fault plan (or chaos schedule)
+    could otherwise target a site that silently never fires.
+
+    Dynamically-minted site names (``faultinject.site(site)`` with a
+    variable, e.g. the engines' ``wer.<engine>`` sites) are deliberately
+    out of scope: the rule constrains literals only."""
+
+    id = "R008"
+    title = "faultinject site not registered / not unique"
+
+    SITE_FUNCS = ("site", "truncate_fraction")
+
+    def __init__(self, site_module_rel: str =
+                 "qldpc_fault_tolerance_tpu/utils/faultinject.py"):
+        self.site_module_rel = site_module_rel
+
+    # -- the SITES table + the cross-module literal-use index --------------
+    def _index(self, ctx):
+        def build():
+            mod = ctx.by_rel.get(self.site_module_rel)
+            if mod is None:
+                return None
+            registered = self._sites_table(mod)
+            if registered is None:
+                return None
+            uses: dict[str, list] = {}
+            for module in ctx.modules:
+                if getattr(module, "parse_error", None):
+                    continue
+                for node in module_nodes(module, ctx):
+                    name = self._literal_site(node)
+                    if name is not None:
+                        uses.setdefault(name, []).append(
+                            (module.rel, node.lineno, node.col_offset))
+            for occ in uses.values():
+                occ.sort()
+            return registered, uses
+        return ctx.cache("fault_sites", build)
+
+    @staticmethod
+    def _sites_table(mod: SourceModule):
+        """{site name: lineno} parsed from the module-level SITES dict
+        literal, or None when the anchor is missing/unreadable."""
+        for node in mod.tree.body:
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = [t.id for t in node.targets
+                           if isinstance(t, ast.Name)]
+                value = node.value
+            elif isinstance(node, ast.AnnAssign) and \
+                    isinstance(node.target, ast.Name):
+                targets = [node.target.id]
+                value = node.value
+            else:
+                continue
+            if "SITES" not in targets or not isinstance(value, ast.Dict):
+                continue
+            table = {}
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    table[k.value] = k.lineno
+            return table
+        return None
+
+    def _literal_site(self, node) -> "str | None":
+        """The literal first argument of a faultinject.site /
+        faultinject.truncate_fraction call (None for variables and
+        unrelated calls)."""
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if not isinstance(func, ast.Attribute):
+            return None
+        chain = dotted(func)
+        if not chain or chain[-1] not in self.SITE_FUNCS or \
+                chain[0] != "faultinject":
+            return None
+        if not node.args:
+            return None
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            return arg.value
+        return None
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        index = self._index(ctx)
+        if index is None:
+            return
+        registered, uses = index
+        if module.rel == self.site_module_rel:
+            # stale table entries keep the registry honest: an entry no
+            # call site plants means the failure point moved (or never
+            # existed) and plans targeting it are dead weight
+            for name, lineno in sorted(registered.items()):
+                if name not in uses:
+                    yield Finding(
+                        module.rel, lineno, self.id,
+                        f"site {name!r} is registered in SITES but no "
+                        f"faultinject.site()/truncate_fraction() literal "
+                        f"plants it — delete the entry or plant the site")
+        for node in module_nodes(module, ctx):
+            name = self._literal_site(node)
+            if name is None:
+                continue
+            if name not in registered:
+                yield Finding(
+                    module.rel, node.lineno, self.id,
+                    f"faultinject site {name!r} is not registered in the "
+                    f"SITES table (utils/faultinject.py) — an unregistered "
+                    f"name is one typo away from a fault plan that "
+                    f"silently never fires", node.col_offset)
+                continue
+            first = uses[name][0]
+            if (module.rel, node.lineno, node.col_offset) != first:
+                yield Finding(
+                    module.rel, node.lineno, self.id,
+                    f"faultinject site {name!r} is also planted at "
+                    f"{first[0]}:{first[1]} — one name maps to one failure "
+                    f"point; mint a distinct site name for this call",
+                    node.col_offset)

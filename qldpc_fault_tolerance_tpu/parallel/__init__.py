@@ -4,6 +4,7 @@ from .shots import (
     MegabatchDriver,
     count_min_driver,
     drain_double_buffered,
+    replay_fold,
     sharded_batch_stats,
     shot_mesh,
     split_keys_for_mesh,
@@ -14,6 +15,7 @@ __all__ = [
     "MegabatchDriver",
     "count_min_driver",
     "drain_double_buffered",
+    "replay_fold",
     "sharded_batch_stats",
     "shot_mesh",
     "split_keys_for_mesh",

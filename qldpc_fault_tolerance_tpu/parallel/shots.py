@@ -37,12 +37,36 @@ __all__ = [
     "shot_mesh",
     "sharded_batch_stats",
     "split_keys_for_mesh",
+    "replay_fold",
     "MegabatchDriver",
     "CellFusedDriver",
     "count_min_driver",
     "cell_fused_driver",
     "drain_double_buffered",
 ]
+
+
+def replay_fold(outs, n_w: int = 0, has_tele: bool = False):
+    """Fold per-logical-device stats outputs exactly as the mesh
+    collectives would — counts psum→sum, min-weight pmin→minimum, the
+    ``n_w`` float weight-moment tracks sum, trailing telemetry vector sum
+    — sequentially in device order.  The ONE implementation of the
+    ``mesh_replan`` exactness contract (integer folds are order-free, so
+    replayed counts are bit-exact with the collective; float moments
+    agree up to summation order), shared by ``CellFusedDriver``'s replay
+    step and ``sim/common.mesh_batch_stats``'s replay runner so the two
+    paths cannot drift.  ``outs[i]`` is ``(count, min_w, *moments[,
+    tele])`` for logical device ``i``."""
+    width = 2 + n_w + (1 if has_tele else 0)
+    res = list(outs[0][:width])
+    for out in outs[1:]:
+        res[0] = res[0] + out[0]
+        res[1] = jnp.minimum(res[1], out[1])
+        for i in range(n_w):
+            res[2 + i] = res[2 + i] + out[2 + i]
+        if has_tele:
+            res[2 + n_w] = res[2 + n_w] + out[2 + n_w]
+    return tuple(res)
 
 # engine stats drivers, memoized on (tag, cfg, k_inner) — see count_min_driver
 _engine_driver_cache = _LruCache()
@@ -154,6 +178,12 @@ class MegabatchDriver:
         self.k_inner = max(1, int(k_inner))
         self._init_fn = init_fn
         self.dispatches = 0  # cumulative, observable by bench
+        # an optional dispatch-level DegradationLadder (CellFusedDriver
+        # installs its mesh_replan rung here): stepped by the retry policy
+        # on repeated transient faults, and immediately on "resource"
+        # faults like MeshDeviceLoss — where retrying the same program is
+        # a guaranteed loss but a replan clears it
+        self._dispatch_ladder = None
         # cost-model accounting label (utils.profiling.capture_jit_cost):
         # the factory helpers overwrite it with the engine tag
         self.cost_label = "megabatch"
@@ -204,7 +234,10 @@ class MegabatchDriver:
 
         if self._donated:
             return attempt()
-        return resilience.run_cell(attempt, label="megabatch_dispatch")
+        ladder = self._dispatch_ladder
+        return resilience.run_cell(
+            attempt, label="megabatch_dispatch",
+            degrade=None if ladder is None else ladder.step)
 
     def run(self, key, n_batches: int, *extra, start: int = 0, carry0=None):
         """Fold ``n_batches`` batches (rounded UP to a k_inner multiple so
@@ -356,6 +389,25 @@ class CellFusedDriver(MegabatchDriver):
     per-CELL planes through the same lane-plan scatter as the counts, so
     rare-event cells ride the adaptive lane reallocation unchanged.  Carry
     becomes ``(failures, shots, min_w, s1, s2, w1, w2[, tele])``.
+
+    Elastic mesh degrade (ISSUE 14): a mesh-sharded driver installs a
+    one-rung dispatch-level DegradationLadder — ``mesh_replan`` — that the
+    retry policy steps when a dispatch dies with a device-loss /
+    "resource" fault.  ``degrade_mesh()`` rebuilds the mega program with
+    the SAME per-logical-device key folds (``fold_in(key_lane, d)`` for
+    every d of the ORIGINAL device count) executed sequentially on the
+    surviving default device instead of collectively over ICI, so the
+    replanned run consumes the identical key streams: integer counts and
+    min-weights are bit-exact with the uninterrupted mesh run, float
+    weight moments agree up to collective-vs-sequential summation order.
+    Shots accounting is unchanged (the logical stream count is what it
+    was).  The retry then re-dispatches the intact pre-dispatch carry —
+    mid-megabatch recovery with no lost or double-counted batches.  On
+    DONATING backends (TPU) the dispatch-level retry is disabled (the
+    carry may already be consumed), so a device loss escalates to the
+    cell-level retry as before — the replan rung serves the non-donating
+    (CPU / forced-host) paths and the chaos tests that prove the
+    semantics.
     """
 
     def __init__(self, stats_fn, n_cells: int, batch_size: int,
@@ -369,7 +421,9 @@ class CellFusedDriver(MegabatchDriver):
         self._mesh = mesh
         self.dispatches = 0
         self.cost_label = "fused_cells"
+        self.mesh_degraded = False
         n_dev = 1 if mesh is None else mesh.devices.size
+        self._n_dev = n_dev
         shots_inc = jnp.int32(self.batch_size * n_dev)
         big = jnp.int32(np.iinfo(np.int32).max)
         n_w = 4 if weighted else 0
@@ -383,7 +437,7 @@ class CellFusedDriver(MegabatchDriver):
                 carry += (jnp.zeros((tele_len,), jnp.int32),)
             return carry
 
-        def step(keys, lane_cell, active, *extra):
+        def step_mesh(keys, lane_cell, active, *extra):
             if mesh is None:
                 return stats_fn(keys, lane_cell, active, *extra)
 
@@ -410,42 +464,74 @@ class CellFusedDriver(MegabatchDriver):
                 check_vma=False,
             )(keys, lane_cell, active, *extra)
 
-        def mega(carry, key, lane_base, lane_stride, lane_cell, active,
-                 *extra):
-            def body(c, j):
-                b_idx = lane_base + j * lane_stride
-                keys = jax.vmap(
-                    lambda b: jax.random.fold_in(key, b))(b_idx)
-                out = step(keys, lane_cell, active, *extra)
-                cnt, mw = out[0], out[1]
-                fail = c[0].at[lane_cell].add(
-                    jnp.where(active, cnt, 0), mode="drop")
-                shots = c[1].at[lane_cell].add(
-                    jnp.where(active, shots_inc, 0), mode="drop")
-                mws = c[2].at[lane_cell].min(
-                    jnp.where(active, mw, big), mode="drop")
-                new = (fail, shots, mws)
-                new += tuple(
-                    c[3 + i].at[lane_cell].add(
-                        jnp.where(active, out[2 + i], 0.0), mode="drop")
-                    for i in range(n_w))
-                if tele_len:
-                    new += (c[3 + n_w] + out[2 + n_w],)
-                return new, None
+        def step_replay(keys, lane_cell, active, *extra):
+            # the mesh_replan rung: run the SAME n_dev logical key streams
+            # sequentially on the surviving device and fold them exactly
+            # as the psum/pmin would — integer-exact, key-identical
+            outs = []
+            for d in range(n_dev):
+                dev_keys = jax.vmap(
+                    lambda k0, _d=d: jax.random.fold_in(k0, _d))(keys)
+                outs.append(stats_fn(dev_keys, lane_cell, active, *extra))
+            return replay_fold(outs, n_w=n_w, has_tele=bool(tele_len))
 
-            carry, _ = jax.lax.scan(body, carry, jnp.arange(self.k_inner))
-            return carry
+        def make_mega(step):
+            def mega(carry, key, lane_base, lane_stride, lane_cell, active,
+                     *extra):
+                def body(c, j):
+                    b_idx = lane_base + j * lane_stride
+                    keys = jax.vmap(
+                        lambda b: jax.random.fold_in(key, b))(b_idx)
+                    out = step(keys, lane_cell, active, *extra)
+                    cnt, mw = out[0], out[1]
+                    fail = c[0].at[lane_cell].add(
+                        jnp.where(active, cnt, 0), mode="drop")
+                    shots = c[1].at[lane_cell].add(
+                        jnp.where(active, shots_inc, 0), mode="drop")
+                    mws = c[2].at[lane_cell].min(
+                        jnp.where(active, mw, big), mode="drop")
+                    new = (fail, shots, mws)
+                    new += tuple(
+                        c[3 + i].at[lane_cell].add(
+                            jnp.where(active, out[2 + i], 0.0), mode="drop")
+                        for i in range(n_w))
+                    if tele_len:
+                        new += (c[3 + n_w] + out[2 + n_w],)
+                    return new, None
+
+                carry, _ = jax.lax.scan(body, carry,
+                                        jnp.arange(self.k_inner))
+                return carry
+
+            return mega
 
         self._init_fn = init_fn
         self._donated = _carry_donation()
-        self._mega = jax.jit(
-            mega, donate_argnums=(0,) if self._donated else ())
+        self._jit_mega = lambda step: jax.jit(
+            make_mega(step), donate_argnums=(0,) if self._donated else ())
+        self._step_replay = step_replay
+        self._mega = self._jit_mega(step_mesh)
+        self._dispatch_ladder = None
+        if mesh is not None:
+            self._dispatch_ladder = resilience.DegradationLadder(
+                [("mesh_replan", self.degrade_mesh)])
         # lane plan of the fixed-budget stream, hoisted (device constants):
         # lane l <-> cell l, every cell advancing in lockstep —
         # bit-identical boundaries to the serial per-cell megabatch stream
         self._uniform = (jnp.ones((self.n_cells,), jnp.int32),
                          jnp.arange(self.n_cells, dtype=jnp.int32),
                          jnp.ones((self.n_cells,), bool))
+
+    def degrade_mesh(self) -> None:
+        """The ``mesh_replan`` rung: swap the mega program for the
+        logical-stream replay (see class docstring).  Idempotent; a no-op
+        for unmeshed drivers.  The NEXT dispatch attempt — typically the
+        retry re-dispatching the intact carry — runs replanned."""
+        if self._mesh is None or self.mesh_degraded:
+            return
+        self.mesh_degraded = True
+        telemetry.count("mesh.replans")
+        self._mega = self._jit_mega(self._step_replay)
 
     def dispatch_plan(self, carry, key, plan, *extra):
         """One guarded dispatch under an explicit host lane plan

@@ -9,16 +9,28 @@ request-driven decoder service:
 
   session.py    DecodeSession / SessionCache: AOT-compiled decode programs
                 per (H, shape-bucket), persistently cached — warm requests
-                perform zero retraces.
+                perform zero retraces; ``heal()`` rebuilds + recompiles in
+                the background and swaps atomically (ISSUE 14).
   scheduler.py  ContinuousBatcher: coalesces requests across tenants into
                 padded megabatches with deadline-aware flush and
-                round-robin fairness; graceful drain.
+                round-robin fairness; graceful drain.  Exactly-once
+                re-dispatch (ISSUE 14): an idempotency journal dedupes
+                resubmits/hedges, failed dispatches re-queue their batch
+                (bounded attempts, then a structured error), and every
+                failure feeds the self-healing incident stream.
   server.py     asyncio TCP front-end (length-prefixed JSON frames),
-                streamed per-request responses, drain-on-shutdown.
-  client.py     blocking pipelined client (the bench load generator).
+                streamed per-request responses, drain-on-shutdown;
+                network chaos sites (conn_drop / torn_frame).
+  client.py     blocking pipelined client (the bench load generator) with
+                reconnect + resubmit and hedged-resubmit transport
+                recovery (ISSUE 14) — broken pipes are per-request
+                transient errors, never fatal to the client.
   ops.py        live ops plane (ISSUE 11): SLO burn-rate engine feeding
                 shed/defer admission signals into the batcher, plus the
-                /metrics /healthz /varz /tracez HTTP sidecar.
+                /metrics /healthz /varz /tracez HTTP sidecar; HealthProbe
+                (ISSUE 14) — the self-healing loop converting dispatch
+                incidents + device-reset epochs into background session
+                heals.
 
 Per-request observability (ISSUE 11): trace contexts ride an optional
 wire-frame field end to end (utils.tracing) — queue_wait / batch_assemble
@@ -40,6 +52,7 @@ from .session import (
 from .scheduler import ContinuousBatcher, DecodeResult, assemble_round_robin
 from .ops import (
     AdmissionError,
+    HealthProbe,
     OpsHandle,
     OpsServer,
     SLOEngine,
@@ -58,6 +71,7 @@ __all__ = [
     "DecodeResult",
     "assemble_round_robin",
     "AdmissionError",
+    "HealthProbe",
     "OpsHandle",
     "OpsServer",
     "SLOEngine",

@@ -1,4 +1,5 @@
-"""Blocking decode-service client with pipelined submits.
+"""Blocking decode-service client with pipelined submits, reconnect and
+hedged resubmit.
 
 A thin stdlib-socket counterpart to serve/server.py's protocol: ``submit``
 sends a decode frame and returns a future immediately (responses stream
@@ -14,8 +15,30 @@ Tracing (ISSUE 11): construct with ``traced=True`` (or pass ``trace=`` per
 submit) and every request mints a ``utils.tracing.TraceContext`` that
 rides the optional wire field — the server records the full stage-span
 tree under it and echoes the trace id back on ``ClientResult.trace_id``,
-the key for the JSONL stream and ``/tracez``.  Untraced clients send
-byte-identical frames to pre-tracing builds.
+the key for the JSONL stream and ``/tracez``.
+
+Self-healing transport (ISSUE 14):
+
+  * a broken pipe is a PER-REQUEST transient error, never fatal to the
+    client: a submit that hits a dead socket resolves ITS future with a
+    ``ConnectionError`` (classified transient by utils.resilience) and
+    the client stays usable — or, with ``reconnect=True``, the request
+    simply rides the resubmit below;
+  * ``reconnect=True`` — when the connection dies, the reader thread
+    redials (bounded attempts, jittered backoff via the sanctioned
+    ``resilience.sleep_for``) and RESUBMITS every unanswered request on
+    the new connection with a fresh wire id and the SAME idempotency key
+    (serve/wire.py ``IDEM_FIELD``), which the server's journal dedupes —
+    a request whose response died on the wire is replayed from the
+    answered cache, never decoded twice;
+  * ``hedge_s=<seconds>`` — a request unanswered for that long is
+    resubmitted on the live connection (same idempotency key, bounded
+    ``max_hedges``); the server attaches the duplicate to the in-flight
+    decode, so hedging bounds tail latency without duplicating work.
+
+Idempotency keys are minted automatically whenever ``reconnect`` or
+``hedge_s`` is enabled (or explicitly via ``idempotent=True``); a plain
+client sends frames byte-identical to pre-ISSUE-14 builds.
 """
 from __future__ import annotations
 
@@ -31,8 +54,9 @@ from concurrent.futures import Future
 
 import numpy as np
 
-from ..utils import tracing
-from .wire import HEADER, MAX_FRAME_BYTES, TRACE_FIELD, encode_frame
+from ..utils import resilience, telemetry, tracing
+from .wire import HEADER, IDEM_FIELD, MAX_FRAME_BYTES, TRACE_FIELD, \
+    encode_frame
 
 __all__ = ["ClientResult", "DecodeClient"]
 
@@ -47,26 +71,81 @@ class ClientResult:
     trace_id: str | None = None      # echoed by the server when traced
 
 
+class _Inflight:
+    """One logical request across its transmissions: the base frame (all
+    fields but the wire id; None for clients that can never resend — no
+    point retaining the payload), the future, and every wire id it has
+    been sent under (reconnect resubmits and hedges mint fresh ones; the
+    server matches responses to whichever transmission answered)."""
+
+    __slots__ = ("future", "t0", "base", "rids", "last_tx", "hedges",
+                 "resubmits")
+
+    def __init__(self, base: dict, t0: float):
+        self.future: Future = Future()
+        self.t0 = t0
+        self.base = base
+        self.rids: set[str] = set()
+        self.last_tx = t0
+        self.hedges = 0
+        self.resubmits = 0
+
+
 class DecodeClient:
     def __init__(self, host: str, port: int, *, tenant: str = "default",
-                 timeout: float = 60.0, traced: bool = False):
+                 timeout: float = 60.0, traced: bool = False,
+                 reconnect: bool = False, max_reconnects: int = 8,
+                 reconnect_backoff_s: float = 0.05,
+                 hedge_s: float | None = None, max_hedges: int = 1,
+                 idempotent: bool | None = None):
+        self.host, self.port = host, int(port)
         self.tenant = str(tenant)
         self.traced = bool(traced)
         self.timeout = float(timeout)
+        self.reconnect = bool(reconnect)
+        self.max_reconnects = max(1, int(max_reconnects))
+        self.reconnect_backoff_s = float(reconnect_backoff_s)
+        self.hedge_s = None if hedge_s is None else float(hedge_s)
+        self.max_hedges = max(0, int(max_hedges))
+        # resubmits and hedges only dedupe server-side when requests carry
+        # idempotency keys, so those modes imply them; a plain client
+        # keeps its frames byte-identical to older builds
+        self.idempotent = (bool(reconnect or hedge_s is not None)
+                           if idempotent is None else bool(idempotent))
+        self.reconnects = 0
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
-        self._pending: dict[str, tuple[Future, float]] = {}
+        # wire id -> logical request (several ids may map to one request)
+        self._reqs: dict[str, _Inflight] = {}
         # ping waiters queue FIFO (pongs come back in order): concurrent
         # pings from threads sharing one client each get their own future
-        self._pongs: deque[Future] = deque()
+        self._pongs: deque = deque()
         self._closed = False
+        # set (under _plock, atomically with failing the outstanding
+        # requests) when the transport is permanently gone — a submit
+        # after that point must fail ITS future immediately instead of
+        # registering work no reader will ever resolve
+        self._dead = False
+        self._stop = threading.Event()
         self._ids = itertools.count()
         self._prefix = uuid.uuid4().hex[:8]
+        # idempotency keys key SERVER-side dedupe (scoped per tenant +
+        # session there, but key collisions between a fleet's clients of
+        # one tenant would still cross requests): full 128-bit uuid, not
+        # the short wire-id prefix whose 32 bits birthday-collide at
+        # fleet scale
+        self._idem_prefix = uuid.uuid4().hex
         self._reader = threading.Thread(target=self._read_loop, daemon=True,
                                         name="qldpc-serve-client")
         self._reader.start()
+        self._hedger = None
+        if self.hedge_s is not None and self.max_hedges > 0:
+            self._hedger = threading.Thread(
+                target=self._hedge_loop, daemon=True,
+                name="qldpc-serve-client-hedge")
+            self._hedger.start()
 
     # ------------------------------------------------------------------
     def _send(self, obj) -> None:
@@ -74,11 +153,11 @@ class DecodeClient:
         with self._wlock:
             self._sock.sendall(frame)
 
-    def _recv_exact(self, n: int) -> bytes | None:
+    def _recv_exact(self, sock, n: int) -> bytes | None:
         buf = b""
         while len(buf) < n:
             try:
-                chunk = self._sock.recv(n - len(buf))
+                chunk = sock.recv(n - len(buf))
             except socket.timeout:
                 # idle is NOT disconnect: a low-traffic client must keep
                 # its reader alive past the socket timeout (close() breaks
@@ -93,20 +172,23 @@ class DecodeClient:
             buf += chunk
         return buf
 
-    def _read_loop(self) -> None:
+    def _pump(self, sock) -> None:
+        """Read frames off ONE socket until it dies."""
         while True:
-            head = self._recv_exact(HEADER.size)
+            head = self._recv_exact(sock, HEADER.size)
             if head is None:
-                break
+                return
             (length,) = HEADER.unpack(head)
             if length > MAX_FRAME_BYTES:
-                break  # protocol corruption — fail pending via loop exit
-            body = self._recv_exact(length)
+                return  # protocol corruption — reconnect or fail pending
+            body = self._recv_exact(sock, length)
             if body is None:
-                break
+                return
             try:
                 msg = json.loads(body.decode("utf-8"))
             except json.JSONDecodeError:
+                continue
+            if not isinstance(msg, dict):
                 continue
             if msg.get("pong"):
                 with self._plock:
@@ -116,32 +198,201 @@ class DecodeClient:
                 continue
             rid = msg.get("id")
             with self._plock:
-                entry = self._pending.pop(rid, None)
-            if entry is None:
+                req = self._reqs.get(rid)
+                if req is not None:
+                    # one answer resolves the LOGICAL request: retire
+                    # every wire id it was transmitted under (a hedge's
+                    # late second answer finds nothing and is dropped)
+                    for r in req.rids:
+                        self._reqs.pop(r, None)
+            if req is None:
                 continue
-            fut, t0 = entry
+            fut, t0 = req.future, req.t0
+            if fut.done():
+                continue
             if msg.get("ok"):
-                fut.set_result(ClientResult(
-                    corrections=np.asarray(msg["corrections"], np.uint8),
-                    converged=msg.get("converged"),
-                    latency_s=time.perf_counter() - t0,
-                    server_latency_ms=msg.get("latency_ms"),
-                    request_id=str(rid),
-                    trace_id=msg.get("trace_id")))
+                try:
+                    result = ClientResult(
+                        corrections=np.asarray(msg["corrections"],
+                                               np.uint8),
+                        converged=msg.get("converged"),
+                        latency_s=time.perf_counter() - t0,
+                        server_latency_ms=msg.get("latency_ms"),
+                        request_id=str(rid),
+                        trace_id=msg.get("trace_id"))
+                except Exception as exc:  # noqa: BLE001 — reader survives
+                    # a parseable-but-malformed response (version skew,
+                    # corruption) fails ITS request; killing the reader
+                    # here would skip the reconnect path AND the final
+                    # drain, hanging every other outstanding future
+                    fut.set_exception(RuntimeError(
+                        f"malformed decode response: "
+                        f"{type(exc).__name__}: {exc}"))
+                    continue
+                fut.set_result(result)
             else:
                 fut.set_exception(
                     RuntimeError(msg.get("error", "decode failed")))
-        # socket gone: fail whatever is still outstanding
+
+    def _logical_reqs(self) -> list:
+        """Unique in-flight logical requests (several wire ids may map to
+        one ``_Inflight``).  Call under ``_plock``."""
+        return list({id(r): r for r in self._reqs.values()}.values())
+
+    def _read_loop(self) -> None:
+        while True:
+            t_conn = time.perf_counter()
+            try:
+                self._pump(self._sock)
+            except Exception:  # noqa: BLE001 — epilogue must always run
+                # whatever killed the pump, the drain below (or the
+                # reconnect) must still happen: a dead reader that never
+                # set _dead would hang every outstanding future
+                telemetry.count("serve.client.reader_errors")
+            lifetime = time.perf_counter() - t_conn
+            if self._closed or not self.reconnect:
+                break
+            # a connection that died almost immediately signals a
+            # crash-looping server: back off BEFORE the first redial too,
+            # or accept->die->redial->resubmit becomes a zero-sleep spin
+            if not self._reconnect(fast_death=lifetime < 1.0):
+                break
+        # transport permanently gone: fail whatever is still outstanding.
+        # _dead flips under the SAME lock hold that drains the table, so
+        # a racing submit either lands in the drain or sees the flag
         with self._plock:
-            pending, self._pending = self._pending, {}
+            self._dead = True
+            reqs, self._reqs = self._reqs, {}
             pongs, self._pongs = list(self._pongs), deque()
         err = ConnectionError("decode-service connection closed")
-        for fut, _ in pending.values():
-            if not fut.done():
-                fut.set_exception(err)
+        for req in {id(r): r for r in reqs.values()}.values():
+            if not req.future.done():
+                req.future.set_exception(err)
         for pong in pongs:
             if not pong.done():
                 pong.set_exception(err)
+
+    def _fail_request(self, req, exc: Exception) -> None:
+        """Retire one logical request with an error: unregister every
+        wire id and fail its future (used for unsendable frames — e.g. a
+        payload over the frame cap, which no resend can ever fix)."""
+        with self._plock:
+            for r in list(req.rids):
+                self._reqs.pop(r, None)
+        if not req.future.done():
+            req.future.set_exception(exc)
+
+    # ------------------------------------------------------------------
+    # reconnect + resubmit (the self-healing transport)
+    # ------------------------------------------------------------------
+    def _reconnect(self, fast_death: bool = False) -> bool:
+        """Redial (bounded attempts, backoff) and resubmit every
+        unanswered request on the fresh connection.  Returns True when a
+        new connection is live.  ``fast_death`` (the previous connection
+        died near-instantly) makes even the first dial back off."""
+        # a reconnect dial is transport recovery, not device-work retry:
+        # RetryPolicy's between-attempt reset_device_state and sweep-scale
+        # backoff have no business on a network client; attempts still
+        # sleep via the sanctioned resilience.sleep_for and are counted
+        for attempt in range(self.max_reconnects):  # qldpc: ignore[R102]
+            if self._closed:
+                return False
+            if attempt or fast_death:
+                resilience.sleep_for(
+                    min(2.0, self.reconnect_backoff_s * (2 ** attempt)))
+            try:
+                sock = socket.create_connection(
+                    (self.host, self.port), timeout=self.timeout)
+            except OSError:
+                continue
+            # swap + pong drain under ONE _wlock hold (nested _plock,
+            # same _wlock->_plock order ping uses): a ping sent on the
+            # NEW connection can only run before the swap (old-socket
+            # pong, correctly failed below) or after the drain (new
+            # pong, correctly kept) — never be spuriously failed
+            with self._wlock:
+                old, self._sock = self._sock, sock
+                with self._plock:
+                    closed = self._closed
+                    pongs, self._pongs = list(self._pongs), deque()
+            try:
+                old.close()
+            except OSError:
+                pass
+            for pong in pongs:
+                if not pong.done():
+                    pong.set_exception(
+                        ConnectionError("connection replaced"))
+            if closed:
+                # close() ran mid-dial: it shut down the PREVIOUS socket,
+                # so the fresh one must not strand the reader (and leak a
+                # live TCP connection) — tear it down and exit
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                return False
+            self.reconnects += 1
+            telemetry.count("serve.client.reconnects")
+            self._resubmit_unanswered()
+            return True
+        return False
+
+    def _resubmit_unanswered(self) -> None:
+        """Send every unanswered logical request again with a fresh wire
+        id and its original idempotency key — the server's journal
+        attaches duplicates to in-flight decodes and replays
+        already-answered ones, so a resubmit is always safe."""
+        with self._plock:
+            reqs = self._logical_reqs()
+            sends = []
+            for req in reqs:
+                if req.future.done() or req.base is None:
+                    continue
+                rid = f"{self._prefix}-{next(self._ids)}"
+                req.rids.add(rid)
+                req.resubmits += 1
+                req.last_tx = time.perf_counter()
+                self._reqs[rid] = req
+                sends.append((req, {**req.base, "id": rid}))
+        for req, msg in sends:
+            try:
+                self._send(msg)
+                telemetry.count("serve.client.resubmits")
+            except ValueError as exc:
+                # unencodable frame (over the cap): resending can never
+                # fix it — fail THIS request, keep resubmitting the rest
+                self._fail_request(req, exc)
+            except OSError:
+                return  # socket died again; the reader loop redials
+
+    def _hedge_loop(self) -> None:
+        """Resubmit requests unanswered past the hedge deadline (same
+        idempotency key — the server dedupes, so a hedge can only help)."""
+        interval = max(0.001, self.hedge_s / 2.0)
+        while not self._stop.wait(interval):
+            now = time.perf_counter()
+            with self._plock:
+                sends = []
+                for req in self._logical_reqs():
+                    if req.future.done() or req.base is None \
+                            or req.hedges >= self.max_hedges \
+                            or now - req.last_tx < self.hedge_s:
+                        continue
+                    rid = f"{self._prefix}-{next(self._ids)}"
+                    req.rids.add(rid)
+                    req.hedges += 1
+                    req.last_tx = now
+                    self._reqs[rid] = req
+                    sends.append((req, {**req.base, "id": rid}))
+            for req, msg in sends:
+                try:
+                    self._send(msg)
+                    telemetry.count("serve.client.hedges")
+                except ValueError as exc:
+                    self._fail_request(req, exc)  # unencodable: see above
+                except OSError:
+                    break  # dead socket: the reader owns recovery
 
     # ------------------------------------------------------------------
     def submit(self, session: str, syndromes, *,
@@ -149,28 +400,66 @@ class DecodeClient:
                trace: "tracing.TraceContext | None" = None) -> Future:
         """Send one decode request; returns its future.  ``trace``
         attaches an explicit trace context; ``traced=True`` clients mint
-        one per request when none is given."""
+        one per request when none is given.
+
+        A send that hits a dead socket is a PER-REQUEST transient error:
+        without ``reconnect`` the returned future carries a
+        ``ConnectionError`` (the client object stays usable); with it,
+        the request stays registered and rides the reconnect resubmit."""
         arr = np.atleast_2d(np.asarray(syndromes))
-        rid = f"{self._prefix}-{next(self._ids)}"
+        n = next(self._ids)
+        rid = f"{self._prefix}-{n}"
         if trace is None and self.traced:
             trace = tracing.TraceContext()
-        fut: Future = Future()
+        base = {"op": "decode", "session": str(session),
+                "tenant": tenant or self.tenant,
+                "syndromes": arr.tolist()}
+        if self.idempotent:
+            base[IDEM_FIELD] = f"{self._idem_prefix}-i{n}"
+        if trace is not None:
+            base[TRACE_FIELD] = trace.to_wire()
+        # only clients that can ever RESEND (reconnect resubmit / hedging)
+        # need the frame retained until the answer; a plain client holding
+        # the tolist() payload per in-flight request would pay ~10x the
+        # syndrome bytes across its whole pipeline window for nothing
+        resubmittable = self.reconnect or self._hedger is not None
+        req = _Inflight(base if resubmittable else None,
+                        time.perf_counter())
         with self._plock:
             if self._closed:
                 raise RuntimeError("client closed")
-            self._pending[rid] = (fut, time.perf_counter())
-        msg = {"op": "decode", "id": rid, "session": str(session),
-               "tenant": tenant or self.tenant,
-               "syndromes": arr.tolist()}
-        if trace is not None:
-            msg[TRACE_FIELD] = trace.to_wire()
+            if self._dead:
+                # the reader already declared the transport gone (and
+                # drained the request table): registering now would leave
+                # this future unresolved forever — and a send into the
+                # dead socket can "succeed" into the buffer, so the error
+                # must come from here, not from sendall
+                req.future.set_exception(ConnectionError(
+                    "decode-service connection closed"))
+                return req.future
+            req.rids.add(rid)
+            self._reqs[rid] = req
         try:
-            self._send(msg)
-        except OSError:
-            with self._plock:
-                self._pending.pop(rid, None)
-            raise
-        return fut
+            self._send({**base, "id": rid})
+        except ValueError as exc:
+            # over the frame cap: no reconnect or resend can ever fix
+            # this payload, and leaving it registered would leak it (and
+            # crash the resubmit/hedge threads re-encoding it) — fail
+            # THIS request, the client stays healthy
+            self._fail_request(req, exc)
+        except OSError as exc:
+            if not self.reconnect:
+                # surface on THIS request only — a broken pipe must not
+                # poison the client object (regression-tested with a torn
+                # raw socket)
+                with self._plock:
+                    self._reqs.pop(rid, None)
+                if not req.future.done():
+                    req.future.set_exception(ConnectionError(
+                        f"decode submit hit a dead connection: {exc}"))
+            # with reconnect: leave it registered — the reader notices
+            # the dead socket and resubmits on the fresh connection
+        return req.future
 
     def decode(self, session: str, syndromes, *,
                tenant: str | None = None,
@@ -189,6 +478,12 @@ class DecodeClient:
             with self._plock:
                 if self._closed:
                     raise RuntimeError("client closed")
+                if self._dead:
+                    # no reader is alive to match a pong: a send could
+                    # still "succeed" into the dead socket's buffer and
+                    # the caller would block the full timeout
+                    raise ConnectionError(
+                        "decode-service connection closed")
                 self._pongs.append(fut)
             self._sock.sendall(encode_frame({"op": "ping"}))
         return fut.result(timeout=self.timeout)
@@ -196,12 +491,20 @@ class DecodeClient:
     def close(self) -> None:
         with self._plock:
             self._closed = True
+        self._stop.set()
+        # the CURRENT socket, atomically with any in-flight reconnect
+        # swap (the swap's own post-swap _closed check covers the other
+        # interleaving: a socket swapped in after this closes itself)
+        with self._wlock:
+            sock = self._sock
         try:
-            self._sock.shutdown(socket.SHUT_RDWR)
+            sock.shutdown(socket.SHUT_RDWR)
         except OSError:
             pass
-        self._sock.close()
+        sock.close()
         self._reader.join(timeout=10.0)
+        if self._hedger is not None:
+            self._hedger.join(timeout=10.0)
 
     def __enter__(self):
         return self

@@ -24,7 +24,18 @@ the telemetry/tracing substrate that already exists:
     errored traces from the flight-recorder ring; filter with
     ``?trace_id=``, ``?slow_ms=``, ``?errored=1``, ``?limit=``).
 
-Neither piece touches the sweep hot path; both read state the serving
+  * **HealthProbe** (ISSUE 14) — the self-healing loop: a daemon thread
+    that drains the batcher's dispatch-failure *incidents* (watchdog
+    fires, transient dispatch deaths — recorded push-style by the
+    dispatcher, never polled from device state) and watches the process
+    device-reset epoch (``utils.resilience.device_epoch`` — bumped by
+    every ``reset_device_state``), then drives
+    ``DecodeSession.heal()`` — rebuild state + recompile the warm bucket
+    set — on ITS OWN thread while the old programs keep serving, swapping
+    atomically when ready.  Recovery stops being "the next request pays
+    (or fails)" and becomes invisible to traffic.
+
+Neither piece touches the sweep hot path; all read state the serving
 layer already maintains.
 """
 from __future__ import annotations
@@ -37,12 +48,13 @@ import threading
 import time
 import urllib.parse
 
-from ..utils import telemetry, tracing
+from ..utils import resilience, telemetry, tracing
 
 __all__ = [
     "AdmissionError",
     "SLOPolicy",
     "SLOEngine",
+    "HealthProbe",
     "OpsServer",
     "OpsHandle",
     "spawn_server_loop",
@@ -306,6 +318,126 @@ class SLOEngine:
 
 
 # ---------------------------------------------------------------------------
+# Self-healing sessions (ISSUE 14)
+# ---------------------------------------------------------------------------
+class HealthProbe:
+    """The self-healing loop: detect dead device state, recompile sessions
+    in the background, swap while the old programs keep serving.
+
+    Detection is two signals, both free of device round-trips:
+
+      * the batcher's *incidents* — every dispatch that died after its
+        in-dispatch retries (watchdog-failed fetch, transient fault,
+        injected chaos) is recorded with its session name and error
+        classification; the probe heals exactly the sessions implicated;
+      * the process device-reset epoch (``resilience.device_epoch``) — a
+        ``reset_device_state`` anywhere in the process conceptually kills
+        EVERY session's uploaded state, so an epoch move heals all of
+        them.  This is deliberately conservative: the default RetryPolicy
+        resets caches between transient retries, so a serving host that
+        shares its process with retrying sweeps (or leaves the default
+        policy's ``reset_caches`` on for serve dispatches) will
+        fleet-heal after any such retry.  Heals are always SAFE (rebuild
+        from host data, off the dispatcher thread, atomic swap) and
+        coalesce per probe pass; a deployment where that background
+        recompile traffic matters should serve under a
+        ``reset_caches=False`` policy — incident-driven heals already
+        cover the sessions a real failure implicates.
+
+    ``DecodeSession.heal()`` runs on the probe thread: the dispatcher
+    keeps serving the old programs until the atomic swap, so recovery
+    costs traffic nothing (tests pin that a request stream running across
+    a heal never fails and stays bit-exact).  ``probe_once()`` is the
+    synchronous unit tests drive; the daemon loop is just that on a
+    timer."""
+
+    def __init__(self, batcher, *, interval_s: float = 0.25,
+                 start: bool = True):
+        self.batcher = batcher
+        self.interval_s = float(interval_s)
+        self.heals = 0
+        self.last_heal_t: float | None = None
+        self._healed_epoch = resilience.device_epoch()
+        # sessions owing a heal, by reason.  Signals are consumed into
+        # this map BEFORE the heal attempts, and an entry only leaves on
+        # SUCCESS — a heal that fails (the device may still be flapping
+        # right after the restart that triggered it) is retried on every
+        # later pass instead of being silently given up on.  Touched only
+        # by the probe thread / direct probe_once() callers.
+        self._pending_heals: dict = {}
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="qldpc-serve-healthprobe")
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    def probe_once(self) -> list:
+        """One probe pass: drain incidents, check the reset epoch, heal
+        owing sessions on THIS thread.  Returns the healed session names
+        (empty = healthy).  A failed heal keeps its session in the
+        pending map, so the NEXT pass retries it — the signals are
+        consumed here, but the obligation only clears on success."""
+        for inc in self.batcher.take_incidents():
+            # deterministic failures are program bugs — recompiling the
+            # same program against the same state cannot fix them
+            if inc.get("kind") != "deterministic":
+                self._pending_heals[str(inc.get("session"))] = "incident"
+        epoch = resilience.device_epoch()
+        if epoch != self._healed_epoch:
+            self._healed_epoch = epoch
+            for name in self.batcher.sessions.names():
+                self._pending_heals.setdefault(name, "device_reset")
+        healed = []
+        for name in sorted(self._pending_heals):
+            try:
+                sess = self.batcher.sessions.get(name)
+            except KeyError:
+                # evicted since the incident — nothing left to heal
+                self._pending_heals.pop(name, None)
+                continue
+            try:
+                sess.heal(reason=self._pending_heals[name])
+            except Exception as exc:  # noqa: BLE001 — probe must survive
+                telemetry.count("serve.heal_failures")
+                tracing.note_failure("heal_failed", session=name,
+                                     error=f"{type(exc).__name__}: {exc}")
+                continue  # stays pending: retried next pass
+            self._pending_heals.pop(name, None)
+            healed.append(name)
+            self.heals += 1
+            self.last_heal_t = time.monotonic()
+        return healed
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.probe_once()
+            except Exception:  # noqa: BLE001 — the loop never dies
+                telemetry.count("serve.probe_errors")
+
+    def report(self) -> dict:
+        """The /healthz block: lifetime heals + last-heal age."""
+        last = self.last_heal_t
+        return {
+            "heals": int(self.heals),
+            "pending_heals": len(self._pending_heals),
+            "device_epoch": resilience.device_epoch(),
+            "last_heal_age_s": (None if last is None
+                                else round(time.monotonic() - last, 3)),
+            "running": bool(self._thread is not None
+                            and self._thread.is_alive()),
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
 # HTTP ops plane
 # ---------------------------------------------------------------------------
 _HTTP_REASONS = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
@@ -329,12 +461,14 @@ class OpsServer:
 
     def __init__(self, batcher=None, slo: SLOEngine | None = None,
                  host: str = "127.0.0.1", port: int = 0,
-                 flight: "tracing.FlightRecorder | None" = None):
+                 flight: "tracing.FlightRecorder | None" = None,
+                 probe: "HealthProbe | None" = None):
         self.batcher = batcher
         self.slo = slo
         self.host = host
         self.port = int(port)
         self.flight = flight
+        self.probe = probe
         self._server: asyncio.AbstractServer | None = None
         self.t_started = time.monotonic()
 
@@ -418,6 +552,8 @@ class OpsServer:
                               or health.get("draining"))
         if self.slo is not None:
             body["slo"] = self.slo.report()
+        if self.probe is not None:
+            body["probe"] = self.probe.report()
         return body
 
     def varz(self) -> dict:
@@ -511,9 +647,11 @@ def spawn_server_loop(start, thread_name: str, what: str):
 
 
 def start_ops_thread(batcher=None, slo: SLOEngine | None = None,
-                     host: str = "127.0.0.1", port: int = 0) -> OpsHandle:
+                     host: str = "127.0.0.1", port: int = 0,
+                     probe: "HealthProbe | None" = None) -> OpsHandle:
     """Start the ops plane on a daemon thread; returns once it accepts."""
-    server = OpsServer(batcher=batcher, slo=slo, host=host, port=port)
+    server = OpsServer(batcher=batcher, slo=slo, host=host, port=port,
+                       probe=probe)
     loop, thread = spawn_server_loop(server.start, "qldpc-serve-ops",
                                      "ops server")
     return OpsHandle(server, loop, thread)

@@ -18,9 +18,23 @@ Every dispatch runs under the active resilience policy
 (utils.resilience.run_cell) with a one-rung degradation ladder that
 invalidates + rebuilds the session's compiled programs — the recovery that
 actually helps after a worker restart killed the uploaded graph buffers.
-A dispatch that still fails after retries FAILS the batch's futures (the
-requests are answered, never dropped); ``drain()`` flushes everything left
-before stopping, so shutdown loses nothing either.
+
+Exactly-once re-dispatch (ISSUE 14): a dispatch that still fails after
+retries RE-QUEUES its batch's requests — each request carries a bounded
+attempt budget (``max_dispatch_attempts``); only when the budget is
+exhausted (or the error is deterministic, or the batcher is stopped) is
+the future failed with a structured error.  Requests carrying an
+idempotency key (serve/wire.py ``IDEM_FIELD``) are JOURNALED from accept
+to answer: a duplicate submit with the same key — a client hedge or a
+reconnect resubmit — attaches to the in-flight decode, and a duplicate
+arriving just after the answer replays the cached result from a bounded
+LRU.  No request dropped, none decoded twice.  ``drain()`` flushes
+everything left before stopping, so shutdown loses nothing either.
+
+Self-healing feed: every failed dispatch is recorded as an *incident*
+(session, error classification) that ``serve.ops.HealthProbe`` drains to
+drive background session recompiles — detection is push-based off the
+dispatcher's failures, never a poll of device state.
 
 SLO observability (utils.telemetry, free when disabled): ``serve.requests``
 / ``serve.shots`` / ``serve.batches`` / ``serve.errors`` counters (plus
@@ -75,6 +89,12 @@ class _Request:
     future: Future
     t0: float
     trace: "tracing.TraceContext | None" = None
+    # journal key for exactly-once dedupe: (tenant, session, idem) — the
+    # wire-controlled idem string alone must never be the key, or a
+    # collision (hostile or low-entropy client) would replay one tenant's
+    # corrections to another
+    idem: tuple | None = None
+    attempts: int = 0             # failed dispatches this request rode
 
     @property
     def shots(self) -> int:
@@ -195,7 +215,9 @@ class ContinuousBatcher:
     """
 
     def __init__(self, sessions, *, max_batch_shots: int = 1024,
-                 max_wait_s: float = 0.002, slo=None):
+                 max_wait_s: float = 0.002, slo=None,
+                 max_dispatch_attempts: int = 3,
+                 answered_cache: int = 4096):
         if isinstance(sessions, dict):
             cache = SessionCache(max_sessions=max(8, len(sessions)))
             for s in sessions.values():
@@ -205,6 +227,15 @@ class ContinuousBatcher:
         self.slo = slo
         self.max_batch_shots = max(1, int(max_batch_shots))
         self.max_wait_s = float(max_wait_s)
+        # exactly-once re-dispatch budget: how many failed dispatches one
+        # request may ride before its future gets the structured error
+        self.max_dispatch_attempts = max(1, int(max_dispatch_attempts))
+        self.answered_cache = max(16, int(answered_cache))
+        # the answered LRU is additionally bounded by BYTES: each entry
+        # retains a full corrections array, and 4096 large-batch results
+        # would otherwise pin GBs on a long-lived host
+        self.answered_cache_bytes = 256 * 1024 * 1024
+        self._answered_bytes = 0
         self._last_dispatch_t: float | None = None
         self._cv = threading.Condition()
         self._pending: dict[str, _SessionQueue] = {}
@@ -213,7 +244,19 @@ class ContinuousBatcher:
         self._stopped = False
         self.completed = 0
         self.failed = 0
+        self.redispatched = 0
         self._drain_emitted = False
+        # the idempotency journal (ISSUE 14): accepted-but-unanswered
+        # requests by key, plus a bounded LRU of recently answered results
+        # so a hedge arriving just after the answer replays instead of
+        # re-decoding.  Both live under self._cv with the queues — journal
+        # transitions must be atomic with queue/answer transitions or a
+        # hedge threading the gap would decode twice.
+        self._journal: dict[str, _Request] = {}
+        self._answered: "OrderedDict[str, DecodeResult]" = OrderedDict()
+        # dispatch-failure incidents for the self-healing probe
+        # (serve.ops.HealthProbe.take via take_incidents)
+        self._incidents: deque = deque(maxlen=256)
         # per-tenant counter labels are bounded: the tenant string arrives
         # from the wire, and a unique-tenant-per-request client would
         # otherwise grow the process-wide metrics registry without limit
@@ -227,15 +270,54 @@ class ContinuousBatcher:
     # ------------------------------------------------------------------
     # submission
     # ------------------------------------------------------------------
+    @staticmethod
+    def _result_nbytes(res: DecodeResult) -> int:
+        """Retained size of one cached answer (the byte bound on the
+        answered LRU)."""
+        n = int(res.corrections.nbytes)
+        if res.converged is not None:
+            n += int(res.converged.nbytes)
+        return n
+
+    @staticmethod
+    def _attach(src: Future) -> Future:
+        """A fresh future mirroring ``src`` (result or exception) — what a
+        deduped duplicate submit returns: one decode, several answers."""
+        dst: Future = Future()
+
+        def _copy(f):
+            if dst.done():
+                return
+            exc = f.exception()
+            if exc is not None:
+                dst.set_exception(exc)
+            else:
+                dst.set_result(f.result())
+
+        src.add_done_callback(_copy)
+        return dst
+
     def submit(self, session: str, syndromes, *, tenant: str = "default",
-               request_id: str | None = None, trace=None) -> Future:
+               request_id: str | None = None, trace=None,
+               idem: str | None = None) -> Future:
         """Enqueue one decode request; returns its future.  Validation
         (unknown session, wrong width, empty batch) raises HERE, on the
         caller's thread, so the queue only ever holds dispatchable work —
         and so does the SLO admission gate: a shed tenant's submit raises
         ``AdmissionError`` before anything is queued.  ``trace`` is an
         optional ``tracing.TraceContext`` the request's stage spans record
-        under."""
+        under.
+
+        ``idem`` is the optional idempotency key (constant across a
+        client's resubmits of ONE logical request): a key already in the
+        journal attaches to the in-flight decode, a key in the answered
+        LRU replays the cached result — either way the duplicate is
+        answered without decoding twice.  Dedupe is scoped per (tenant,
+        session): the idem string is wire-controlled, and an unscoped
+        collision would hand one tenant another tenant's corrections.
+        The dedupe consult precedes the SLO gate deliberately: shedding a
+        hedge of work already in flight would waste the decode the
+        original is paying for."""
         sess = self.sessions.get(str(session))
         arr = np.atleast_2d(np.asarray(syndromes, dtype=np.uint8))
         if arr.ndim != 2 or arr.shape[0] == 0:
@@ -244,14 +326,58 @@ class ContinuousBatcher:
             raise ValueError(
                 f"session {session!r} decodes width {sess.syndrome_width}, "
                 f"got {arr.shape[1]}")
+        if idem is not None:
+            idem = (str(tenant), str(session), str(idem))
+            if self.slo is not None:
+                # pre-gate dedupe consult, only needed when an SLO gate
+                # exists to mis-fire: a shed tenant's hedge of work
+                # already in flight should attach, not be refused (the
+                # decode is happening either way).  Without an SLO the
+                # single under-lock consult below handles dedupe and the
+                # steady-state journal path pays one lock hold, not two.
+                with self._cv:
+                    done = self._answered.get(idem)
+                    if done is not None:
+                        self._answered.move_to_end(idem)
+                        fut: Future = Future()
+                        fut.set_result(done)
+                        telemetry.count("serve.dedup.replayed")
+                        return fut
+                    inflight = self._journal.get(idem)
+                    if inflight is not None:
+                        telemetry.count("serve.dedup.attached")
+                        return self._attach(inflight.future)
         if self.slo is not None:
             self.slo.check_admission(str(tenant))  # raises AdmissionError
         req = _Request(request_id=request_id, tenant=str(tenant),
                        session=str(session), syndromes=arr,
-                       future=Future(), t0=time.perf_counter(), trace=trace)
+                       future=Future(), t0=time.perf_counter(), trace=trace,
+                       idem=idem)
         with self._cv:
+            if idem is not None:
+                # the (re-)check under the same lock hold that enqueues:
+                # a concurrent duplicate landing between any earlier
+                # consult and here must still dedupe.  It runs BEFORE the
+                # draining/stopped refusal: a reconnect resubmit of a
+                # request that was accepted and decoded must replay (or
+                # attach) even mid-drain — refusing it would surface a
+                # logically-completed request as an error, and neither
+                # dedupe path enqueues anything
+                done = self._answered.get(idem)
+                if done is not None:
+                    self._answered.move_to_end(idem)
+                    fut = Future()
+                    fut.set_result(done)
+                    telemetry.count("serve.dedup.replayed")
+                    return fut
+                inflight = self._journal.get(idem)
+                if inflight is not None:
+                    telemetry.count("serve.dedup.attached")
+                    return self._attach(inflight.future)
             if self._stopped or self._draining:
                 raise RuntimeError("scheduler is draining/stopped")
+            if idem is not None:
+                self._journal[idem] = req
             self._pending.setdefault(req.session, _SessionQueue()).add(req)
             self._queued_requests += 1
             depth = self._queued_requests
@@ -364,53 +490,72 @@ class ContinuousBatcher:
                 [("serve_session_recompile", sess.invalidate)])
 
             def _decode():
-                faultinject.site("serve_dispatch")
+                faultinject.site("serve_dispatch", actions={
+                    # chaos enactments (ISSUE 14): a worker restart kills
+                    # every uploaded buffer then the dispatch dies
+                    # transiently; a session eviction drops the warm
+                    # compiled state mid-flight.  Both recoveries — the
+                    # in-dispatch recompile rung and the background heal —
+                    # must serve the requests anyway.
+                    "device_restart": self._chaos_device_restart,
+                    "session_evict": lambda f: self._chaos_session_evict(
+                        sess, f),
+                })
                 return sess.decode(synd)
 
             with telemetry.span("serve.dispatch"):
                 out = resilience.run_cell(_decode, label="serve_dispatch",
                                           degrade=ladder.step)
         except Exception as exc:  # noqa: BLE001 — answered, not dropped
-            self.failed += len(batch)
-            telemetry.count("serve.errors", len(batch))
-            err = f"{type(exc).__name__}: {exc}"
-            telemetry.event("serve_batch", session=session_name,
-                            requests=len(batch), shots=int(synd.shape[0]),
-                            bucket=0, ok=False, error=err)
-            for r in traced:
-                tracing.record_span(
-                    "device_decode", r.trace,
-                    dur_s=time.perf_counter() - t0, ok=False, error=err,
-                    amortized_over=len(batch))
-            # the black box: name EXACTLY the requests that died with this
-            # dispatch, then ship the ring as a postmortem (no-op unless a
-            # postmortem dir is configured)
-            tracing.note_failure(
-                "serve_dispatch_failed", session=session_name, error=err,
-                requests=len(batch), shots=int(synd.shape[0]),
-                request_ids=[r.request_id for r in batch],
-                tenants=sorted({r.tenant for r in batch}))
-            now = time.perf_counter()
-            for r in batch:
-                if self.slo is not None:
-                    self.slo.observe_request(r.tenant, now - r.t0, ok=False)
-                r.future.set_exception(exc)
+            self._dispatch_failed(session_name, batch, traced, synd, exc,
+                                  t0)
             return
         dispatch_s = time.perf_counter() - t0
         self._last_dispatch_t = time.monotonic()
         occupancy = out.shots / out.padded_shots if out.padded_shots else 0.0
         stage_s = out.timings or {}
         now = time.perf_counter()
+        results = []
         lo = 0
         for r in batch:
             hi = lo + r.shots
-            lat = now - r.t0
-            r.future.set_result(DecodeResult(
+            results.append(DecodeResult(
                 corrections=out.corrections[lo:hi],
                 converged=(None if out.converged is None
                            else out.converged[lo:hi]),
-                request_id=r.request_id, latency_s=lat))
+                request_id=r.request_id, latency_s=now - r.t0))
             lo = hi
+        # journal transitions BEFORE the futures resolve: a hedge landing
+        # between "answered" and "journal removed" must find the cached
+        # result, or it would re-decode work that already completed
+        with self._cv:
+            for r, res in zip(batch, results):
+                if r.idem is None:
+                    continue
+                self._journal.pop(r.idem, None)
+                # cache a COPY: res.corrections is a slice VIEW of the
+                # whole batch's array, and caching the view would pin the
+                # full (batch_shots, n) base buffer per entry while the
+                # byte accounting below counted only the slice — exactly
+                # the retention blowup the byte bound exists to prevent.
+                # An explicit .copy(): ascontiguousarray would hand the
+                # axis-0 slice (already contiguous) straight back, base
+                # and all.
+                cached = DecodeResult(
+                    corrections=res.corrections.copy(),
+                    converged=(None if res.converged is None
+                               else res.converged.copy()),
+                    request_id=res.request_id, latency_s=res.latency_s)
+                self._answered[r.idem] = cached
+                self._answered_bytes += self._result_nbytes(cached)
+            while self._answered and (
+                    len(self._answered) > self.answered_cache
+                    or self._answered_bytes > self.answered_cache_bytes):
+                _, old = self._answered.popitem(last=False)
+                self._answered_bytes -= self._result_nbytes(old)
+        for r, res in zip(batch, results):
+            lat = res.latency_s
+            r.future.set_result(res)
             self.completed += 1
             if self.slo is not None:
                 self.slo.observe_request(r.tenant, lat, ok=True)
@@ -443,6 +588,111 @@ class ContinuousBatcher:
                         dispatch_s=round(dispatch_s, 6), ok=True)
 
     # ------------------------------------------------------------------
+    # dispatch failure: bounded re-dispatch, then structured error
+    # ------------------------------------------------------------------
+    def _dispatch_failed(self, session_name: str, batch, traced, synd,
+                         exc: Exception, t0: float) -> None:
+        """One dispatch died after the in-dispatch retries.  Re-queue every
+        request with attempt budget left (transient faults only — the
+        session may have been healed/recompiled under it, so the next
+        flush rides the recovered program); answer the rest with the
+        structured error.  Either way the incident feeds the self-healing
+        probe and the postmortem names exactly what was in flight."""
+        err = f"{type(exc).__name__}: {exc}"
+        kind = resilience.classify_error(exc)
+        retry, dead = [], []
+        with self._cv:
+            stopped = self._stopped
+            for r in batch:
+                r.attempts += 1
+                if (kind != "deterministic" and not stopped
+                        and r.attempts < self.max_dispatch_attempts):
+                    retry.append(r)
+                else:
+                    dead.append(r)
+                    if r.idem is not None:
+                        # errors are not cached: a later duplicate retries
+                        # the decode fresh, which is what a client wants
+                        self._journal.pop(r.idem, None)
+            for r in retry:
+                self._pending.setdefault(r.session, _SessionQueue()).add(r)
+            self._queued_requests += len(retry)
+            if retry:
+                telemetry.set_gauge("serve.queue_depth",
+                                    self._queued_requests)
+                self._cv.notify()
+            self._incidents.append({
+                "session": session_name, "error": err, "kind": kind,
+                "ts": time.monotonic(), "requests": len(batch),
+                "requeued": len(retry)})
+        self.redispatched += len(retry)
+        self.failed += len(dead)
+        telemetry.count("serve.incidents")
+        if retry:
+            telemetry.count("serve.redispatches", len(retry))
+        if dead:
+            telemetry.count("serve.errors", len(dead))
+        telemetry.event("serve_batch", session=session_name,
+                        requests=len(batch), shots=int(synd.shape[0]),
+                        bucket=0, ok=False, error=err,
+                        requeued=len(retry))
+        for r in traced:
+            tracing.record_span(
+                "device_decode", r.trace,
+                dur_s=time.perf_counter() - t0, ok=False, error=err,
+                amortized_over=len(batch))
+        # the black box: name EXACTLY the requests that were in flight
+        # with this dispatch (re-queued ones included — they were hit),
+        # then ship the ring as a postmortem (no-op unless a postmortem
+        # dir is configured)
+        tracing.note_failure(
+            "serve_dispatch_failed", session=session_name, error=err,
+            requests=len(batch), shots=int(synd.shape[0]),
+            request_ids=[r.request_id for r in batch],
+            requeued_ids=[r.request_id for r in retry],
+            tenants=sorted({r.tenant for r in batch}))
+        now = time.perf_counter()
+        for r in dead:
+            if self.slo is not None:
+                self.slo.observe_request(r.tenant, now - r.t0, ok=False)
+            r.future.set_exception(exc)
+
+    # ------------------------------------------------------------------
+    # chaos enactments (utils.faultinject action kinds)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _chaos_device_restart(fault) -> None:
+        """``device_restart``: the worker restarts under the dispatch —
+        every uploaded buffer conceptually dies (``reset_device_state``
+        clears the memos and jit caches, bumping the device epoch the
+        health probe watches) and the dispatch itself fails transiently."""
+        from .. import reset_device_state
+
+        reset_device_state()
+        raise faultinject.InjectedFault(fault.message)
+
+    @staticmethod
+    def _chaos_session_evict(sess: "DecodeSession", fault) -> None:
+        """``session_evict``: the serving session's warm compiled state is
+        evicted mid-flight; the dispatch fails transiently and the retry
+        must serve through the rebuild."""
+        sess.invalidate()
+        raise faultinject.InjectedFault(fault.message)
+
+    # ------------------------------------------------------------------
+    # self-healing feed (serve.ops.HealthProbe)
+    # ------------------------------------------------------------------
+    def take_incidents(self) -> list:
+        """Drain the recorded dispatch-failure incidents (newest last).
+        Consumed by the health probe; each incident names the session and
+        the error classification so the probe heals exactly the state the
+        failure implicates."""
+        with self._cv:
+            out = list(self._incidents)
+            self._incidents.clear()
+        return out
+
+    # ------------------------------------------------------------------
     # health (the ops plane's /healthz body)
     # ------------------------------------------------------------------
     def health(self) -> dict:
@@ -454,12 +704,17 @@ class ContinuousBatcher:
             draining, stopped = self._draining, self._stopped
             completed, failed = self.completed, self.failed
             last_t = self._last_dispatch_t
+            journal = len(self._journal)
+            incidents = len(self._incidents)
         return {
             "queue_depth": int(depth),
             "sessions": len(self.sessions),
             "session_names": self.sessions.names(),
             "completed": int(completed),
             "failed": int(failed),
+            "redispatched": int(self.redispatched),
+            "journal_inflight": int(journal),
+            "incidents_pending": int(incidents),
             "draining": bool(draining),
             "stopped": bool(stopped),
             "last_dispatch_age_s": (
@@ -505,7 +760,10 @@ class ContinuousBatcher:
                        for dq in q.tenants.values() for r in dq]
             self._pending.clear()
             # the abandoned requests are ANSWERED below, not pending: a
-            # later snapshot / idempotent drain() must not report them
+            # later snapshot / idempotent drain() must not report them —
+            # and their journal entries go with them (the exception
+            # propagates to attached duplicates via the future mirror)
+            self._journal.clear()
             self._queued_requests = 0
             telemetry.set_gauge("serve.queue_depth", 0)
             self._cv.notify_all()

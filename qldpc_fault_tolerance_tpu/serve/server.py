@@ -47,13 +47,35 @@ import time
 
 import numpy as np
 
-from ..utils import telemetry, tracing
+from ..utils import faultinject, telemetry, tracing
 from .ops import AdmissionError, spawn_server_loop
 from .scheduler import ContinuousBatcher
-from .wire import HEADER, MAX_FRAME_BYTES, TRACE_FIELD, encode_frame
+from .wire import (
+    HEADER,
+    IDEM_FIELD,
+    MAX_FRAME_BYTES,
+    TRACE_FIELD,
+    encode_frame,
+)
 
 __all__ = ["DecodeServer", "ServerHandle", "start_server_thread",
            "MAX_FRAME_BYTES", "encode_frame"]
+
+
+# idempotency keys are wire-controlled strings that key the scheduler's
+# journal — bound them like trace ids; an oversize key is treated as
+# absent (counted), never an error that kills the request
+_MAX_IDEM_CHARS = 128
+
+
+def _wire_idem(msg) -> str | None:
+    idem = msg.get(IDEM_FIELD)
+    if not isinstance(idem, str) or not idem:
+        return None
+    if len(idem) > _MAX_IDEM_CHARS:
+        telemetry.count("serve.idem_oversize")
+        return None
+    return idem
 
 
 async def read_frame(reader: asyncio.StreamReader):
@@ -111,6 +133,17 @@ class DecodeServer:
                     break
                 if msg is None:
                     break
+                # network chaos (ISSUE 14): under a fault plan this frame
+                # may be answered with a torn frame and/or the connection
+                # hard-dropped — the client's reconnect + resubmit path
+                # (deduped by the scheduler journal) must recover
+                if await self._consume_conn_fault(
+                        lambda on: faultinject.site(
+                            "serve_conn_rx",
+                            actions={"conn_drop": on, "torn_frame": on,
+                                     "stall": on}),
+                        writer, wlock):
+                    break
                 if not isinstance(msg, dict):
                     # valid JSON but not an object: a structured reply,
                     # not a dead connection for everything pipelined on it
@@ -138,6 +171,51 @@ class DecodeServer:
             except Exception:
                 pass
 
+    async def _consume_conn_fault(self, consult, writer, wlock) -> bool:
+        """Consult one wire chaos site and enact the result: ``consult``
+        performs the literal ``faultinject.site`` call (the literal stays
+        at the call site — R008 pins one plant per site name) with a
+        shared on-hit callback for the kinds that site enacts.  A
+        stall-kind fault sleeps ASYNC so it stalls only this connection,
+        never the event loop; drop kinds (and any raise-kind fault at the
+        site) kill the connection.  Returns True when the connection is
+        dead and the caller must stop using it."""
+        hit = []
+        try:
+            consult(hit.append)
+        except Exception:  # noqa: BLE001 — raise kinds drop the conn too
+            hit.append(None)
+        if not hit:
+            return False
+        fault = hit[0]
+        if fault is not None and fault.kind == "stall":
+            await asyncio.sleep(fault.stall_s)
+            return False
+        await self._enact_conn_fault(writer, wlock, fault)
+        return True
+
+    @staticmethod
+    async def _enact_conn_fault(writer, wlock, fault) -> None:
+        """Enact one network chaos fault: ``torn_frame`` writes a length
+        header promising more bytes than follow (the torn wire a dying
+        peer leaves) and then drops; ``conn_drop`` (and any raise-kind
+        fault at the site, passed as None) hard-aborts the transport
+        without flushing.  After this the connection is dead and the
+        caller must stop serving it."""
+        if fault is not None and fault.kind == "torn_frame":
+            try:
+                async with wlock:
+                    # header claims a full frame; only a prefix follows
+                    writer.write(HEADER.pack(1 << 16) + b'{"torn":')
+                    await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+        telemetry.count("serve.chaos.conn_drops")
+        try:
+            writer.transport.abort()
+        except Exception:  # noqa: BLE001 — already dead is fine
+            pass
+
     async def _handle_decode(self, msg, writer, wlock) -> None:
         rid = msg.get("id")
         # trace propagation (ISSUE 11): the optional wire field becomes a
@@ -160,7 +238,8 @@ class DecodeServer:
                 np.asarray(msg["syndromes"], dtype=np.uint8),
                 tenant=str(msg.get("tenant", "default")),
                 request_id=None if rid is None else str(rid),
-                trace=req_ctx)
+                trace=req_ctx,
+                idem=_wire_idem(msg))
         except AdmissionError as exc:
             # the SLO gate: shed traffic is answered with a structured
             # flag so load generators can tell backpressure from bugs
@@ -218,6 +297,17 @@ class DecodeServer:
         if req_ctx is not None:
             payload["trace_id"] = req_ctx.trace_id
         t_write = time.perf_counter()
+        # response-path chaos: the connection dies with the answer already
+        # computed but unwritten — the client resubmits on its new
+        # connection and the scheduler's answered-LRU replays the result
+        # instead of decoding twice (the exactly-once window this site
+        # exists to pin)
+        if await self._consume_conn_fault(
+                lambda on: faultinject.site(
+                    "serve_respond",
+                    actions={"conn_drop": on, "stall": on}),
+                writer, wlock):
+            return
         try:
             await self._write(writer, wlock, payload)
         except (ConnectionError, RuntimeError):

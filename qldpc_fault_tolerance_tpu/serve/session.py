@@ -144,24 +144,35 @@ class DecodeSession:
         self._lock = threading.RLock()
         self._programs: dict[int, object] = {}
         self.compiles = 0
+        # bumped by every state swap (invalidate / heal): lets the health
+        # probe and tests tell "already healed" from "still serving the
+        # pre-incident programs"
+        self.generation = 0
+        self.heals = 0
         self._resolve_state()
 
+    def _resolved(self):
+        """One fresh ``(static, state, syndrome_width, kernel_variant,
+        osd_backend)`` resolution — the assignment-free half of
+        ``_resolve_state`` so ``heal()`` can build replacement state on a
+        probe thread while the current pair keeps serving."""
+        static, state = self._rebuild()
+        width = device_syndrome_width(static, state)
+        telemetry.count("serve.session.builds")
+        return (static, state, width, kernel_variant(static, state),
+                "device" if static[0] == "bposd_dev" else "none")
+
     def _resolve_state(self) -> None:
-        self.static, self.state = self._rebuild()
-        self.syndrome_width = device_syndrome_width(self.static, self.state)
         # which BP kernel the AOT programs will route to (the decode
         # program is compiled from the SAME (static, state) pair the
         # offline path uses, so the warm serving path picks up the v2
         # sparse-incidence routing automatically) — recorded so serving
-        # dashboards can name the kernel behind a session
-        self.kernel_variant = kernel_variant(self.static, self.state)
-        # whether the session's compiled program carries a device-resident
-        # OSD stage (ISSUE 13: BPOSD sessions serve paper-grade accuracy
-        # with zero warm-path retraces) — "host" can never appear, host-OSD
-        # configs are rejected at construction
-        self.osd_backend = ("device" if self.static[0] == "bposd_dev"
-                           else "none")
-        telemetry.count("serve.session.builds")
+        # dashboards can name the kernel behind a session.  osd_backend:
+        # whether the compiled program carries a device-resident OSD stage
+        # (ISSUE 13) — "host" can never appear, host-OSD configs are
+        # rejected at construction
+        (self.static, self.state, self.syndrome_width,
+         self.kernel_variant, self.osd_backend) = self._resolved()
 
     # ------------------------------------------------------------------
     # program cache
@@ -239,12 +250,58 @@ class DecodeSession:
         with self._lock:
             self._programs.clear()
             self._resolve_state()
+            self.generation += 1
             telemetry.count("serve.session.invalidations")
             telemetry.event("serve_session", session=self.name,
                             event="invalidate",
                             syndrome_width=self.syndrome_width,
                             kernel_variant=self.kernel_variant,
                             osd_backend=self.osd_backend)
+
+    def heal(self, reason: str = "probe") -> int:
+        """Self-healing warm recompile (ISSUE 14): rebuild the decoder
+        state and recompile every currently-warm shape bucket into a NEW
+        program map — all on the CALLING thread (the health probe's, never
+        the dispatcher's) while the old programs keep serving — then swap
+        state and programs atomically.  Returns the number of programs
+        recompiled.
+
+        This is the asymptomatic-recovery twin of ``invalidate()``: the
+        probe drives it after a watchdog-failed dispatch or a device-state
+        reset so the NEXT request hits a warm post-restart program instead
+        of paying the recompile (or failing) inline.  A bucket compiled
+        concurrently between the warm-set snapshot and the swap is simply
+        dropped by the swap and recompiles on its next request."""
+        import jax
+        import jax.numpy as jnp
+
+        t0 = time.perf_counter()
+        with self._lock:
+            warm = sorted(self._programs)
+        static, state, width, kvariant, osd = self._resolved()
+        programs = {
+            b: _decode_device_jit.lower(
+                static, state,
+                jax.ShapeDtypeStruct((int(b), width), jnp.uint8)).compile()
+            for b in warm}
+        dt = time.perf_counter() - t0
+        with self._lock:
+            self.static, self.state = static, state
+            self.syndrome_width = width
+            self.kernel_variant, self.osd_backend = kvariant, osd
+            self._programs = programs
+            self.compiles += len(programs)
+            self.generation += 1
+            self.heals += 1
+        telemetry.count("serve.session.heals")
+        telemetry.count("serve.session.compiles", len(programs))
+        telemetry.observe("serve.session.heal_s", dt)
+        telemetry.event("serve_session", session=self.name, event="heal",
+                        reason=str(reason), programs=len(programs),
+                        compile_s=round(dt, 4),
+                        syndrome_width=width, kernel_variant=kvariant,
+                        osd_backend=osd)
+        return len(programs)
 
     # ------------------------------------------------------------------
     # serving
@@ -273,14 +330,19 @@ class DecodeSession:
         for lo in range(0, arr.shape[0], top):
             chunk = arr[lo:lo + top]
             bucket = self.bucket_for(chunk.shape[0])
-            prog = self.program(bucket)
+            # program + state snapshotted under ONE lock hold: a
+            # concurrent heal() swaps both atomically, and a decode must
+            # not pair an old program with new state across the swap
+            with self._lock:
+                prog = self.program(bucket)
+                state = self.state
             t0 = time.perf_counter()
             pad = np.zeros((bucket, self.syndrome_width), np.uint8)
             pad[:chunk.shape[0]] = chunk
             t1 = time.perf_counter()
             pad_s += t1 - t0
             with telemetry.span("serve.decode"):
-                cor, aux = prog(self.state, jnp.asarray(pad))
+                cor, aux = prog(state, jnp.asarray(pad))
                 conv = aux.get("converged")
                 # fetch the FULL padded planes and slice on host: a traced
                 # device-side cor[:B] would retrace per distinct request

@@ -16,13 +16,24 @@ backward compatible in both directions; a malformed annotation is dropped
 server-side (``TraceContext.from_wire``), never an error — a bad trace
 must not fail the decode it rides on.  Traced responses echo the trace id
 back as ``"trace_id"`` so a client can join its result to the span tree.
+
+Idempotency (ISSUE 14): a decode request MAY carry an OPTIONAL ``"idem"``
+field (``IDEM_FIELD``) — a client-minted idempotency key that stays the
+SAME across reconnect resubmits and hedged duplicates of one logical
+request, while the wire ``"id"`` is fresh per transmission.  The server's
+``ContinuousBatcher`` journals accepted-but-unanswered keys and dedupes:
+a duplicate submit attaches to the in-flight decode (or replays the
+recently-answered result) instead of decoding twice — the exactly-once
+half of the no-drop/no-duplicate serving guarantee.  Old clients omit the
+field and old servers ignore it, so it is backward compatible both ways.
 """
 from __future__ import annotations
 
 import json
 import struct
 
-__all__ = ["HEADER", "MAX_FRAME_BYTES", "TRACE_FIELD", "encode_frame"]
+__all__ = ["HEADER", "IDEM_FIELD", "MAX_FRAME_BYTES", "TRACE_FIELD",
+           "encode_frame"]
 
 HEADER = struct.Struct(">I")
 MAX_FRAME_BYTES = 64 * 1024 * 1024  # a malformed length must not OOM us
@@ -30,6 +41,10 @@ MAX_FRAME_BYTES = 64 * 1024 * 1024  # a malformed length must not OOM us
 # the optional trace-context field of a decode request (and the echoed
 # trace id key of its response) — named here so neither end hard-codes it
 TRACE_FIELD = "trace"
+
+# the optional idempotency-key field of a decode request: constant across
+# resubmits of one logical request, the dedupe key of the server journal
+IDEM_FIELD = "idem"
 
 
 def encode_frame(obj) -> bytes:

@@ -562,14 +562,17 @@ def fused_cell_finish(carry):
     from ..utils import faultinject, profiling, resilience, telemetry
 
     def fetch():
-        faultinject.site("megabatch_drain")
+        # its own site name (not the megabatch driver's): qldpc-lint R008
+        # pins one literal site per failure point, so a chaos schedule can
+        # target the fused-bucket drain specifically
+        faultinject.site("fused_cells_drain")
         import jax
 
         return jax.device_get(carry)
 
     with telemetry.span("megabatch_drain"):
         t0 = time.perf_counter()
-        host = resilience.guarded_fetch(fetch, label="megabatch_drain")
+        host = resilience.guarded_fetch(fetch, label="fused_cells_drain")
         profiling.record_host_sync(time.perf_counter() - t0)
     failures, shots, min_w, tele = _fused_host(host)
     if tele is not None:
@@ -810,6 +813,26 @@ def record_wer_run(engine: str, failures, shots, wer, dispatches=None,
     return ci
 
 
+def _mesh_replay_runner(stats_fn, n_dev: int, has_tele: bool):
+    """The ``mesh_replan`` twin of ``parallel.sharded_batch_stats``: run
+    the SAME ``n_dev`` logical per-device key streams sequentially on the
+    surviving default device and fold them exactly as the psum/pmin would
+    (``parallel.replay_fold`` — the one shared implementation of that
+    exactness contract) — integer counts and min-weights bit-exact with
+    the uninterrupted mesh run, because the key streams are identical and
+    integer sums are order-free."""
+    import jax
+
+    from ..parallel import replay_fold
+
+    @jax.jit
+    def run(keys):
+        return replay_fold([stats_fn(keys[d]) for d in range(n_dev)],
+                           has_tele=has_tele)
+
+    return run
+
+
 def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
                      has_tele: bool = False):
     """Shot loop sharded over ``sim._mesh``: every mesh device runs
@@ -825,7 +848,16 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
     ``has_tele``: ``stats_fn`` additionally returns the device telemetry
     vector (utils.telemetry), which psum-reduces over the mesh, accumulates
     across batches, and publishes to the registry at the same sync.
-    """
+
+    Elastic mesh degrade (ISSUE 14): a device loss mid-run — a
+    ``MeshDeviceLoss`` (injected ``mesh_device_loss`` fault or real ICI
+    peer death) or any transient fault that survives the guarded fetch —
+    REPLANS instead of killing the cell: the ``mesh_replan`` ladder rung
+    fires (counted, event-emitted, visible on the sweep dashboard as a
+    ``ladder_degrade`` anomaly) and the run restarts on the surviving
+    default device, replaying the identical per-logical-device key
+    streams sequentially (``_mesh_replay_runner``) — counts exactly equal
+    to the uninterrupted run's.  Deterministic faults still fail fast."""
     import jax
     import jax.numpy as jnp
 
@@ -842,27 +874,73 @@ def mesh_batch_stats(sim, cache_key, stats_fn, num_samples: int, key,
 
     n_dev = mesh.devices.size
     batcher = ShotBatcher(num_samples, sim.batch_size * n_dev)
-    count, min_w, tele = None, None, None
     import time
 
     from ..utils import profiling
 
-    for i in batcher:
-        faultinject.site("mesh_dispatch")
-        keys = split_keys_for_mesh(jax.random.fold_in(key, i), mesh)
+    def stream(runner, inject):
+        count, min_w, tele = None, None, None
+        for i in batcher:
+            inject()
+            keys = split_keys_for_mesh(jax.random.fold_in(key, i), mesh)
+            t0 = time.perf_counter()
+            out = runner(keys)
+            profiling.record_dispatch(time.perf_counter() - t0)
+            telemetry.count("driver.dispatches")
+            count = out[0] if count is None else count + out[0]
+            min_w = out[1] if min_w is None else jnp.minimum(min_w, out[1])
+            if has_tele:
+                tele = out[2] if tele is None else tele + out[2]
+        # one host round-trip — watchdog-guarded (utils.resilience)
         t0 = time.perf_counter()
-        out = run(keys)
-        profiling.record_dispatch(time.perf_counter() - t0)
-        telemetry.count("driver.dispatches")
-        count = out[0] if count is None else count + out[0]
-        min_w = out[1] if min_w is None else jnp.minimum(min_w, out[1])
-        if has_tele:
-            tele = out[2] if tele is None else tele + out[2]
-    # one host round-trip — watchdog-guarded (utils.resilience)
-    t0 = time.perf_counter()
-    count, min_w, tele = resilience.guarded_fetch(
-        lambda: jax.device_get((count, min_w, tele)), label="mesh_drain")
-    profiling.record_host_sync(time.perf_counter() - t0)
+        host = resilience.guarded_fetch(
+            lambda: jax.device_get((count, min_w, tele)),
+            label="mesh_drain")
+        profiling.record_host_sync(time.perf_counter() - t0)
+        return host
+
+    def _replay_runner():
+        if ("mesh_replay", cache_key) not in runners:
+            runners[("mesh_replay", cache_key)] = \
+                _mesh_replay_runner(stats_fn, n_dev, has_tele)
+        return runners[("mesh_replay", cache_key)]
+
+    def _replay_inject():
+        # the ONE literal plant of this site (R008): both replay entries
+        # — the persisted fast path and the first post-degrade run —
+        # inject through here
+        faultinject.site("mesh_replay_dispatch")
+
+    if sim.__dict__.get("_mesh_lost"):
+        # a previous cell already lost a device: go straight to the
+        # replay path instead of burning a watchdog deadline per cell
+        # re-proving the mesh is still dead
+        count, min_w, tele = stream(_replay_runner(), _replay_inject)
+        if tele is not None:
+            telemetry.publish_device_tele(tele)
+        return int(count), batcher.total, int(min_w)
+    try:
+        count, min_w, tele = stream(
+            run, lambda: faultinject.site("mesh_dispatch"))
+    except Exception as exc:  # noqa: BLE001 — classification decides
+        if resilience.classify_error(exc) == "deterministic":
+            raise
+        # step the mesh_replan rung: the rung's apply_fn INSTALLS the
+        # replay runner and persists the loss on the simulator (telemetry
+        # + degrade event + sweep-monitor notification + postmortem hook
+        # come with the step, and the event stream can never claim a
+        # degrade that didn't happen), then replay the whole cell on the
+        # surviving device: restarting from batch 0 is what keeps the
+        # counts exactly equal — partial mesh accumulators may live on
+        # the lost device
+        def _install_replay():
+            telemetry.count("mesh.replans")
+            sim._mesh_lost = True
+            _replay_runner()
+
+        resilience.DegradationLadder(
+            [("mesh_replan", _install_replay)]).step()
+        count, min_w, tele = stream(_replay_runner(), _replay_inject)
     if tele is not None:
         telemetry.publish_device_tele(tele)
     return int(count), batcher.total, int(min_w)

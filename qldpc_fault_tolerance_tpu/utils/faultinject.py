@@ -5,8 +5,9 @@ runs in tests, and the real failure modes (tunneled-worker death, hung
 drains, kills mid-checkpoint-append) cannot be produced on demand.  This
 module plants named **sites** at the library's failure points —
 ``MegabatchDriver`` dispatch/drain, the engines' WER entries, the windowed
-OSD drain, ``SweepCheckpoint`` appends — and a seeded, deterministic
-**fault plan** decides which site hits raise, stall, or truncate.
+OSD drain, ``SweepCheckpoint`` appends, the serve stack's dispatch/wire
+paths — and a seeded, deterministic **fault plan** decides which site hits
+raise, stall, or truncate.
 
 Zero cost when inactive: ``site()`` is one module-global ``None`` check.
 
@@ -25,10 +26,36 @@ Fault kinds:
   * ``deterministic`` — raise ``InjectedDeterministicFault`` (a ValueError:
     simulates a program bug; retry must fail FAST);
   * ``stall``   — sleep ``stall_s`` at the site (simulates a hung worker;
-    drain watchdogs must fire);
+    drain watchdogs must fire).  At a serve dispatch site this IS the
+    ``stalled_dispatch`` chaos primitive — the stall plus the watchdog
+    deadline turn into a ``WatchdogTimeout`` the re-dispatch path recovers;
   * ``truncate``— only honored by ``SweepCheckpoint`` appends: write a
     partial line then raise (simulates a kill mid-append; the loader must
-    skip the torn line).
+    skip the torn line);
+  * serve/network/device chaos kinds (ISSUE 14) — enacted by the SITE
+    owner, which passes a handler per kind it can perform (``site(name,
+    actions={...})``); a chaos kind fired at a site with no handler for it
+    degrades to ``raise`` so a misplanned schedule still fails loudly:
+
+      - ``conn_drop``      the server hard-closes the TCP connection
+                           (client reconnect + resubmit must recover);
+      - ``torn_frame``     the server writes a torn frame (header + partial
+                           body) then drops the connection;
+      - ``session_evict``  the serving session is evicted from the cache
+                           mid-flight (the rebuild path must serve it);
+      - ``device_restart`` ``reset_device_state()`` runs (every uploaded
+                           buffer conceptually dies) and the dispatch
+                           fails transiently — the self-healing probe must
+                           recompile sessions without operator action;
+      - ``mesh_device_loss`` raise ``resilience.MeshDeviceLoss``
+                           (classified "resource": retrying the same mesh
+                           cannot help, replanning onto surviving devices
+                           can) — the elastic mesh-degrade primitive.
+
+All literal site names live in the ``SITES`` table below; qldpc-lint rule
+R008 pins that every ``faultinject.site("...")`` literal in the package is
+registered here and used at exactly ONE call site — a typo'd site name
+would otherwise silently never fire.
 
 Env activation for subprocesses / CI: ``QLDPC_FAULT_PLAN`` holds the plan as
 JSON (``[{"site": "megabatch_dispatch", "kind": "raise", "after": 1}]`` or
@@ -44,19 +71,44 @@ import os
 import threading
 
 from . import telemetry, tracing
-from .resilience import TransientFault, sleep_for
+from .resilience import MeshDeviceLoss, TransientFault, sleep_for
 
 __all__ = [
     "InjectedFault",
     "InjectedDeterministicFault",
     "Fault",
     "FaultPlan",
+    "SITES",
     "active_plan",
     "activate",
     "deactivate",
     "site",
     "truncate_fraction",
 ]
+
+
+# ---------------------------------------------------------------------------
+# The one site table (qldpc-lint R008 anchors on this literal dict):
+# every literal site name passed to ``site()`` / ``truncate_fraction()``
+# anywhere in the package must be a key here, and each name must appear at
+# exactly one call site — one name, one failure point, so a fault plan (or
+# a chaos schedule) can never silently target nothing.  Engine-level sites
+# ("wer.data", ...) are minted dynamically via ``resilient_engine_run`` and
+# are deliberately NOT listed: the rule only constrains literals.
+SITES = {
+    "megabatch_dispatch": "parallel/shots.py MegabatchDriver dispatch",
+    "megabatch_drain": "parallel/shots.py run_keys double-buffered drain",
+    "fused_cells_launch": "sim/common.py fused bucket async launch",
+    "fused_cells_drain": "sim/common.py fused bucket carry fetch",
+    "windowed_launch": "sim/common.py windowed (host-OSD) batch launch",
+    "windowed_drain": "sim/common.py windowed (host-OSD) batch drain",
+    "mesh_dispatch": "sim/common.py mesh_batch_stats sharded dispatch",
+    "mesh_replay_dispatch": "sim/common.py mesh-degrade replay dispatch",
+    "sweep_ckpt_put": "utils/checkpoint.py JSONL append",
+    "serve_dispatch": "serve/scheduler.py batch dispatch",
+    "serve_conn_rx": "serve/server.py per-received-frame (network chaos)",
+    "serve_respond": "serve/server.py before a response frame is written",
+}
 
 
 class InjectedFault(TransientFault):
@@ -71,7 +123,9 @@ class Fault:
     """One fault spec: fire at hits ``after < n <= after + count`` of
     ``site`` (``after=0, count=1`` = first hit only)."""
 
-    KINDS = ("raise", "deterministic", "stall", "truncate")
+    KINDS = ("raise", "deterministic", "stall", "truncate",
+             "conn_drop", "torn_frame", "session_evict", "device_restart",
+             "mesh_device_loss")
 
     def __init__(self, site: str, kind: str = "raise", after: int = 0,
                  count: int = 1, stall_s: float = 0.25,
@@ -181,11 +235,38 @@ def _record(fault: Fault, site_name: str) -> None:
                           fault_kind=fault.kind)
 
 
-def site(name: str) -> None:
+def _perform(fault: Fault, name: str, actions=None) -> None:
+    """Enact one matched fault.  ``actions`` maps chaos kinds the SITE can
+    perform to handlers (the handler enacts the chaos — dropping the
+    connection, evicting the session, resetting device state — and may
+    itself raise); chaos kinds without a handler here degrade to ``raise``
+    so a schedule aimed at the wrong site still fails loudly instead of
+    silently doing nothing.  ``actions`` wins over the built-in ``stall``
+    sleep: an ASYNC site (the serve front-end's event loop) must perform
+    the stall as an awaited sleep on one connection, never a blocking
+    ``sleep_for`` that freezes every connection on the loop thread."""
+    _record(fault, name)
+    if actions and fault.kind in actions:
+        actions[fault.kind](fault)
+        return
+    if fault.kind == "stall":
+        sleep_for(fault.stall_s)
+        return
+    if fault.kind == "deterministic":
+        raise InjectedDeterministicFault(fault.message)
+    if fault.kind == "mesh_device_loss":
+        raise MeshDeviceLoss(fault.message)
+    # "raise", and every unhandled chaos kind
+    raise InjectedFault(fault.message)
+
+
+def site(name: str, actions=None) -> None:
     """Named injection point.  One global ``None`` check when no plan is
     active; under a plan, counts the hit and performs the matching fault
     (``truncate`` specs are ignored here — they only make sense where the
-    caller owns the write, see ``truncate_fraction``)."""
+    caller owns the write, see ``truncate_fraction``).  ``actions`` lets
+    the site owner enact the chaos kinds it can perform (see
+    ``_perform``)."""
     if _ACTIVE is None:
         if _ENV_CHECKED:
             return
@@ -195,13 +276,10 @@ def site(name: str) -> None:
     fault = _ACTIVE._fire(name)
     if fault is None:
         return
-    _record(fault, name)
-    if fault.kind == "raise":
-        raise InjectedFault(fault.message)
-    if fault.kind == "deterministic":
-        raise InjectedDeterministicFault(fault.message)
-    if fault.kind == "stall":
-        sleep_for(fault.stall_s)
+    if fault.kind == "truncate":
+        _record(fault, name)  # counted, but only write owners can enact it
+        return
+    _perform(fault, name, actions)
 
 
 def truncate_fraction(name: str) -> float | None:
@@ -219,13 +297,8 @@ def truncate_fraction(name: str) -> float | None:
     fault = _ACTIVE._fire(name)
     if fault is None:
         return None
-    _record(fault, name)
     if fault.kind == "truncate":
+        _record(fault, name)
         return fault.truncate_at
-    if fault.kind == "raise":
-        raise InjectedFault(fault.message)
-    if fault.kind == "deterministic":
-        raise InjectedDeterministicFault(fault.message)
-    if fault.kind == "stall":
-        sleep_for(fault.stall_s)
+    _perform(fault, name)
     return None

@@ -51,6 +51,7 @@ from . import telemetry, tracing
 __all__ = [
     "TransientFault",
     "WatchdogTimeout",
+    "MeshDeviceLoss",
     "classify_error",
     "RetryPolicy",
     "DegradationLadder",
@@ -60,6 +61,8 @@ __all__ = [
     "run_cell",
     "fetch_with_watchdog",
     "sleep_for",
+    "device_epoch",
+    "note_device_reset",
 ]
 
 
@@ -70,6 +73,43 @@ class TransientFault(RuntimeError):
 
 class WatchdogTimeout(TimeoutError):
     """A watchdog-wrapped host fetch exceeded its deadline (hung worker)."""
+
+
+class MeshDeviceLoss(RuntimeError):
+    """A mesh-sharded dispatch lost one of its devices (ICI peer gone /
+    injected ``mesh_device_loss`` chaos fault).  Classified "resource":
+    retrying the SAME mesh program is a guaranteed loss — the device is
+    still gone — but stepping a degradation ladder that REPLANS the shot
+    split onto surviving devices (parallel/shots.py ``mesh_replan`` rung)
+    makes the very next attempt worthwhile, with no backoff burned."""
+
+
+# ---------------------------------------------------------------------------
+# Device-reset epoch (the self-healing probe's restart signal)
+# ---------------------------------------------------------------------------
+# Monotonic count of reset_device_state() calls this process has performed.
+# A reset conceptually kills every uploaded device buffer, so a serving
+# layer holding AOT programs compiled against pre-reset state must rebuild;
+# serve.ops.HealthProbe compares this epoch against the one it last healed
+# at and drives session recompiles in the background when it moves.
+_EPOCH_LOCK = threading.Lock()
+_DEVICE_EPOCH = 0
+
+
+def device_epoch() -> int:
+    """How many device-state resets this process has performed."""
+    with _EPOCH_LOCK:
+        return _DEVICE_EPOCH
+
+
+def note_device_reset() -> None:
+    """Called by ``qldpc_fault_tolerance_tpu.reset_device_state`` (the one
+    sanctioned reset entry point) so probes can detect restarts they did
+    not themselves cause."""
+    global _DEVICE_EPOCH
+    with _EPOCH_LOCK:
+        _DEVICE_EPOCH += 1
+    telemetry.count("resilience.device_resets")
 
 
 def sleep_for(seconds: float) -> None:
@@ -112,6 +152,9 @@ def classify_error(exc: BaseException) -> str:
     failure.  Watchdog timeouts, connection drops, and injected
     ``TransientFault``s are transient; everything else (ValueError,
     TypeError, AssertionError, ...) is a deterministic bug."""
+    if isinstance(exc, MeshDeviceLoss):
+        # the lost device stays lost: only a replan (ladder step) helps
+        return "resource"
     if isinstance(exc, (TransientFault, WatchdogTimeout)):
         return "transient"
     if isinstance(exc, (TimeoutError, ConnectionError, BrokenPipeError)):

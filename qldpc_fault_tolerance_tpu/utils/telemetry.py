@@ -563,10 +563,13 @@ EVENT_SCHEMAS: dict[str, dict] = {
         "required": {"session": str, "event": str},
         # osd_backend (ISSUE 13, additive): "device" for bposd_dev
         # programs, "none" otherwise — host-OSD configs are rejected at
-        # session construction, so "host" never appears here
+        # session construction, so "host" never appears here.
+        # reason/programs (ISSUE 14, additive): the self-healing
+        # event="heal" names why the probe fired and how many warm
+        # buckets were recompiled in the background
         "optional": {"bucket": int, "compile_s": _NUM,
                      "syndrome_width": int, "kernel_variant": str,
-                     "osd_backend": str},
+                     "osd_backend": str, "reason": str, "programs": int},
     },
     "serve_request": {
         "required": {"session": str, "tenant": str, "shots": int},
@@ -576,8 +579,12 @@ EVENT_SCHEMAS: dict[str, dict] = {
     "serve_batch": {
         "required": {"session": str, "requests": int, "shots": int,
                      "bucket": int},
+        # requeued (ISSUE 14, additive): how many of a failed batch's
+        # requests re-queued for exactly-once re-dispatch instead of
+        # being answered with the error
         "optional": {"occupancy": _NUM, "tenants": int, "wait_s": _NUM,
-                     "dispatch_s": _NUM, "ok": bool, "error": str},
+                     "dispatch_s": _NUM, "ok": bool, "error": str,
+                     "requeued": int},
     },
     "serve_drain": {
         "required": {"pending_requests": int, "completed": int},

@@ -159,7 +159,15 @@ class FlightRecorder:
              ) -> str:
         """Write the ring to ``<directory>/postmortem-<pid>-<seq>-<reason>
         .jsonl``: one header line naming the reason + process, then every
-        ring record oldest-first.  Returns the path."""
+        ring record oldest-first.  Returns the path.
+
+        The write is ATOMIC (tmp file + fsync + ``os.replace``) — the same
+        torn-line discipline utils/checkpoint.py applies to its appends: a
+        postmortem is dumped precisely because something is dying, so a
+        crash mid-dump is the expected case, and a half-written JSONL
+        would choke the reassembly tooling (``traces_from_records`` over a
+        parsed dump) that reads it afterwards.  The dump either appears
+        whole under its final name or not at all."""
         os.makedirs(directory, exist_ok=True)
         with self._dump_lock:
             seq = next(self._dump_seq)
@@ -175,10 +183,24 @@ class FlightRecorder:
         }
         if extra:
             header.update(extra)
-        with open(path, "w", encoding="utf-8") as fh:
-            fh.write(json.dumps(header, sort_keys=True, default=str) + "\n")
-            for rec in records:
-                fh.write(json.dumps(rec, sort_keys=True, default=str) + "\n")
+        tmp = f"{path}.tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as fh:
+                fh.write(json.dumps(header, sort_keys=True, default=str)
+                         + "\n")
+                for rec in records:
+                    fh.write(json.dumps(rec, sort_keys=True, default=str)
+                             + "\n")
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            # never leave the torn tmp behind to be globbed up later
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         return path
 
 

@@ -73,6 +73,15 @@ GATED_FIELDS = (
     # the checked-in history gates unchanged.
     "tracing_ab.traced_shots_per_s",
     "tracing_ab.traced_p99_ms",
+    # chaos-hardened serving (bench.py serve journal A/B + bench.py
+    # chaos, ISSUE 14): the JOURNALED arm's throughput is the robust
+    # regression signal for the idempotency journal's steady-state cost;
+    # chaos rounds gate their under-fault QPS (the recovery headline is
+    # the round's "value", unit "s" — gated lower-is-better by the
+    # standard wall-clock rule).  Rounds before r06 lack the keys, so
+    # the checked-in history gates unchanged.
+    "journal_ab.journaled_shots_per_s",
+    "chaos_qps",
     # device-resident BPOSD (bench.py bposd, ISSUE 13): the end-to-end
     # BPOSD rate and both arms of the device-vs-host OSD A/B gate as rate
     # fields; host round-trips gate on INCREASES (a reappearing host sync

@@ -24,6 +24,7 @@ from qldpc_fault_tolerance_tpu.analysis import (  # noqa: E402
     BarePrintRule,
     BareSleepRule,
     DonationRule,
+    FaultSiteRule,
     HostSyncRule,
     KernelContractRule,
     LockDisciplineRule,
@@ -579,6 +580,89 @@ def test_suppression_only_masks_listed_rules():
 
 
 # ---------------------------------------------------------------------------
+# R008 faultinject site discipline (ISSUE 14)
+# ---------------------------------------------------------------------------
+FAULT_MOD = PKG + "utils/faultinject.py"
+FAULT_SITES_SRC = """
+    SITES = {
+        "alpha_site": "module a's failure point",
+        "ckpt_site": "checkpoint append",
+    }
+"""
+
+
+def run_fault_rule(sources):
+    all_sources = {FAULT_MOD: FAULT_SITES_SRC}
+    all_sources.update(sources)
+    modules = [SourceModule.parse(r, textwrap.dedent(s))
+               for r, s in all_sources.items()]
+    ctx = AnalysisContext(modules)
+    return run_analysis(modules, [FaultSiteRule()], ctx=ctx)
+
+
+def test_r008_fires_on_unregistered_site_literal():
+    res = run_fault_rule({FIX: """
+        from ..utils import faultinject
+
+        def f():
+            faultinject.site("alfa_site")  # typo'd: never in SITES
+    """})
+    found = [f for f in res.findings if f.rule == "R008"]
+    # the typo'd literal + the now-unplanted registered names
+    assert any("not registered" in f.message and "alfa_site" in f.message
+               for f in found)
+
+
+def test_r008_fires_on_duplicate_site_across_modules():
+    res = run_fault_rule({
+        PKG + "sim/_fa.py": """
+            from ..utils import faultinject
+
+            def f():
+                faultinject.site("alpha_site")
+        """,
+        PKG + "sim/_fb.py": """
+            from ..utils import faultinject
+
+            def g():
+                faultinject.site("alpha_site")
+                faultinject.truncate_fraction("ckpt_site")
+        """,
+    })
+    found = [f for f in res.findings if f.rule == "R008"]
+    assert len(found) == 1
+    assert found[0].file == PKG + "sim/_fb.py"
+    assert "also planted at" in found[0].message
+    assert "sim/_fa.py" in found[0].message
+
+
+def test_r008_fires_on_stale_sites_table_entry():
+    res = run_fault_rule({FIX: """
+        from ..utils import faultinject
+
+        def f():
+            faultinject.site("alpha_site")
+    """})
+    found = [f for f in res.findings if f.rule == "R008"]
+    assert len(found) == 1
+    assert found[0].file == FAULT_MOD
+    assert "ckpt_site" in found[0].message and "plant" in found[0].message
+
+
+def test_r008_quiet_on_registered_unique_and_dynamic_sites():
+    res = run_fault_rule({FIX: """
+        from ..utils import faultinject
+
+        def f(site_name):
+            faultinject.site("alpha_site")
+            faultinject.truncate_fraction("ckpt_site")
+            faultinject.site(site_name)       # dynamic: out of scope
+            faultinject.site("wer." + "x")    # non-literal: out of scope
+    """})
+    assert [f for f in res.findings if f.rule == "R008"] == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline
 # ---------------------------------------------------------------------------
 def test_baseline_roundtrip(tmp_path):
@@ -633,7 +717,7 @@ def test_full_package_has_no_unbaselined_findings():
         + ", ".join(f"{e.file} [{e.rule}]" for e in res.stale_baseline)
     assert res.files > 100  # the walk really covered the codebase
     assert set(res.rules) == {"R001", "R002", "R003", "R004", "R005",
-                              "R006", "R007", "R101", "R102"}
+                              "R006", "R007", "R008", "R101", "R102"}
 
 
 def test_nonexistent_lint_target_is_an_error():

@@ -1,0 +1,989 @@
+"""Chaos-hardened serving (ISSUE 14): seeded fault schedules against a
+LIVE decode server, with the serving invariants asserted after every
+schedule — every accepted request answered exactly once, answered
+corrections bit-exact vs the offline ``decode_batch``, ``/healthz`` back
+to 200 with zero operator action, and postmortem/trace artifacts naming
+every affected request.  Plus the unit halves: self-healing sessions
+(background heal + HealthProbe), exactly-once re-dispatch (journal,
+dedupe, bounded re-queue), client reconnect/hedging (torn sockets,
+dropped connections), elastic mesh degrade (device loss mid-run replans
+onto the survivors, counts exactly equal), and the drain-vs-disconnect
+race the scheduler must win."""
+import glob
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class, BPDecoder
+from qldpc_fault_tolerance_tpu.parallel import shot_mesh
+from qldpc_fault_tolerance_tpu.serve import (
+    ContinuousBatcher,
+    DecodeClient,
+    DecodeSession,
+    HealthProbe,
+    start_ops_thread,
+    start_server_thread,
+)
+from qldpc_fault_tolerance_tpu.utils import (
+    faultinject,
+    resilience,
+    telemetry,
+    tracing,
+)
+
+pytestmark = pytest.mark.faults
+
+DEC_CLS = BP_Decoder_Class(4, "minimum_sum", 0.625)
+CODE3 = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+CODE4 = hgp(rep_code(4), rep_code(4), name="hgp_rep4")
+P = 0.05
+
+# fast, deterministic retry behavior for the dispatcher thread (the
+# scheduler consults the PROCESS default policy, not a thread-local
+# override — the dispatch runs on its own thread)
+FAST_POLICY = resilience.RetryPolicy(
+    max_attempts=2, base_delay=0.01, backoff=1.0, jitter=0.0,
+    reset_caches=False, degrade_after=1)
+TRIVIAL_POLICY = resilience.RetryPolicy(max_attempts=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    telemetry.disable()
+    telemetry.reset()
+    faultinject.deactivate()
+    prev_policy = resilience.current_policy()
+    tracing.recorder().clear()
+    yield
+    resilience.set_default_policy(prev_policy)
+    faultinject.deactivate()
+    tracing.configure(postmortem_dir="")
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _params(code):
+    return {"h": code.hx, "p_data": P}
+
+
+def _session(code, name=None, buckets=(8, 32)):
+    return DecodeSession(name or code.name, decoder_class=DEC_CLS,
+                         params=_params(code), buckets=buckets)
+
+
+def _synd(code, k, rng):
+    err = (rng.random((k, code.N)) < P).astype(np.uint8)
+    return (err @ np.asarray(code.hx, np.uint8).T % 2).astype(np.uint8)
+
+
+def _offline(code, synd):
+    return DEC_CLS.GetDecoder(_params(code)).decode_batch(synd)
+
+
+def _counter(name):
+    return telemetry.snapshot().get(name, {}).get("value", 0)
+
+
+# ---------------------------------------------------------------------------
+# Self-healing sessions
+# ---------------------------------------------------------------------------
+def test_session_heal_swaps_in_background_and_stays_bitexact():
+    """heal() rebuilds state + recompiles the warm bucket set off to the
+    side and swaps atomically: generation bumps, the warm decode path
+    stays retrace-free, and corrections are bit-exact across the swap."""
+    telemetry.enable()
+    sess = _session(CODE3)
+    sess.warm()
+    rng = np.random.default_rng(0)
+    synd = _synd(CODE3, 5, rng)
+    before_heal = sess.decode(synd)
+    gen0, compiles0 = sess.generation, sess.compiles
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        n = sess.heal(reason="test")
+    finally:
+        telemetry.remove_sink(sink)
+    assert n == len(sess.buckets)  # every warm bucket recompiled
+    assert sess.generation == gen0 + 1 and sess.heals == 1
+    assert sess.compiles == compiles0 + n
+    heals = [r for r in sink.records if r["kind"] == "serve_session"
+             and r.get("event") == "heal"]
+    assert len(heals) == 1 and heals[0]["reason"] == "test"
+    assert heals[0]["programs"] == n
+    assert telemetry.validate_event(heals[0]) == []
+    # post-heal serving: zero retraces, bit-exact with pre-heal output
+    retr0 = telemetry.compile_stats().get("jax.retraces", 0)
+    after_heal = sess.decode(synd)
+    assert telemetry.compile_stats().get("jax.retraces", 0) == retr0
+    assert np.array_equal(after_heal.corrections, before_heal.corrections)
+    assert np.array_equal(after_heal.corrections, _offline(CODE3, synd))
+
+
+def test_health_probe_heals_on_incident_and_on_device_reset():
+    """The probe converts dispatcher incidents and device-reset epoch
+    moves into background heals — no request has to fail to trigger
+    recovery, and no operator action is involved."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    sess = _session(CODE3)
+    sess.warm()
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=64,
+                            max_wait_s=0.002, max_dispatch_attempts=3)
+    probe = HealthProbe(bat, start=False)  # drive probe_once by hand
+    try:
+        rng = np.random.default_rng(1)
+        # a transient dispatch death: the request re-queues (answered
+        # fine), the incident lands in the feed
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_dispatch", kind="raise")])
+        with plan.active():
+            res = bat.submit("hgp_rep3", _synd(CODE3, 3, rng)).result(
+                timeout=60)
+        assert res.corrections.shape == (3, CODE3.N)
+        gen0 = sess.generation
+        healed = probe.probe_once()
+        assert healed == ["hgp_rep3"]
+        assert sess.generation > gen0 and sess.heals >= 1
+        # quiescent probe: nothing to do
+        assert probe.probe_once() == []
+        # a device reset anywhere in the process heals every session
+        from qldpc_fault_tolerance_tpu import reset_device_state
+
+        gen1 = sess.generation
+        reset_device_state()
+        assert probe.probe_once() == ["hgp_rep3"]
+        assert sess.generation > gen1
+        rep = probe.report()
+        assert rep["heals"] == probe.heals >= 2
+        # served output after both heals is still bit-exact
+        synd = _synd(CODE3, 4, rng)
+        out = bat.submit("hgp_rep3", synd).result(timeout=60)
+        assert np.array_equal(out.corrections, _offline(CODE3, synd))
+    finally:
+        probe.stop()
+        bat.drain()
+
+
+def test_health_probe_retries_a_failed_heal():
+    """A heal that fails (the device may still be flapping right after
+    the restart that triggered it) must NOT consume the signal: the
+    session stays owing and the next probe pass retries it."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    sess = _session(CODE3)
+    sess.warm()
+    bat = ContinuousBatcher({"hgp_rep3": sess}, max_batch_shots=64,
+                            max_wait_s=0.002)
+    probe = HealthProbe(bat, start=False)
+    real_heal = sess.heal
+    calls = []
+
+    def flaky_heal(reason="probe"):
+        calls.append(reason)
+        if len(calls) == 1:
+            raise RuntimeError("device still flapping")
+        return real_heal(reason=reason)
+
+    sess.heal = flaky_heal
+    try:
+        from qldpc_fault_tolerance_tpu import reset_device_state
+
+        reset_device_state()
+        assert probe.probe_once() == []  # heal attempt failed ...
+        assert probe.report()["pending_heals"] == 1  # ... still owing
+        assert probe.probe_once() == ["hgp_rep3"]  # retried, healed
+        assert probe.report()["pending_heals"] == 0
+        assert calls == ["device_reset", "device_reset"]
+    finally:
+        sess.heal = real_heal
+        probe.stop()
+        bat.drain()
+
+
+# ---------------------------------------------------------------------------
+# Exactly-once re-dispatch (scheduler level)
+# ---------------------------------------------------------------------------
+def test_failed_dispatch_requeues_and_answers_every_request():
+    """A dispatch that dies after its in-dispatch retries re-queues its
+    batch; the next flush answers every request with bit-exact
+    corrections — no request dropped, no error surfaced."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002,
+                            max_dispatch_attempts=4)
+    try:
+        rng = np.random.default_rng(2)
+        synds = [_synd(CODE3, 3, rng) for _ in range(4)]
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_dispatch", kind="raise",
+                               count=2)])
+        with plan.active():
+            futs = [bat.submit("hgp_rep3", s, idem=f"req-{i}")
+                    for i, s in enumerate(synds)]
+            outs = [f.result(timeout=60) for f in futs]
+        for s, o in zip(synds, outs):
+            assert np.array_equal(o.corrections, _offline(CODE3, s))
+        assert bat.failed == 0 and bat.completed == len(synds)
+        assert bat.redispatched > 0
+        assert _counter("serve.redispatches") > 0
+        assert _counter("serve.errors") == 0
+        # the journal drained with the answers
+        assert bat.health()["journal_inflight"] == 0
+    finally:
+        bat.drain()
+
+
+def test_redispatch_attempts_bounded_then_structured_error():
+    """A session that keeps dying exhausts the per-request attempt budget
+    and answers a structured error — answered, never dropped, never
+    retried forever."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002,
+                            max_dispatch_attempts=2)
+    try:
+        rng = np.random.default_rng(3)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_dispatch", kind="raise",
+                               count=99)])
+        with plan.active():
+            fut = bat.submit("hgp_rep3", _synd(CODE3, 2, rng),
+                             idem="doomed")
+            with pytest.raises(faultinject.InjectedFault):
+                fut.result(timeout=60)
+        assert bat.failed == 1 and bat.completed == 0
+        assert bat.redispatched == 1  # exactly max_dispatch_attempts - 1
+        assert bat.health()["journal_inflight"] == 0  # journal drained
+    finally:
+        bat.drain()
+
+
+def test_idem_dedupe_replays_answered_and_attaches_inflight():
+    """The journal dedupes both duplicate windows: a duplicate of an
+    ANSWERED request replays the cached result (no second decode), and a
+    duplicate of an IN-FLIGHT request attaches to the pending decode —
+    one decode, several answers, all identical."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.05)
+    try:
+        rng = np.random.default_rng(4)
+        synd = _synd(CODE3, 3, rng)
+        r1 = bat.submit("hgp_rep3", synd, idem="dup").result(timeout=60)
+        batches_after_first = _counter("serve.batches")
+        r2 = bat.submit("hgp_rep3", synd, idem="dup").result(timeout=60)
+        assert np.array_equal(r1.corrections, r2.corrections)
+        assert _counter("serve.batches") == batches_after_first
+        assert _counter("serve.dedup.replayed") == 1
+        # in-flight attach: stall the dispatch so the duplicate lands
+        # while the original is queued/decoding
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_dispatch", kind="stall",
+                               stall_s=0.3)])
+        with plan.active():
+            f1 = bat.submit("hgp_rep3", synd, idem="race")
+            f2 = bat.submit("hgp_rep3", synd, idem="race")
+            a, b = f1.result(timeout=60), f2.result(timeout=60)
+        assert np.array_equal(a.corrections, b.corrections)
+        assert np.array_equal(a.corrections, _offline(CODE3, synd))
+        assert _counter("serve.dedup.attached") == 1
+    finally:
+        bat.drain()
+
+
+def test_idem_dedupe_is_scoped_per_tenant():
+    """The idem string is wire-controlled: two TENANTS sending the same
+    key must each get their own decode — an unscoped journal would hand
+    tenant B tenant A's corrections (cross-tenant disclosure, and a
+    wrong-shaped answer for a different request)."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    try:
+        rng = np.random.default_rng(12)
+        sa, sb = _synd(CODE3, 2, rng), _synd(CODE3, 5, rng)
+        ra = bat.submit("hgp_rep3", sa, tenant="A",
+                        idem="shared-key").result(timeout=60)
+        rb = bat.submit("hgp_rep3", sb, tenant="B",
+                        idem="shared-key").result(timeout=60)
+        assert ra.corrections.shape == (2, CODE3.N)
+        assert rb.corrections.shape == (5, CODE3.N)  # NOT A's cached rows
+        assert np.array_equal(rb.corrections, _offline(CODE3, sb))
+        assert _counter("serve.dedup.replayed") == 0
+        # same tenant + session + key DOES replay
+        ra2 = bat.submit("hgp_rep3", sa, tenant="A",
+                         idem="shared-key").result(timeout=60)
+        assert np.array_equal(ra2.corrections, ra.corrections)
+        assert _counter("serve.dedup.replayed") == 1
+    finally:
+        bat.drain()
+    # a resubmit of an ANSWERED request replays even after drain: its
+    # decode completed, so refusing it would surface a logically-complete
+    # request as an error (the reconnect-during-shutdown window)
+    ra3 = bat.submit("hgp_rep3", sa, tenant="A",
+                     idem="shared-key").result(timeout=60)
+    assert np.array_equal(ra3.corrections, ra.corrections)
+    with pytest.raises(RuntimeError):  # NEW work is still refused
+        bat.submit("hgp_rep3", sa, tenant="A", idem="post-drain-new")
+
+
+# ---------------------------------------------------------------------------
+# Client transport resilience
+# ---------------------------------------------------------------------------
+def test_client_broken_pipe_is_per_request_transient_error():
+    """Satellite: a broken pipe mid-submit surfaces on THAT request's
+    future as a transient ConnectionError — the client object survives
+    and later submits fail the same controlled way (regression test with
+    a torn raw socket)."""
+    srv = socket.create_server(("127.0.0.1", 0))
+    host, port = srv.getsockname()
+
+    def tear():
+        conn, _ = srv.accept()
+        conn.close()  # torn immediately: client's socket dies
+
+    t = threading.Thread(target=tear, daemon=True)
+    t.start()
+    cli = DecodeClient(host, port, timeout=5.0)
+    t.join(timeout=5)
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            fut = cli.submit("s", np.zeros((1, 4), np.uint8))
+            try:
+                fut.result(timeout=5)
+            except ConnectionError:
+                break  # the per-request transient error
+            except RuntimeError as exc:  # pragma: no cover - impossible
+                pytest.fail(f"non-transient failure: {exc}")
+            # the first submit may still have been buffered before the
+            # RST arrived; keep going until the dead socket surfaces
+        else:
+            pytest.fail("dead socket never surfaced as ConnectionError")
+        assert resilience.classify_error(ConnectionError()) == "transient"
+        # the client is NOT poisoned: another submit returns a future
+        # (failed the same controlled way), no exception escapes
+        fut2 = cli.submit("s", np.zeros((1, 4), np.uint8))
+        with pytest.raises((ConnectionError, RuntimeError)):
+            fut2.result(timeout=5)
+        # ping after permanent transport death fails IMMEDIATELY too —
+        # with no reader alive a buffered send would otherwise block the
+        # caller for the full timeout with an orphaned pong future.
+        # (ConnectionError from the _dead gate, or the raw OSError if the
+        # ping races the reader's death notice — never a blocking wait)
+        t_ping = time.monotonic()
+        with pytest.raises(OSError):
+            cli.ping()
+        assert time.monotonic() - t_ping < 2.0
+    finally:
+        cli.close()
+        srv.close()
+
+
+def test_client_reconnects_and_resubmits_through_conn_drop():
+    """conn_drop chaos: the server hard-drops the connection on a frame;
+    the reconnect client redials, resubmits with the SAME idempotency
+    key, and every logical request is answered exactly once."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(5)
+        synds = [_synd(CODE3, 2, rng) for _ in range(6)]
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_conn_rx", kind="conn_drop",
+                               after=1)])
+        with plan.active():
+            with DecodeClient(host, port, reconnect=True,
+                              timeout=30.0) as cli:
+                futs = [cli.submit("hgp_rep3", s) for s in synds]
+                outs = [f.result(timeout=60) for f in futs]
+        for s, o in zip(synds, outs):
+            assert np.array_equal(o.corrections, _offline(CODE3, s))
+        assert _counter("serve.chaos.conn_drops") == 1
+        assert _counter("serve.client.reconnects") >= 1
+        assert bat.failed == 0
+    finally:
+        handle.stop(drain=True)
+
+
+def test_response_drop_replays_from_answered_cache_never_decodes_twice():
+    """conn_drop at serve_respond: the decode completed but its response
+    died on the wire.  The client's resubmit must be answered from the
+    journal's answered-LRU — exactly-once pinned via the dedupe counter
+    and the decoded-batch count."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(6)
+        synd = _synd(CODE3, 3, rng)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_respond", kind="conn_drop")])
+        with plan.active():
+            with DecodeClient(host, port, reconnect=True,
+                              timeout=30.0) as cli:
+                out = cli.submit("hgp_rep3", synd).result(timeout=60)
+        assert np.array_equal(out.corrections, _offline(CODE3, synd))
+        assert _counter("serve.dedup.replayed") >= 1
+        assert bat.completed == 1  # ONE decode answered the logical req
+    finally:
+        handle.stop(drain=True)
+
+
+def test_torn_frame_recovery():
+    """torn_frame chaos: the server answers with a length header promising
+    more bytes than follow, then drops.  The client treats the torn wire
+    as a dead connection, redials and resubmits — answered exactly once,
+    bit-exact."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(7)
+        synd = _synd(CODE3, 2, rng)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_conn_rx", kind="torn_frame")])
+        with plan.active():
+            with DecodeClient(host, port, reconnect=True,
+                              timeout=30.0) as cli:
+                out = cli.submit("hgp_rep3", synd).result(timeout=60)
+        assert np.array_equal(out.corrections, _offline(CODE3, synd))
+        assert _counter("serve.client.reconnects") >= 1
+    finally:
+        handle.stop(drain=True)
+
+
+def test_hedged_resubmit_attaches_server_side():
+    """A request unanswered past the hedge deadline is resubmitted with
+    the same idempotency key; the server attaches the duplicate to the
+    in-flight decode — tail latency bounded, work never duplicated."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(8)
+        synd = _synd(CODE3, 2, rng)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_dispatch", kind="stall",
+                               stall_s=0.5)])
+        with plan.active():
+            with DecodeClient(host, port, hedge_s=0.05,
+                              timeout=30.0) as cli:
+                out = cli.submit("hgp_rep3", synd).result(timeout=60)
+        assert np.array_equal(out.corrections, _offline(CODE3, synd))
+        assert _counter("serve.client.hedges") >= 1
+        assert (_counter("serve.dedup.attached")
+                + _counter("serve.dedup.replayed")) >= 1
+        assert bat.completed == 1
+    finally:
+        handle.stop(drain=True)
+
+
+def test_server_side_stall_is_async_not_loop_freezing():
+    """A stall-kind fault at a server wire site sleeps ASYNC on that one
+    connection: a second client's traffic keeps flowing while the first
+    connection's frame is stalled — the event loop never blocks."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(11)
+        synd = _synd(CODE3, 2, rng)
+        # the FIRST frame received server-side stalls 1.5s; frames on the
+        # other connection must be served meanwhile
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_conn_rx", kind="stall",
+                               stall_s=1.5)])
+        with plan.active():
+            with DecodeClient(host, port, timeout=30.0) as slow, \
+                    DecodeClient(host, port, timeout=30.0) as fast:
+                t0 = time.monotonic()
+                slow_fut = slow.submit("hgp_rep3", synd)
+                resilience.sleep_for(0.05)  # let the stall engage
+                fast_res = fast.decode("hgp_rep3", synd)
+                fast_dt = time.monotonic() - t0
+                slow_res = slow_fut.result(timeout=30)
+        assert fast_dt < 1.0, (
+            f"second connection waited {fast_dt:.2f}s — the stall froze "
+            "the event loop instead of one connection")
+        assert np.array_equal(fast_res.corrections,
+                              _offline(CODE3, synd))
+        assert np.array_equal(slow_res.corrections,
+                              _offline(CODE3, synd))
+    finally:
+        handle.stop(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Drain racing disconnects + dispatch failure (satellite)
+# ---------------------------------------------------------------------------
+def test_drain_races_client_disconnects_and_dispatch_failure():
+    """Satellite: drain() while clients vanish mid-flight AND the dispatch
+    is dying.  Drain must still resolve every accepted request (error or
+    result), never hang, and the server must come down clean."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=16, max_wait_s=0.2,
+                            max_dispatch_attempts=2)
+    handle = start_server_thread(bat)
+    host, port = handle.address
+    rng = np.random.default_rng(9)
+    clients = [DecodeClient(host, port, timeout=10.0) for _ in range(2)]
+    plan = faultinject.FaultPlan(
+        [faultinject.Fault(site="serve_dispatch", kind="raise", count=99)])
+    try:
+        with plan.active():
+            for cli in clients:
+                for _ in range(5):
+                    cli.submit("hgp_rep3", _synd(CODE3, 2, rng))
+            # rip the client sockets out mid-window while drain flushes
+            # the queue into a failing dispatch
+            killer = threading.Thread(
+                target=lambda: [c.close() for c in clients], daemon=True)
+            stopper = threading.Thread(
+                target=lambda: handle.stop(drain=True, timeout=30),
+                daemon=True)
+            stopper.start()
+            killer.start()
+            killer.join(timeout=30)
+            stopper.join(timeout=60)
+            assert not stopper.is_alive(), "drain hung"
+        # every accepted request was resolved one way or the other
+        assert bat.completed + bat.failed == 10
+        assert bat.health()["stopped"] is True
+        assert bat.health()["journal_inflight"] == 0
+    finally:
+        for cli in clients:
+            cli.close()
+
+
+# ---------------------------------------------------------------------------
+# Elastic mesh degrade
+# ---------------------------------------------------------------------------
+def _mesh_sim(mesh, batch_size=64, seed=7):
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    dec_x = BPDecoder(CODE3.hz, np.full(CODE3.N, P), max_iter=10)
+    dec_z = BPDecoder(CODE3.hx, np.full(CODE3.N, P), max_iter=10)
+    return CodeSimulator_DataError(
+        code=CODE3, decoder_x=dec_x, decoder_z=dec_z,
+        pauli_error_probs=[P / 3] * 3, batch_size=batch_size, mesh=mesh,
+        seed=seed)
+
+
+def test_mesh_device_loss_replans_with_exact_counts():
+    """ISSUE 14 acceptance (mesh half): a faultinjected device loss
+    mid-run completes on the surviving device by replaying the identical
+    per-logical-device key streams — counts EXACTLY equal to the
+    uninterrupted mesh run, with the mesh_replan degrade emitted for the
+    dashboard's ladder_degrade anomaly."""
+    key = jax.random.PRNGKey(11)
+    # 2048 shots / (64-shot batches x 8 devices) = 4 mesh dispatches, so
+    # after=1 kills the run MID-stream (the second dispatch)
+    clean = _mesh_sim(shot_mesh()).WordErrorRate(2048, key=key)
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        sim = _mesh_sim(shot_mesh())
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="mesh_dispatch",
+                               kind="mesh_device_loss", after=1)])
+        with plan.active():
+            degraded = sim.WordErrorRate(2048, key=key)
+    finally:
+        telemetry.remove_sink(sink)
+    assert degraded == clean  # exact, not just 3-sigma-consistent
+    degrades = [r for r in sink.records if r["kind"] == "degrade"]
+    assert [r["rung"] for r in degrades] == ["mesh_replan"]
+    assert telemetry.validate_event(degrades[0]) == []
+    assert _counter("mesh.replans") == 1
+    injected = [r for r in sink.records if r["kind"] == "fault_injected"]
+    assert injected and injected[0]["fault_kind"] == "mesh_device_loss"
+    # the loss PERSISTS on the simulator: later cells go straight to the
+    # replay path (no per-cell watchdog deadline re-proving the mesh is
+    # dead, no second degrade), and counts stay exact
+    assert sim.__dict__.get("_mesh_lost") is True
+    again = sim.WordErrorRate(2048, key=key)
+    assert again == clean
+    assert _counter("mesh.replans") == 1
+    assert _counter("resilience.degrades") == 1
+
+
+def test_mesh_device_loss_inside_sweep_emits_ladder_degrade_anomaly():
+    """The replan is visible where operators look: inside a sweep-run
+    scope the rung lands as a ladder_degrade anomaly naming the cell —
+    the record scripts/sweep_dashboard.py renders with the '!' mark."""
+    from qldpc_fault_tolerance_tpu.utils import diagnostics
+
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        with diagnostics.sweep_run({"test": "mesh_degrade"}) as run:
+            sim = _mesh_sim(shot_mesh())
+            plan = faultinject.FaultPlan(
+                [faultinject.Fault(site="mesh_dispatch",
+                                   kind="mesh_device_loss")])
+            with plan.active():
+                wer = sim.WordErrorRate(256, key=jax.random.PRNGKey(3))
+            run.note_cell({"code": "hgp_rep3", "noise": "data",
+                           "type": "single", "p": P}, wer[0], {})
+    finally:
+        telemetry.remove_sink(sink)
+    anomalies = [r for r in sink.records if r["kind"] == "anomaly"
+                 and r.get("anomaly") == "ladder_degrade"]
+    assert anomalies and "mesh_replan" in anomalies[0]["rungs"]
+    assert telemetry.validate_event(anomalies[0]) == []
+
+
+def test_cell_fused_mesh_degrade_exact_counts():
+    """CellFusedDriver mesh fold: a device loss steps the driver's
+    mesh_replan rung; the retry re-dispatches the intact carry on the
+    replay program and the per-cell counters come out exactly equal to
+    the uninterrupted mesh run's."""
+    import jax.numpy as jnp
+
+    from qldpc_fault_tolerance_tpu.parallel.shots import CellFusedDriver
+
+    batch = 128
+
+    def stats_fn(keys, lane_cell, active):
+        def one(k, cell):
+            u = jax.random.uniform(k, (batch,))
+            thresh = 0.02 * (1.0 + cell.astype(jnp.float32))
+            cnt = (u < thresh).sum().astype(jnp.int32)
+            return cnt, jnp.int32(3) + cell
+        return jax.vmap(one)(keys, lane_cell)
+
+    def run(plan_faults):
+        drv = CellFusedDriver(stats_fn, n_cells=3, batch_size=batch,
+                              k_inner=2, min_init=99,
+                              mesh=shot_mesh(jax.devices()[:2]))
+        key = jax.random.PRNGKey(5)
+        if plan_faults:
+            with faultinject.FaultPlan(plan_faults).active():
+                carry, n_run = drv.run_plan(key, 4)
+        else:
+            carry, n_run = drv.run_plan(key, 4)
+        return drv, jax.device_get(carry), n_run
+
+    _, clean, n_clean = run([])
+    telemetry.enable()
+    drv, degraded, n_deg = run(
+        [faultinject.Fault(site="megabatch_dispatch",
+                           kind="mesh_device_loss", after=1)])
+    assert n_deg == n_clean
+    assert drv.mesh_degraded is True
+    for a, b in zip(clean, degraded):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert _counter("mesh.replans") == 1
+    assert _counter("resilience.degrades") == 1
+
+
+# ---------------------------------------------------------------------------
+# Postmortems
+# ---------------------------------------------------------------------------
+def test_postmortem_atomic_and_names_affected_requests(tmp_path):
+    """Satellite + invariant: postmortem dumps are atomic (tmp+rename —
+    no torn JSONL, no stray .tmp) and name exactly the requests that were
+    in flight with the dead dispatch."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    pm = tmp_path / "pm"
+    tracing.configure(postmortem_dir=str(pm))
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002,
+                            max_dispatch_attempts=1)
+    try:
+        rng = np.random.default_rng(10)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_dispatch",
+                               kind="deterministic")])
+        with plan.active():
+            fut = bat.submit("hgp_rep3", _synd(CODE3, 2, rng),
+                             request_id="pm-req-1", idem="pm-1")
+            with pytest.raises(faultinject.InjectedDeterministicFault):
+                fut.result(timeout=60)
+        files = glob.glob(str(pm / "postmortem-*serve_dispatch_failed*"))
+        assert len(files) >= 1
+        assert not glob.glob(str(pm / "*.tmp"))  # atomic: no torn temp
+        with open(files[0], encoding="utf-8") as fh:
+            lines = [json.loads(ln) for ln in fh]  # every line parses
+        header = lines[0]
+        assert header["kind"] == "postmortem"
+        assert header["request_ids"] == ["pm-req-1"]
+        # the ring carried the injected fault AND the accepted request
+        kinds = {r.get("kind") for r in lines[1:]}
+        assert {"request", "fault_injected", "failure"} <= kinds
+    finally:
+        bat.drain()
+
+
+# ---------------------------------------------------------------------------
+# The live-server chaos schedules
+# ---------------------------------------------------------------------------
+def _storm(handle, codes, n_per_tenant, tenants=2, seed=0, hedge_s=None):
+    """Closed-loop request storm with reconnect clients; returns
+    [(code_name, syndromes, corrections)] across all tenants (raises on
+    any unanswered/failed request)."""
+    host, port = handle.address
+    names = sorted(codes)
+    results, errors = [], []
+
+    def worker(idx):
+        try:
+            rng = np.random.default_rng(1000 * seed + idx)
+            with DecodeClient(host, port, tenant=f"t{idx}", reconnect=True,
+                              hedge_s=hedge_s, timeout=60.0) as cli:
+                pending = []
+                for i in range(n_per_tenant):
+                    name = names[(i + idx) % len(names)]
+                    synd = _synd(codes[name], int(rng.integers(1, 8)), rng)
+                    pending.append((name, synd,
+                                    cli.submit(name, synd)))
+                for name, synd, fut in pending:
+                    res = fut.result(timeout=120)
+                    results.append((name, synd, res.corrections))
+        except Exception as exc:  # noqa: BLE001 — surfaced by the test
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+    assert not errors, errors
+    return results
+
+
+def _healthz_until_200(ops_handle, timeout=30.0) -> dict:
+    host, port = ops_handle.address
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            return json.loads(urllib.request.urlopen(
+                f"http://{host}:{port}/healthz", timeout=5).read())
+        except urllib.error.HTTPError as exc:
+            last = exc.code
+        except OSError:
+            pass
+        resilience.sleep_for(0.05)
+    pytest.fail(f"/healthz never returned 200 (last status {last})")
+
+
+def test_chaos_acceptance_combined_schedule(tmp_path):
+    """ISSUE 14 acceptance: a seeded schedule combining device_restart +
+    conn_drop + stalled_dispatch (+ session_evict for good measure)
+    against a LIVE server with the HealthProbe attached.  Invariants:
+    every accepted request answered exactly once with corrections
+    bit-exact vs offline decode_batch, /healthz back to 200 with zero
+    operator action, and the postmortem names every in-flight request of
+    the dead dispatch."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    tracing.configure(postmortem_dir=str(tmp_path / "pm"))
+    codes = {"hgp_rep3": CODE3, "hgp_rep4": CODE4}
+    sessions = {n: _session(c, name=n) for n, c in codes.items()}
+    for s in sessions.values():
+        s.warm()
+    bat = ContinuousBatcher(sessions, max_batch_shots=32,
+                            max_wait_s=0.002, max_dispatch_attempts=4)
+    probe = HealthProbe(bat, interval_s=0.05)
+    handle = start_server_thread(bat)
+    ops = start_ops_thread(batcher=bat, probe=probe)
+    try:
+        # `after`s chosen so every fault fires within the storm's minimum
+        # hit counts (>= 4 dispatches incl. retry re-hits, >= 24 frames
+        # received, >= 24 responses written)
+        plan = faultinject.FaultPlan([
+            # count=2 exhausts BOTH in-dispatch retry attempts, so the
+            # batch takes the re-queue path and the dispatch death ships
+            # a postmortem naming its in-flight requests
+            faultinject.Fault(site="serve_dispatch", kind="device_restart",
+                              after=1, count=2),
+            faultinject.Fault(site="serve_dispatch", kind="stall",
+                              after=3, stall_s=0.2),  # stalled_dispatch
+            faultinject.Fault(site="serve_dispatch", kind="session_evict",
+                              after=4),
+            faultinject.Fault(site="serve_conn_rx", kind="conn_drop",
+                              after=3),
+            faultinject.Fault(site="serve_respond", kind="conn_drop",
+                              after=6),
+        ], seed=14)
+        with plan.active():
+            results = _storm(handle, codes, n_per_tenant=12, tenants=2,
+                             seed=14)
+        # --- every accepted request answered exactly once, bit-exact ---
+        assert len(results) == 24
+        for name in codes:
+            rows = [(s, c) for n, s, c in results if n == name]
+            synd = np.concatenate([s for s, _ in rows])
+            served = np.concatenate([c for _, c in rows])
+            assert np.array_equal(served, _offline(codes[name], synd)), \
+                name
+        assert bat.failed == 0
+        snap = telemetry.snapshot()
+
+        def cnt(n):
+            return snap.get(n, {}).get("value", 0)
+
+        assert cnt("faultinject.injected") >= 5  # the schedule ran
+        # exactly-once: the server accepted each of the 24 logical
+        # requests once (a broken dedupe would re-accept a resubmit and
+        # push serve.requests past 24) and completed each exactly once
+        assert cnt("serve.requests") == 24
+        assert bat.completed == 24
+        assert bat.health()["journal_inflight"] == 0
+        # --- /healthz returns to 200 with zero operator action ---------
+        hz = _healthz_until_200(ops)
+        assert hz["ok"] is True
+        assert hz["probe"]["heals"] >= 1  # the self-healing loop fired
+        # --- artifacts name the affected requests ----------------------
+        pm_files = glob.glob(str(tmp_path / "pm" / "postmortem-*"))
+        assert pm_files  # the device_restart dispatch death shipped one
+        named = set()
+        for path in pm_files:
+            with open(path, encoding="utf-8") as fh:
+                header = json.loads(fh.readline())
+            named.update(header.get("request_ids") or [])
+        assert named  # specific in-flight requests are named
+    finally:
+        probe.stop()
+        ops.stop()
+        handle.stop(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Satellite: bench_compare gates the journal A/B + chaos rounds
+# ---------------------------------------------------------------------------
+def test_bench_compare_gates_journal_ab_and_chaos_rounds(tmp_path):
+    """The idempotency journal's steady-state cost and the chaos smoke's
+    recovery/throughput join the regression ledger: the journaled arm's
+    throughput regresses DOWN, the chaos round's recovery headline (unit
+    's') regresses UP, its under-fault QPS regresses DOWN."""
+    import importlib
+
+    scripts = os.path.join(REPO_ROOT, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    bench_compare = importlib.import_module("bench_compare")
+
+    def serve_round(n, journaled_sps):
+        obj = {"schema": 2, "round": n,
+               "result": {"metric": "decode-service sustained QPS",
+                          "value": 500.0, "unit": "req/s",
+                          "journal_ab": {
+                              "journaled_shots_per_s": journaled_sps,
+                              "overhead_pct": 1.0,
+                              "overhead_le_2pct": True}}}
+        p = tmp_path / f"BENCH_J_r{n:02d}.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    bad = [serve_round(1, 8000.0), serve_round(2, 4000.0)]
+    assert bench_compare.main(bad + ["--gate", "--tolerance", "10"]) == 1
+    ok = [serve_round(3, 8000.0), serve_round(4, 8100.0)]
+    assert bench_compare.main(ok + ["--gate", "--tolerance", "10"]) == 0
+
+    def chaos_round(n, recovery_s, qps):
+        obj = {"schema": 2, "round": n,
+               "result": {"metric": "chaos smoke recovery",
+                          "value": recovery_s, "unit": "s",
+                          "chaos_qps": qps}}
+        p = tmp_path / f"BENCH_C_r{n:02d}.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    slow = [chaos_round(1, 0.5, 20.0), chaos_round(2, 5.0, 20.0)]
+    assert bench_compare.main(slow + ["--gate", "--tolerance", "10"]) == 1
+    dropped = [chaos_round(3, 0.5, 20.0), chaos_round(4, 0.5, 5.0)]
+    assert bench_compare.main(dropped
+                              + ["--gate", "--tolerance", "10"]) == 1
+    fine = [chaos_round(5, 0.5, 20.0), chaos_round(6, 0.45, 21.0)]
+    assert bench_compare.main(fine + ["--gate", "--tolerance", "10"]) == 0
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_seeded_random_schedule_invariants(seed):
+    """Randomized chaos schedules drawn from a seeded menu (bounded so
+    recovery is always possible: per-site raise counts stay under the
+    re-dispatch budget).  Every schedule must preserve the serving
+    invariants — the same assertions, whatever the draw."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    rng = np.random.default_rng(seed)
+    menu = [
+        ("serve_dispatch", "raise"),
+        ("serve_dispatch", "stall"),
+        ("serve_dispatch", "device_restart"),
+        ("serve_dispatch", "session_evict"),
+        ("serve_conn_rx", "conn_drop"),
+        ("serve_conn_rx", "torn_frame"),
+        ("serve_respond", "conn_drop"),
+    ]
+    faults = []
+    for _ in range(int(rng.integers(2, 5))):
+        site, kind = menu[int(rng.integers(0, len(menu)))]
+        faults.append(faultinject.Fault(
+            site=site, kind=kind, after=int(rng.integers(0, 6)),
+            stall_s=0.1))
+    plan = faultinject.FaultPlan(faults, seed=seed)
+    codes = {"hgp_rep3": CODE3}
+    bat = ContinuousBatcher({"hgp_rep3": _session(CODE3)},
+                            max_batch_shots=32, max_wait_s=0.002,
+                            max_dispatch_attempts=6)
+    probe = HealthProbe(bat, interval_s=0.05)
+    handle = start_server_thread(bat)
+    try:
+        with plan.active():
+            results = _storm(handle, codes, n_per_tenant=10, tenants=2,
+                             seed=seed)
+        assert len(results) == 20
+        synd = np.concatenate([s for _, s, _ in results])
+        served = np.concatenate([c for _, _, c in results])
+        assert np.array_equal(served, _offline(CODE3, synd))
+        assert bat.failed == 0
+        assert bat.health()["journal_inflight"] == 0
+    finally:
+        probe.stop()
+        handle.stop(drain=True)

@@ -463,16 +463,19 @@ def test_malformed_trace_field_does_not_fail_decode():
             fut = cli.submit("hgp_rep3", _synd(CODE3, 2, rng))
             fut.result(timeout=60)
             # hand-roll a frame with a junk trace annotation
+            from qldpc_fault_tolerance_tpu.serve.client import _Inflight
+
+            with cli._plock:
+                import time as _time
+
+                req = _Inflight({}, _time.perf_counter())
+                req.rids.add("junk-trace")
+                cli._reqs["junk-trace"] = req
             cli._send({"op": "decode", "id": "junk-trace",
                        "session": "hgp_rep3",
                        "syndromes": _synd(CODE3, 2, rng).tolist(),
                        "trace": {"trace_id": 42}})
-            fut2 = Future()
-            with cli._plock:
-                import time as _time
-
-                cli._pending["junk-trace"] = (fut2, _time.perf_counter())
-            res = fut2.result(timeout=60)
+            res = req.future.result(timeout=60)
             assert res.corrections.shape[0] == 2
             assert res.trace_id is None  # dropped, not errored
     finally:
