@@ -163,6 +163,9 @@ class HostSyncRule(Rule):
         "qldpc_fault_tolerance_tpu/parallel/",
         "qldpc_fault_tolerance_tpu/sim/common.py",
         "qldpc_fault_tolerance_tpu/serve/session.py",
+        # the wire codec IS a host boundary: packing/unpacking bitplanes
+        # for the network necessarily materializes them on host (ISSUE 15)
+        "qldpc_fault_tolerance_tpu/serve/wire.py",
     )
 
     def __init__(self, allowed: tuple = DEFAULT_ALLOWED,

@@ -28,6 +28,10 @@ class KernelContract(NamedTuple):
     kernel: str     # Pallas-side entry (or one variant of a pair)
     twin: str       # XLA-side entry (or the other variant)
     shared: tuple   # body symbols BOTH must reach transitively
+    # extra per-role symbols ((kernel-only,), (twin-only,)) — for
+    # directional pairs like a wire codec, where each direction must
+    # reach ITS shared body (pack vs unpack) on top of the common layout
+    role_shared: tuple = ((), ())
 
 
 _OPS = "qldpc_fault_tolerance_tpu/ops/"
@@ -78,6 +82,17 @@ KERNEL_CONTRACTS = (
         "osd_elim_blocked", _OPS + "osd_device.py",
         "_elim_blocked_kernel", "_eliminate_blocked_twin",
         ("_blocked_stepA", "_blocked_phaseB_delta")),
+    # packed wire codec (ISSUE 15): the network layout IS the gf2_packed
+    # device layout — both directions must keep routing through the
+    # shared bodies (num_words pins the lane-word geometry for both;
+    # pack_shots / unpack_shots pin each direction's bit layout).  A
+    # drifted reimplementation would corrupt every served correction
+    # while small round-trip tests still pass.
+    KernelContract(
+        "wire_packed_codec",
+        "qldpc_fault_tolerance_tpu/serve/wire.py",
+        "pack_plane", "unpack_plane", ("num_words",),
+        role_shared=(("pack_shots",), ("unpack_shots",))),
 )
 
 
@@ -110,10 +125,12 @@ class KernelContractRule(Rule):
                         f"rename, or restore the function")
             if c.kernel not in mod.defs or c.twin not in mod.defs:
                 continue
-            for role, fn in (("kernel", c.kernel), ("twin", c.twin)):
+            for (role, fn), extra in zip(
+                    (("kernel", c.kernel), ("twin", c.twin)),
+                    c.role_shared):
                 reach = {name for _rel, name in
                          reachable_symbols(ctx, module.rel, fn)}
-                for sym in c.shared:
+                for sym in c.shared + tuple(extra):
                     if sym not in reach:
                         node = mod.defs[fn]
                         yield Finding(

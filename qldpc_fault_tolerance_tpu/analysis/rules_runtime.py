@@ -41,6 +41,7 @@ DEFAULT_FROZEN_FLOORS = {
     "_V2_EVENT_KINDS": 4,
     "_V3_EVENT_KINDS": 1,
     "_V4_EVENT_KINDS": 3,
+    "_V5_EVENT_KINDS": 1,
 }
 
 

@@ -11,26 +11,42 @@ request-driven decoder service:
                 per (H, shape-bucket), persistently cached — warm requests
                 perform zero retraces; ``heal()`` rebuilds + recompiles in
                 the background and swaps atomically (ISSUE 14).
+                FusedDecodeGroup (ISSUE 15): one cell-fused program per
+                bucket FAMILY (session = cell axis, traced lane_cell), so
+                co-bucketed sessions' rounds ride one dispatch; hot
+                sessions shard their decode across a mesh
+                (``DecodeSession(mesh=)`` + shard()/unshard()).
+  wire.py       the wire codec, defined once for both ends: JSON v1 and
+                the packed binary v2 (ISSUE 15 — bitplanes in the
+                gf2_packed device layout, hello negotiation, v1 clients
+                served forever, lint-pinned layout contract).
   scheduler.py  ContinuousBatcher: coalesces requests across tenants into
                 padded megabatches with deadline-aware flush and
-                round-robin fairness; graceful drain.  Exactly-once
+                round-robin fairness; graceful drain.  Cross-session
+                fused rounds (ISSUE 15): co-family pending sessions
+                flush into ONE fused dispatch, per-session fallbacks
+                counted and reported in health().  Exactly-once
                 re-dispatch (ISSUE 14): an idempotency journal dedupes
                 resubmits/hedges, failed dispatches re-queue their batch
                 (bounded attempts, then a structured error), and every
                 failure feeds the self-healing incident stream.
-  server.py     asyncio TCP front-end (length-prefixed JSON frames),
-                streamed per-request responses, drain-on-shutdown;
-                network chaos sites (conn_drop / torn_frame).
+  server.py     asyncio TCP front-end (length-prefixed frames, both
+                codecs), streamed per-request responses matched by id,
+                drain-on-shutdown; network chaos sites (conn_drop /
+                torn_frame); serve.bytes_rx/tx accounting.
   client.py     blocking pipelined client (the bench load generator) with
-                reconnect + resubmit and hedged-resubmit transport
-                recovery (ISSUE 14) — broken pipes are per-request
-                transient errors, never fatal to the client.
+                codec negotiation at connect, reconnect + resubmit and
+                hedged-resubmit transport recovery (ISSUE 14) — broken
+                pipes are per-request transient errors, never fatal to
+                the client.
   ops.py        live ops plane (ISSUE 11): SLO burn-rate engine feeding
                 shed/defer admission signals into the batcher, plus the
                 /metrics /healthz /varz /tracez HTTP sidecar; HealthProbe
                 (ISSUE 14) — the self-healing loop converting dispatch
                 incidents + device-reset epochs into background session
-                heals.
+                heals; AutoScaler (ISSUE 15) — the control loop ACTING on
+                the admission signals: batch-target resize + mesh
+                shard/retire with versioned scale_event telemetry.
 
 Per-request observability (ISSUE 11): trace contexts ride an optional
 wire-frame field end to end (utils.tracing) — queue_wait / batch_assemble
@@ -47,14 +63,18 @@ from .session import (
     DEFAULT_BUCKETS,
     DecodeOutput,
     DecodeSession,
+    FusedDecodeGroup,
     SessionCache,
+    bucket_family,
 )
 from .scheduler import ContinuousBatcher, DecodeResult, assemble_round_robin
 from .ops import (
     AdmissionError,
+    AutoScaler,
     HealthProbe,
     OpsHandle,
     OpsServer,
+    ScalePolicy,
     SLOEngine,
     SLOPolicy,
     start_ops_thread,
@@ -66,11 +86,15 @@ __all__ = [
     "DEFAULT_BUCKETS",
     "DecodeOutput",
     "DecodeSession",
+    "FusedDecodeGroup",
     "SessionCache",
+    "bucket_family",
     "ContinuousBatcher",
     "DecodeResult",
     "assemble_round_robin",
     "AdmissionError",
+    "AutoScaler",
+    "ScalePolicy",
     "HealthProbe",
     "OpsHandle",
     "OpsServer",

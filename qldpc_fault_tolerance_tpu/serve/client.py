@@ -39,6 +39,15 @@ Self-healing transport (ISSUE 14):
 Idempotency keys are minted automatically whenever ``reconnect`` or
 ``hedge_s`` is enabled (or explicitly via ``idempotent=True``); a plain
 client sends frames byte-identical to pre-ISSUE-14 builds.
+
+Wire codec (ISSUE 15): ``codec="auto"`` (the default) negotiates the
+packed binary codec via a ``hello`` at connect — syndromes ship as
+gf2_packed lane words instead of JSON int matrices, corrections and
+convergence come back the same way — and falls back to JSON against an
+old server.  ``codec=1`` forces JSON (no hello, frames byte-identical to
+pre-v2 builds); ``codec=2`` requires the packed codec.  Reconnects
+renegotiate on the fresh socket.  ``serve.client.bytes_rx/tx`` count
+framed bytes both ways.
 """
 from __future__ import annotations
 
@@ -55,8 +64,18 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..utils import resilience, telemetry, tracing
-from .wire import HEADER, IDEM_FIELD, MAX_FRAME_BYTES, TRACE_FIELD, \
-    encode_frame
+from .wire import (
+    HEADER,
+    IDEM_FIELD,
+    MAX_FRAME_BYTES,
+    TRACE_FIELD,
+    WIRE_CODEC_JSON,
+    WIRE_CODEC_PACKED,
+    WireCodecError,
+    decode_payload,
+    encode_frame,
+    encode_request_frame,
+)
 
 __all__ = ["ClientResult", "DecodeClient"]
 
@@ -97,11 +116,21 @@ class DecodeClient:
                  reconnect: bool = False, max_reconnects: int = 8,
                  reconnect_backoff_s: float = 0.05,
                  hedge_s: float | None = None, max_hedges: int = 1,
-                 idempotent: bool | None = None):
+                 idempotent: bool | None = None,
+                 codec: "int | str" = "auto"):
         self.host, self.port = host, int(port)
         self.tenant = str(tenant)
         self.traced = bool(traced)
         self.timeout = float(timeout)
+        # wire codec (ISSUE 15): "auto" negotiates the packed binary codec
+        # via the hello op at connect and falls back to JSON against an
+        # old server; 1 forces JSON (no hello — frames byte-identical to
+        # pre-v2 builds); 2 requires the packed codec (raises when the
+        # server can't speak it).  Renegotiated on every reconnect.
+        if codec not in ("auto", WIRE_CODEC_JSON, WIRE_CODEC_PACKED):
+            raise ValueError(f"codec must be 'auto', 1 or 2, got {codec!r}")
+        self._codec_req = codec
+        self.wire_codec = WIRE_CODEC_JSON
         self.reconnect = bool(reconnect)
         self.max_reconnects = max(1, int(max_reconnects))
         self.reconnect_backoff_s = float(reconnect_backoff_s)
@@ -115,6 +144,10 @@ class DecodeClient:
         self.reconnects = 0
         self._sock = socket.create_connection((host, int(port)),
                                               timeout=timeout)
+        # negotiate BEFORE the reader thread starts: the hello reply is
+        # read synchronously off the fresh socket, so the pump never has
+        # to disambiguate negotiation frames from responses
+        self.wire_codec = self._negotiate(self._sock)
         self._wlock = threading.Lock()
         self._plock = threading.Lock()
         # wire id -> logical request (several ids may map to one request)
@@ -148,9 +181,74 @@ class DecodeClient:
             self._hedger.start()
 
     # ------------------------------------------------------------------
+    # wire codec negotiation (ISSUE 15)
+    # ------------------------------------------------------------------
+    @staticmethod
+    def _read_exact_sync(sock, n: int) -> bytes:
+        """Exactly ``n`` bytes off a blocking socket (negotiation only —
+        the socket's timeout bounds the wait; EOF raises)."""
+        buf = b""
+        while len(buf) < n:
+            chunk = sock.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("connection closed during codec "
+                                      "negotiation")
+            buf += chunk
+        return buf
+
+    def _negotiate(self, sock) -> int:
+        """Hello handshake on a FRESH socket (constructor / reconnect,
+        before the reader pumps it).  Returns the codec to send with.
+        ``codec=1`` skips the handshake entirely; ``codec=2`` raises when
+        the server can't speak the packed codec; ``"auto"`` falls back to
+        JSON against an old server (which answers "unknown op")."""
+        if self._codec_req == WIRE_CODEC_JSON:
+            return WIRE_CODEC_JSON
+        negotiated = WIRE_CODEC_JSON
+        try:
+            hello = encode_frame(
+                {"op": "hello",
+                 "codecs": [WIRE_CODEC_PACKED, WIRE_CODEC_JSON]})
+            telemetry.count("serve.client.bytes_tx", len(hello))
+            sock.sendall(hello)
+            head = self._read_exact_sync(sock, HEADER.size)
+            (length,) = HEADER.unpack(head)
+            if length > MAX_FRAME_BYTES:
+                raise ConnectionError(f"oversize hello reply ({length}B)")
+            telemetry.count("serve.client.bytes_rx",
+                            length + HEADER.size)
+            msg = decode_payload(self._read_exact_sync(sock, length))
+            if isinstance(msg, dict) and msg.get("hello") \
+                    and int(msg.get("codec", WIRE_CODEC_JSON)) \
+                    == WIRE_CODEC_PACKED:
+                negotiated = WIRE_CODEC_PACKED
+        except (OSError, ValueError, KeyError, json.JSONDecodeError,
+                UnicodeDecodeError):
+            # old server (unknown-op reply), torn wire or a socket that
+            # died under the handshake: stay on JSON — a dead transport
+            # must keep surfacing per-REQUEST (or via reconnect), exactly
+            # as it did before v2, never as a constructor failure
+            negotiated = WIRE_CODEC_JSON
+        if self._codec_req == WIRE_CODEC_PACKED \
+                and negotiated != WIRE_CODEC_PACKED:
+            raise ValueError(
+                "server does not speak wire codec 2 (packed binary); "
+                "construct the client with codec='auto' or 1")
+        telemetry.count(f"serve.client.codec.v{negotiated}_conns")
+        telemetry.set_gauge("wire.codec_version", negotiated)
+        return negotiated
+
+    # ------------------------------------------------------------------
     def _send(self, obj) -> None:
-        frame = encode_frame(obj)
+        # encode under the SAME _wlock hold that sends: _reconnect swaps
+        # (socket, wire_codec) atomically under it, and a frame encoded
+        # with a stale codec must never land on a freshly renegotiated
+        # connection (a packed frame on a JSON-only server kills the
+        # whole pipelined connection)
         with self._wlock:
+            frame = (encode_request_frame(obj, self.wire_codec)
+                     if obj.get("op") == "decode" else encode_frame(obj))
+            telemetry.count("serve.client.bytes_tx", len(frame))
             self._sock.sendall(frame)
 
     def _recv_exact(self, sock, n: int) -> bytes | None:
@@ -184,9 +282,24 @@ class DecodeClient:
             body = self._recv_exact(sock, length)
             if body is None:
                 return
+            telemetry.count("serve.client.bytes_rx",
+                            len(body) + HEADER.size)
             try:
-                msg = json.loads(body.decode("utf-8"))
-            except json.JSONDecodeError:
+                msg = decode_payload(body)
+            except WireCodecError as exc:
+                # a malformed binary response fails ITS request (when the
+                # header named one) — the reader and the rest of the
+                # pipeline survive, like the malformed-JSON path below
+                telemetry.count("serve.client.wire_errors")
+                rid = exc.request_id
+                if rid is not None:
+                    with self._plock:
+                        req = self._reqs.get(rid)
+                    if req is not None:
+                        self._fail_request(req, RuntimeError(
+                            f"malformed decode response: {exc}"))
+                continue
+            except (json.JSONDecodeError, UnicodeDecodeError):
                 continue
             if not isinstance(msg, dict):
                 continue
@@ -305,6 +418,17 @@ class DecodeClient:
                     (self.host, self.port), timeout=self.timeout)
             except OSError:
                 continue
+            try:
+                # renegotiate the wire codec on the FRESH socket before
+                # the reader pumps it (the server may have been replaced
+                # by one speaking a different codec set)
+                codec = self._negotiate(sock)
+            except (OSError, ValueError):
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                continue
             # swap + pong drain under ONE _wlock hold (nested _plock,
             # same _wlock->_plock order ping uses): a ping sent on the
             # NEW connection can only run before the swap (old-socket
@@ -312,6 +436,7 @@ class DecodeClient:
             # pong, correctly kept) — never be spuriously failed
             with self._wlock:
                 old, self._sock = self._sock, sock
+                self.wire_codec = codec
                 with self._plock:
                     closed = self._closed
                     pongs, self._pongs = list(self._pongs), deque()
@@ -411,9 +536,13 @@ class DecodeClient:
         rid = f"{self._prefix}-{n}"
         if trace is None and self.traced:
             trace = tracing.TraceContext()
+        # syndromes stay an ndarray in the base message: the packed codec
+        # encodes them directly and the JSON path .tolist()s at encode
+        # time — resubmittable clients retain ~8 bytes/shot-bit less than
+        # the old pre-serialized int lists did
         base = {"op": "decode", "session": str(session),
                 "tenant": tenant or self.tenant,
-                "syndromes": arr.tolist()}
+                "syndromes": np.asarray(arr, np.uint8)}
         if self.idempotent:
             base[IDEM_FIELD] = f"{self._idem_prefix}-i{n}"
         if trace is not None:
@@ -485,7 +614,9 @@ class DecodeClient:
                     raise ConnectionError(
                         "decode-service connection closed")
                 self._pongs.append(fut)
-            self._sock.sendall(encode_frame({"op": "ping"}))
+            frame = encode_frame({"op": "ping"})
+            telemetry.count("serve.client.bytes_tx", len(frame))
+            self._sock.sendall(frame)
         return fut.result(timeout=self.timeout)
 
     def close(self) -> None:

@@ -52,6 +52,8 @@ from ..utils import resilience, telemetry, tracing
 
 __all__ = [
     "AdmissionError",
+    "AutoScaler",
+    "ScalePolicy",
     "SLOPolicy",
     "SLOEngine",
     "HealthProbe",
@@ -318,6 +320,232 @@ class SLOEngine:
 
 
 # ---------------------------------------------------------------------------
+# Admission-driven autoscaler (ISSUE 15)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class ScalePolicy:
+    """The autoscaler's control law, all knobs explicit.
+
+    Batch-target control: overload (queue depth at/above
+    ``grow_queue_depth``, or any tenant's SLO burn rate at/above
+    ``grow_burn_rate``) doubles the batcher's ``max_batch_shots`` toward
+    ``max_batch_shots`` and cuts ``max_wait_s`` to ``overload_wait_s`` —
+    under load the queue refills batches instantly, so waiting only adds
+    latency while bigger batches buy amortization.  Underload (depth
+    at/below ``shrink_queue_depth`` AND burn below the grow threshold)
+    walks both knobs back toward their construction-time base values.
+
+    Mesh-shard control: a session whose QUEUED SHOTS cross
+    ``shard_queued_shots`` is sharded across the batcher's mesh
+    (``DecodeSession.shard``); it retires (``unshard``) once its queue
+    falls to ``unshard_queued_shots``.  Hysteresis between the two
+    thresholds (and ``cooldown_s`` between any two actions) keeps the
+    scaler from flapping.
+    """
+
+    min_batch_shots: int = 64
+    max_batch_shots: int = 8192
+    grow_queue_depth: int = 64
+    shrink_queue_depth: int = 4
+    grow_burn_rate: float = 1.0
+    overload_wait_s: float = 0.0005
+    shard_queued_shots: int = 4096
+    unshard_queued_shots: int = 256
+    cooldown_s: float = 2.0
+    eval_interval_s: float = 0.5
+
+
+class AutoScaler:
+    """The loop that ACTS on the admission signals (ROADMAP item 1's
+    autoscaling half): consumes the batcher's queue stats and the SLO
+    engine's burn-rate report, resizes the batcher's continuous-batching
+    targets (``max_batch_shots`` / ``max_wait_s``) and triggers/retires
+    hot-session mesh sharding.  Every action emits a versioned
+    ``scale_event`` (+ ``serve.scale.events`` counter and
+    ``serve.autoscale.*`` gauges) and lands in the flight-recorder ring,
+    so scaling history is reconstructable from the JSONL stream alone.
+
+    ``now`` is injectable everywhere (monotonic seconds), so tests drive
+    a synthetic SLO burn deterministically; ``evaluate_once()`` is the
+    synchronous unit, the daemon loop is that on a timer."""
+
+    def __init__(self, batcher, slo: SLOEngine | None = None,
+                 policy: ScalePolicy | None = None,
+                 interval_s: float | None = None, start: bool = True):
+        self.batcher = batcher
+        self.slo = slo
+        self.policy = policy or ScalePolicy()
+        self.interval_s = (self.policy.eval_interval_s
+                          if interval_s is None else float(interval_s))
+        # construction-time targets are the underload resting point
+        self.base_batch_shots = int(batcher.max_batch_shots)
+        self.base_wait_s = float(batcher.max_wait_s)
+        self.actions = 0
+        self._last_action_t = float("-inf")
+        self._sharded: set[str] = set()
+        self._last_actions: list = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if start:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True,
+                name="qldpc-serve-autoscaler")
+            self._thread.start()
+
+    # ------------------------------------------------------------------
+    def _emit(self, now: float, action: str, **fields) -> dict:
+        rec = {"action": action, **fields}
+        self.actions += 1
+        self._last_action_t = now
+        telemetry.count("serve.scale.events")
+        telemetry.event("scale_event", **rec)
+        tracing.flight_record("scale_event", **rec)
+        return rec
+
+    def _burn_rate(self) -> float:
+        if self.slo is None:
+            return 0.0
+        report = self.slo.report()
+        return max((r.get("burn_rate", 0.0) for r in report.values()),
+                   default=0.0)
+
+    def evaluate_once(self, now: float | None = None) -> list:
+        """One control pass; returns the actions taken (empty in steady
+        state or inside the cooldown window)."""
+        now = time.monotonic() if now is None else float(now)
+        pol = self.policy
+        stats = self.batcher.queue_stats()
+        depth = stats["queued_requests"]
+        queued_shots = stats["queued_shots"]
+        burn = self._burn_rate()
+        telemetry.set_gauge("serve.autoscale.max_batch_shots",
+                            self.batcher.max_batch_shots)
+        if now - self._last_action_t < pol.cooldown_s:
+            return []
+        actions = []
+        overloaded = depth >= pol.grow_queue_depth \
+            or burn >= pol.grow_burn_rate
+        cur = int(self.batcher.max_batch_shots)
+        cur_wait = float(self.batcher.max_wait_s)
+        if overloaded:
+            # never SHRINK on the grow path: an operator-configured base
+            # above the policy cap must not be halved by a "grow" (the
+            # restore path could never recover it past the cap either)
+            target = max(cur, min(pol.max_batch_shots,
+                                  max(cur * 2, pol.min_batch_shots)))
+            if target != cur:
+                self.batcher.max_batch_shots = target
+                actions.append(self._emit(
+                    now, "grow_batch", target="max_batch_shots",
+                    from_value=cur, to_value=target, queue_depth=depth,
+                    burn_rate=round(burn, 4),
+                    reason=("queue_depth" if depth >= pol.grow_queue_depth
+                            else "slo_burn")))
+            if cur_wait > pol.overload_wait_s:
+                self.batcher.max_wait_s = pol.overload_wait_s
+                actions.append(self._emit(
+                    now, "cut_wait", target="max_wait_s",
+                    from_value=cur_wait, to_value=pol.overload_wait_s,
+                    queue_depth=depth, burn_rate=round(burn, 4),
+                    reason="overload"))
+        elif depth <= pol.shrink_queue_depth:
+            target = max(self.base_batch_shots,
+                         max(pol.min_batch_shots, cur // 2))
+            if target < cur:
+                self.batcher.max_batch_shots = target
+                actions.append(self._emit(
+                    now, "shrink_batch", target="max_batch_shots",
+                    from_value=cur, to_value=target, queue_depth=depth,
+                    burn_rate=round(burn, 4), reason="underload"))
+            if cur_wait != self.base_wait_s:
+                self.batcher.max_wait_s = self.base_wait_s
+                actions.append(self._emit(
+                    now, "restore_wait", target="max_wait_s",
+                    from_value=cur_wait, to_value=self.base_wait_s,
+                    queue_depth=depth, burn_rate=round(burn, 4),
+                    reason="underload"))
+        actions.extend(self._scale_sharding(now, depth, queued_shots))
+        if actions:
+            self._last_actions = actions
+        telemetry.set_gauge("serve.autoscale.sharded_sessions",
+                            len(self._sharded))
+        return actions
+
+    def _scale_sharding(self, now: float, depth: int,
+                        queued_shots: dict) -> list:
+        """Trigger/retire hot-session mesh sharding on per-session queue
+        pressure.  ``shard()``/``unshard()`` are no-ops (False) for
+        sessions without a mesh — nothing is emitted for those.  The
+        SESSION's ``sharded`` flag is the source of truth: the
+        scheduler's degrade rung may have unsharded a session under us
+        (mesh fault), and the local set must resync rather than block a
+        hot session's re-shard forever."""
+        pol = self.policy
+        actions = []
+        for name, shots in queued_shots.items():
+            if shots < pol.shard_queued_shots:
+                continue
+            try:
+                sess = self.batcher.sessions.get(name)
+            except KeyError:
+                continue
+            if sess.sharded:
+                self._sharded.add(name)  # resync (e.g. manual shard)
+                continue
+            if sess.shard(reason="autoscale"):
+                self._sharded.add(name)
+                actions.append(self._emit(
+                    now, "shard", session=name, queue_depth=depth,
+                    queued_shots=int(shots), reason="hot_session"))
+        for name in sorted(self._sharded):
+            try:
+                sess = self.batcher.sessions.get(name)
+            except KeyError:
+                self._sharded.discard(name)
+                continue
+            if not sess.sharded:
+                # the degrade rung (or an operator) already unsharded it
+                self._sharded.discard(name)
+                continue
+            shots = int(queued_shots.get(name, 0))
+            if shots > pol.unshard_queued_shots:
+                continue
+            if sess.unshard(reason="autoscale"):
+                actions.append(self._emit(
+                    now, "unshard", session=name, queue_depth=depth,
+                    queued_shots=shots, reason="cooled"))
+            self._sharded.discard(name)
+        return actions
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.evaluate_once()
+            except Exception:  # noqa: BLE001 — the loop never dies
+                telemetry.count("serve.autoscale.errors")
+
+    def report(self) -> dict:
+        """The /varz + /healthz block: current vs base targets, sharded
+        sessions, lifetime action count and the last action batch."""
+        return {
+            "max_batch_shots": int(self.batcher.max_batch_shots),
+            "max_wait_s": float(self.batcher.max_wait_s),
+            "base_batch_shots": self.base_batch_shots,
+            "base_wait_s": self.base_wait_s,
+            "sharded_sessions": sorted(self._sharded),
+            "actions": int(self.actions),
+            "last_actions": list(self._last_actions),
+            "running": bool(self._thread is not None
+                            and self._thread.is_alive()),
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+
+
+# ---------------------------------------------------------------------------
 # Self-healing sessions (ISSUE 14)
 # ---------------------------------------------------------------------------
 class HealthProbe:
@@ -462,13 +690,15 @@ class OpsServer:
     def __init__(self, batcher=None, slo: SLOEngine | None = None,
                  host: str = "127.0.0.1", port: int = 0,
                  flight: "tracing.FlightRecorder | None" = None,
-                 probe: "HealthProbe | None" = None):
+                 probe: "HealthProbe | None" = None,
+                 scaler: "AutoScaler | None" = None):
         self.batcher = batcher
         self.slo = slo
         self.host = host
         self.port = int(port)
         self.flight = flight
         self.probe = probe
+        self.scaler = scaler
         self._server: asyncio.AbstractServer | None = None
         self.t_started = time.monotonic()
 
@@ -554,12 +784,17 @@ class OpsServer:
             body["slo"] = self.slo.report()
         if self.probe is not None:
             body["probe"] = self.probe.report()
+        if self.scaler is not None:
+            body["autoscale"] = self.scaler.report()
         return body
 
     def varz(self) -> dict:
-        return {"metrics": telemetry.snapshot(),
+        body = {"metrics": telemetry.snapshot(),
                 "compile": telemetry.compile_stats(),
                 "process": telemetry.process_info()}
+        if self.scaler is not None:
+            body["autoscale"] = self.scaler.report()
+        return body
 
     def tracez(self, query: dict | None = None) -> dict:
         query = query or {}
@@ -648,10 +883,11 @@ def spawn_server_loop(start, thread_name: str, what: str):
 
 def start_ops_thread(batcher=None, slo: SLOEngine | None = None,
                      host: str = "127.0.0.1", port: int = 0,
-                     probe: "HealthProbe | None" = None) -> OpsHandle:
+                     probe: "HealthProbe | None" = None,
+                     scaler: "AutoScaler | None" = None) -> OpsHandle:
     """Start the ops plane on a daemon thread; returns once it accepts."""
     server = OpsServer(batcher=batcher, slo=slo, host=host, port=port,
-                       probe=probe)
+                       probe=probe, scaler=scaler)
     loop, thread = spawn_server_loop(server.start, "qldpc-serve-ops",
                                      "ops server")
     return OpsHandle(server, loop, thread)

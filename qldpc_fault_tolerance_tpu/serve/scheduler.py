@@ -14,6 +14,17 @@ Fairness is round-robin across tenants at assembly time
 others — every flush takes at most its rotating share, and the other
 tenants' requests ride the same batch.
 
+Cross-session fused dispatch (ISSUE 15): when the flushed session shares
+a bucket FAMILY with other pending sessions (equal program shape —
+another code of the same dimensions, another p's priors), their rounds
+ride ONE cell-fused device program (``session.FusedDecodeGroup``,
+session = cell axis, lane membership traced) and per-session corrections
+are sliced on host — many tenants, many codes, one dispatch.  Rounds
+that don't co-bucket (oversize part, unstackable family) fall back to
+the per-session path, COUNTED (``serve.fused.fallbacks`` + per-family
+eligibility in ``health()``) so a shape drift that silently stops
+co-bucketing is operator-visible instead of a quiet throughput loss.
+
 Every dispatch runs under the active resilience policy
 (utils.resilience.run_cell) with a one-rung degradation ladder that
 invalidates + rebuilds the session's compiled programs — the recovery that
@@ -65,7 +76,13 @@ from concurrent.futures import Future
 import numpy as np
 
 from ..utils import faultinject, resilience, telemetry, tracing
-from .session import OCCUPANCY_BUCKETS, DecodeSession, SessionCache
+from .session import (
+    OCCUPANCY_BUCKETS,
+    DecodeSession,
+    FusedDecodeGroup,
+    SessionCache,
+    family_digest,
+)
 
 __all__ = ["DecodeResult", "ContinuousBatcher", "assemble_round_robin"]
 
@@ -217,7 +234,7 @@ class ContinuousBatcher:
     def __init__(self, sessions, *, max_batch_shots: int = 1024,
                  max_wait_s: float = 0.002, slo=None,
                  max_dispatch_attempts: int = 3,
-                 answered_cache: int = 4096):
+                 answered_cache: int = 4096, fused: bool = True):
         if isinstance(sessions, dict):
             cache = SessionCache(max_sessions=max(8, len(sessions)))
             for s in sessions.values():
@@ -227,6 +244,26 @@ class ContinuousBatcher:
         self.slo = slo
         self.max_batch_shots = max(1, int(max_batch_shots))
         self.max_wait_s = float(max_wait_s)
+        # cross-session fused dispatch (ISSUE 15): when the flushed
+        # session shares a bucket family with other pending sessions,
+        # their rounds ride ONE cell-fused device program (session = cell
+        # axis).  Ineligible rounds (oversize part, unstackable state)
+        # fall back per-session — counted, never silent.
+        self.fused = bool(fused)
+        self.fused_dispatches = 0
+        self.fused_fallbacks = 0
+        # family -> (member-object tuple, FusedDecodeGroup | None): the
+        # group restacks itself on member heals; a member-set change
+        # (eviction, new co-family session) builds a fresh group.  None
+        # caches a family whose states don't stack (fallback, once).
+        # Bounded LRU: a group pins its members' states + compiled
+        # executables, and a long-lived host rotating through many code
+        # families must not accumulate retired groups forever.
+        self._group_cache: "OrderedDict" = OrderedDict()
+        self.max_fused_groups = 8
+        # per-family health block (touched by the dispatcher thread,
+        # snapshotted by health() — guarded by _cv like the queues)
+        self._fused_stats: dict = {}
         # exactly-once re-dispatch budget: how many failed dispatches one
         # request may ride before its future gets the structured error
         self.max_dispatch_attempts = max(1, int(max_dispatch_attempts))
@@ -407,10 +444,13 @@ class ContinuousBatcher:
     # worker
     # ------------------------------------------------------------------
     def _pick_locked(self, now: float, force: bool):
-        """Choose (session name, flush batch) under the lock, or None.
+        """Choose (primary session name, rounds) under the lock, or None.
         Flushable: batch-fill reached, deadline passed, or ``force``
         (drain).  Among flushable sessions the oldest queued request wins
-        (FIFO across sessions)."""
+        (FIFO across sessions).  ``rounds`` is ``[(session, batch)]``:
+        with fused dispatch enabled, pending sessions sharing the
+        primary's bucket family ride the SAME dispatch (their deadlines
+        haven't expired — riding early only helps them)."""
         best, best_t = None, None
         for name, q in self._pending.items():
             if q.empty():
@@ -422,14 +462,37 @@ class ContinuousBatcher:
                 best, best_t = name, q.oldest_t
         if best is None:
             return None
-        q = self._pending[best]
         deferred = (self.slo.deferred_tenants()
                     if self.slo is not None else frozenset())
-        batch = assemble_round_robin(q, self.max_batch_shots, force=force,
-                                     deferred=deferred)
-        if q.empty():
-            self._pending.pop(best, None)
-        return best, batch
+
+        def flush(name):
+            q = self._pending[name]
+            batch = assemble_round_robin(q, self.max_batch_shots,
+                                         force=force, deferred=deferred)
+            if q.empty():
+                self._pending.pop(name, None)
+            return batch
+
+        rounds = [(best, flush(best))]
+        if self.fused:
+            fam = self._family_of(best)
+            if fam is not None:
+                for name in [n for n, q in self._pending.items()
+                             if n != best and not q.empty()]:
+                    if self._family_of(name) == fam:
+                        batch = flush(name)
+                        if batch:
+                            rounds.append((name, batch))
+        return best, rounds
+
+    def _family_of(self, name: str):
+        """A pending session's bucket family, or None when it vanished
+        from the cache (its batch will fail inside the dispatch guard,
+        exactly like the per-session path)."""
+        try:
+            return self.sessions.get(name).family
+        except KeyError:
+            return None
 
     def _next_deadline(self) -> float | None:
         ts = [q.oldest_t for q in self._pending.values()
@@ -445,7 +508,8 @@ class ContinuousBatcher:
                     now = time.perf_counter()
                     picked = self._pick_locked(now, force=self._draining)
                     if picked is not None:
-                        self._queued_requests -= len(picked[1])
+                        self._queued_requests -= sum(
+                            len(b) for _n, b in picked[1])
                         telemetry.set_gauge("serve.queue_depth",
                                             self._queued_requests)
                         break
@@ -459,7 +523,190 @@ class ContinuousBatcher:
                     self._cv.wait(timeout)
             self._dispatch(*picked)
 
-    def _dispatch(self, session_name: str, batch: list[_Request]) -> None:
+    def _dispatch(self, primary: str, rounds) -> None:
+        """Route one picked flush: a single round goes down the
+        per-session path; multiple co-family rounds try the fused path,
+        with ineligible rounds (oversize part, unstackable family) falling
+        back per-session — counted, never silent."""
+        if len(rounds) == 1:
+            self._dispatch_one(*rounds[0])
+            return
+        group = self._fused_group(primary)
+        solo, fusable = [], []
+        for name, batch in rounds:
+            shots = sum(r.shots for r in batch)
+            if group is None:
+                solo.append((name, batch))
+            elif shots > group.buckets[-1]:
+                # a force-drain (or oversize-request) round past the top
+                # bucket chunks through the per-session path
+                self._count_fallback(group, "oversize")
+                solo.append((name, batch))
+            else:
+                fusable.append((name, batch))
+        if group is not None and len(fusable) >= 2:
+            self._dispatch_fused(group, fusable)
+        else:
+            solo = fusable + solo
+        for name, batch in solo:
+            self._dispatch_one(name, batch)
+
+    # ------------------------------------------------------------------
+    # fused-group bookkeeping (ISSUE 15)
+    # ------------------------------------------------------------------
+    def _fused_group(self, primary: str) -> "FusedDecodeGroup|None":
+        """The fused group serving the primary's bucket family, built over
+        ALL cached sessions of that family (so any pending subset reuses
+        the same lane programs) and rebuilt when the member set (or any
+        member object) changed.  None when the family doesn't stack —
+        negative-cached per member set, counted as a fallback per
+        dispatch."""
+        try:
+            fam = self.sessions.get(primary).family
+        except KeyError:
+            return None
+        members = []
+        for name in self.sessions.names():
+            try:
+                sess = self.sessions.get(name)
+            except KeyError:
+                continue
+            # strictly family-matched: a pending round whose session
+            # drifted out of the family (config swap under the same
+            # name) is NOT forced in — its round takes the transient
+            # requeue path and flushes as its own primary next pick
+            if sess.family == fam:
+                members.append(sess)
+        members.sort(key=lambda s: s.name)
+        if len(members) < 2:
+            # the family shrank under us (evictions/config swaps): not a
+            # stacking failure, just nothing to fuse this pick
+            return None
+        objs = tuple(members)
+        cached = self._group_cache.get(fam)
+        if cached is not None and cached[0] == objs:
+            self._group_cache.move_to_end(fam)
+            if cached[1] is None:
+                self._count_fallback(None, "unstackable", fam=fam)
+            return cached[1]
+        try:
+            group = FusedDecodeGroup(members)
+        except Exception as exc:  # noqa: BLE001 — fall back, loudly
+            telemetry.event("fused_fallback",
+                            reason=f"group_build: {type(exc).__name__}",
+                            cells=len(members))
+            self._store_group(fam, objs, None)
+            self._count_fallback(None, "unstackable", fam=fam)
+            return None
+        self._store_group(fam, objs, group)
+        with self._cv:
+            # MERGE into an existing entry: a group rebuild (member
+            # eviction/recreation) must not zero the cumulative per-family
+            # history this block exists to expose
+            st = self._fused_stats.setdefault(group.family_label(), {
+                "sessions": [], "eligible": True,
+                "dispatches": 0, "fallbacks": 0, "last_fallback": None})
+            st["sessions"] = list(group.names)
+            st["eligible"] = True
+        return group
+
+    def _store_group(self, fam, objs, group) -> None:
+        """Insert/replace one family's group, LRU-bounded: a retired
+        family's group pins member states + compiled executables, so a
+        host rotating through many families evicts the least-recently
+        picked one (a re-pick simply rebuilds + recompiles)."""
+        self._group_cache[fam] = (objs, group)
+        self._group_cache.move_to_end(fam)
+        while len(self._group_cache) > self.max_fused_groups:
+            self._group_cache.popitem(last=False)
+            telemetry.count("serve.fused.group_evictions")
+
+    def _count_fallback(self, group, reason: str, fam=None) -> None:
+        self.fused_fallbacks += 1
+        telemetry.count("serve.fused.fallbacks")
+        telemetry.count(f"serve.fused.fallback.{reason}")
+        label = (group.family_label() if group is not None
+                 else f"unstackable.{family_digest(fam)}")
+        with self._cv:
+            st = self._fused_stats.setdefault(label, {
+                "sessions": [], "eligible": group is not None,
+                "dispatches": 0, "fallbacks": 0, "last_fallback": None})
+            st["fallbacks"] += 1
+            st["last_fallback"] = reason
+            st["eligible"] = group is not None
+
+    def _dispatch_fused(self, group: FusedDecodeGroup, rounds) -> None:
+        """One cross-session fused dispatch: every round becomes one lane
+        of the group's cell-fused program; per-session corrections are
+        sliced on host and each round completes exactly like a per-session
+        batch (journal, futures, telemetry)."""
+        t_assembled = time.perf_counter()
+        flat = [r for _n, b in rounds for r in b]
+        traced = [r for r in flat if r.trace is not None]
+        for r in traced:
+            tracing.record_span(
+                "queue_wait", r.trace, dur_s=t_assembled - r.t0,
+                session=r.session, tenant=r.tenant,
+                **({} if r.request_id is None
+                   else {"request_id": r.request_id}))
+        synds = [(name, (batch[0].syndromes if len(batch) == 1
+                         else np.concatenate([r.syndromes for r in batch])))
+                 for name, batch in rounds]
+        total_shots = sum(int(s.shape[0]) for _n, s in synds)
+        wait_s = time.perf_counter() - min(r.t0 for r in flat)
+        t0 = time.perf_counter()
+        for r in traced:
+            tracing.record_span(
+                "batch_assemble", r.trace, dur_s=t0 - t_assembled,
+                requests=len(flat), shots=total_shots,
+                amortized_over=len(flat))
+        idx = {name: i for i, name in enumerate(group.names)}
+        try:
+            if any(name not in idx for name, _s in synds):
+                # a member replaced/evicted between group build and now:
+                # transient — the re-queue (or the next flush's rebuilt
+                # group) serves it
+                raise resilience.TransientFault(
+                    "fused group membership changed under the dispatch")
+            group.ensure_fresh()
+            parts = [(idx[name], s) for name, s in synds]
+            ladder = resilience.DegradationLadder(
+                [("serve_fused_recompile", group.invalidate)])
+
+            def _decode():
+                faultinject.site("serve_fused_dispatch", actions={
+                    "device_restart": self._chaos_device_restart,
+                    "session_evict": lambda f: self._chaos_session_evict(
+                        group, f),
+                })
+                return group.decode(parts)
+
+            with telemetry.span("serve.dispatch"):
+                outs = resilience.run_cell(
+                    _decode, label="serve_fused_dispatch",
+                    degrade=ladder.step)
+        except Exception as exc:  # noqa: BLE001 — answered, not dropped
+            synd_all = np.concatenate([s for _n, s in synds])
+            self._dispatch_failed(group.name, flat, traced, synd_all, exc,
+                                  t0, sessions=[n for n, _b in rounds])
+            return
+        dispatch_s = time.perf_counter() - t0
+        self._last_dispatch_t = time.monotonic()
+        self.fused_dispatches += 1
+        telemetry.count("serve.fused.dispatches")
+        telemetry.count("serve.fused.lanes", len(rounds))
+        label = group.family_label()
+        with self._cv:
+            st = self._fused_stats.get(label)
+            if st is not None:
+                st["dispatches"] += 1
+        for (name, batch), out in zip(rounds, outs):
+            self._finish_batch(name, batch, out, wait_s, dispatch_s,
+                               amortized_over=len(flat),
+                               fused_lanes=len(rounds), family=label)
+
+    def _dispatch_one(self, session_name: str,
+                      batch: list[_Request]) -> None:
         t_assembled = time.perf_counter()
         traced = [r for r in batch if r.trace is not None]
         for r in traced:
@@ -483,11 +730,19 @@ class ContinuousBatcher:
             # submit and flush must fail this batch's futures, not kill
             # the dispatcher thread (which would hang the whole service)
             sess: DecodeSession = self.sessions.get(session_name)
-            # the recovery rung: repeated transient faults invalidate the
-            # session (programs recompile against freshly uploaded state)
-            # — the rung that matters after a worker restart
-            ladder = resilience.DegradationLadder(
-                [("serve_session_recompile", sess.invalidate)])
+            # recovery rungs: a SHARDED session first retires its mesh
+            # (a device loss makes the sharded program a guaranteed loss
+            # while the single-device twin still serves — the elastic
+            # degrade composing with PR 14's mesh_replan semantics),
+            # then repeated transient faults invalidate the session
+            # (programs recompile against freshly uploaded state — the
+            # rung that matters after a worker restart)
+            rungs = []
+            if sess.sharded:
+                rungs.append(("serve_mesh_unshard",
+                              lambda: sess.unshard(reason="degrade")))
+            rungs.append(("serve_session_recompile", sess.invalidate))
+            ladder = resilience.DegradationLadder(rungs)
 
             def _decode():
                 faultinject.site("serve_dispatch", actions={
@@ -512,6 +767,20 @@ class ContinuousBatcher:
             return
         dispatch_s = time.perf_counter() - t0
         self._last_dispatch_t = time.monotonic()
+        self._finish_batch(session_name, batch, out, wait_s, dispatch_s,
+                           amortized_over=len(batch))
+
+    def _finish_batch(self, session_name: str, batch, out, wait_s: float,
+                      dispatch_s: float, *, amortized_over: int,
+                      fused_lanes: int = 0,
+                      family: str | None = None) -> None:
+        """Complete one session's decoded round: slice per-request
+        results, journal transitions, resolve futures, record stage spans
+        and telemetry.  Shared by the per-session and fused paths —
+        ``fused_lanes``/``family`` annotate the serve_batch event, and
+        ``amortized_over`` is the whole dispatch's request count (a fused
+        dispatch's batch stages amortize across every lane's requests)."""
+        traced = [r for r in batch if r.trace is not None]
         occupancy = out.shots / out.padded_shots if out.padded_shots else 0.0
         stage_s = out.timings or {}
         now = time.perf_counter()
@@ -562,11 +831,12 @@ class ContinuousBatcher:
             if r.trace is not None:
                 # pad / device_decode / slice are BATCH stages; each traced
                 # request records them with the amortization factor so a
-                # span tree stays honest about shared work
+                # span tree stays honest about shared work (a fused
+                # dispatch amortizes over EVERY lane's requests)
                 for stage in ("pad", "device_decode", "slice"):
                     tracing.record_span(
                         stage, r.trace, dur_s=float(stage_s.get(stage, 0.0)),
-                        amortized_over=len(batch),
+                        amortized_over=amortized_over,
                         bucket=int(max(out.buckets)), shots=r.shots)
             telemetry.observe("serve.latency_s", lat)
             telemetry.event("serve_request", session=session_name,
@@ -585,19 +855,24 @@ class ContinuousBatcher:
                         occupancy=round(occupancy, 4),
                         tenants=len({r.tenant for r in batch}),
                         wait_s=round(wait_s, 6),
-                        dispatch_s=round(dispatch_s, 6), ok=True)
+                        dispatch_s=round(dispatch_s, 6), ok=True,
+                        fused=bool(fused_lanes), lanes=int(fused_lanes),
+                        **({} if family is None else {"family": family}))
 
     # ------------------------------------------------------------------
     # dispatch failure: bounded re-dispatch, then structured error
     # ------------------------------------------------------------------
     def _dispatch_failed(self, session_name: str, batch, traced, synd,
-                         exc: Exception, t0: float) -> None:
+                         exc: Exception, t0: float,
+                         sessions=None) -> None:
         """One dispatch died after the in-dispatch retries.  Re-queue every
         request with attempt budget left (transient faults only — the
         session may have been healed/recompiled under it, so the next
         flush rides the recovered program); answer the rest with the
         structured error.  Either way the incident feeds the self-healing
-        probe and the postmortem names exactly what was in flight."""
+        probe and the postmortem names exactly what was in flight.
+        ``sessions`` (fused dispatches) lists every member session the
+        failure implicates — the probe heals each of them."""
         err = f"{type(exc).__name__}: {exc}"
         kind = resilience.classify_error(exc)
         retry, dead = [], []
@@ -621,10 +896,11 @@ class ContinuousBatcher:
                 telemetry.set_gauge("serve.queue_depth",
                                     self._queued_requests)
                 self._cv.notify()
-            self._incidents.append({
-                "session": session_name, "error": err, "kind": kind,
-                "ts": time.monotonic(), "requests": len(batch),
-                "requeued": len(retry)})
+            for name in (sessions if sessions else [session_name]):
+                self._incidents.append({
+                    "session": name, "error": err, "kind": kind,
+                    "ts": time.monotonic(), "requests": len(batch),
+                    "requeued": len(retry)})
         self.redispatched += len(retry)
         self.failed += len(dead)
         telemetry.count("serve.incidents")
@@ -680,6 +956,32 @@ class ContinuousBatcher:
         raise faultinject.InjectedFault(fault.message)
 
     # ------------------------------------------------------------------
+    # warmup (the serve warmup discipline: timed/served paths never
+    # compile)
+    # ------------------------------------------------------------------
+    def warm(self, max_shots: int | None = None) -> None:
+        """Precompile every session's shape buckets AND every bucket
+        family's fused lane programs up to ``max_shots`` (defaults:
+        session ladders fully, fused groups to ``max_batch_shots``)."""
+        fams: dict = {}
+        for name in self.sessions.names():
+            try:
+                sess = self.sessions.get(name)
+            except KeyError:
+                continue
+            sess.warm(max_shots)
+            fams.setdefault(sess.family, []).append(name)
+        if not self.fused:
+            return
+        for fam, names in fams.items():
+            if len(names) < 2:
+                continue
+            group = self._fused_group(names[0])
+            if group is not None:
+                group.warm(self.max_batch_shots if max_shots is None
+                           else max_shots)
+
+    # ------------------------------------------------------------------
     # self-healing feed (serve.ops.HealthProbe)
     # ------------------------------------------------------------------
     def take_incidents(self) -> list:
@@ -706,6 +1008,7 @@ class ContinuousBatcher:
             last_t = self._last_dispatch_t
             journal = len(self._journal)
             incidents = len(self._incidents)
+            fused_stats = {k: dict(v) for k, v in self._fused_stats.items()}
         return {
             "queue_depth": int(depth),
             "sessions": len(self.sessions),
@@ -720,7 +1023,28 @@ class ContinuousBatcher:
             "last_dispatch_age_s": (
                 None if last_t is None
                 else round(time.monotonic() - last_t, 3)),
+            # cross-session fused dispatch (ISSUE 15): per-bucket-family
+            # eligibility + the fallback counter, so an operator can SEE
+            # when co-bucketing stopped (a shape drift used to just
+            # degrade throughput silently)
+            "fused": {
+                "enabled": bool(self.fused),
+                "dispatches": int(self.fused_dispatches),
+                "fallbacks": int(self.fused_fallbacks),
+                "families": fused_stats,
+            },
         }
+
+    def queue_stats(self) -> dict:
+        """Per-session queued shots + total depth (the autoscaler's
+        scaling signals, snapshotted under the lock)."""
+        with self._cv:
+            return {
+                "queued_requests": int(self._queued_requests),
+                "queued_shots": {name: int(q.shots)
+                                 for name, q in self._pending.items()
+                                 if not q.empty()},
+            }
 
     # ------------------------------------------------------------------
     # shutdown

@@ -1,25 +1,30 @@
 """Asyncio front-end for the decode service: stdlib TCP, length-prefixed
-JSON frames, streamed per-request responses, graceful drain.
+frames (JSON v1 or packed-binary v2), streamed per-request responses,
+graceful drain.
 
-Wire protocol (no dependencies beyond the stdlib):
+Wire protocol (no dependencies beyond the stdlib; serve/wire.py owns the
+codec):
 
     frame    := uint32 big-endian payload length | payload
-    payload  := one UTF-8 JSON object
+    payload  := one UTF-8 JSON object (v1) | packed binary (v2, ISSUE 15)
 
-Requests (client -> server):
+Requests (client -> server; v2 ships the same fields with the syndromes as
+a packed gf2_packed body instead of a JSON matrix):
     {"op": "decode", "id": <str>, "session": <name>, "tenant": <str>,
      "syndromes": [[0,1,...], ...],
      "trace": {"trace_id": ..., "span_id": ...}}   # OPTIONAL (ISSUE 11)
     {"op": "ping"}
+    {"op": "hello", "codecs": [2, 1]}              # codec negotiation
 
 Responses (server -> client; decode responses stream back in COMPLETION
 order, matched by "id" — a slow megabatch never head-of-line-blocks a fast
-one):
+one — and each response is encoded in the codec its request arrived in):
     {"id": ..., "ok": true, "corrections": [[...], ...],
      "converged": [true, ...] | null, "latency_ms": <float>,
      "trace_id": "..."}                            # echoed when traced
     {"id": ..., "ok": false, "error": "...", "shed": true?}
     {"ok": true, "pong": true, "sessions": [...], "draining": false}
+    {"ok": true, "hello": true, "codec": 2, "codecs": [1, 2], ...}
 
 A traced request (optional "trace" field, utils.tracing.TraceContext wire
 shape) gets a ``serve.request`` root span covering submit -> response
@@ -29,9 +34,15 @@ it and the server adds the ``respond`` span.  A tenant shed by the SLO
 admission signal (serve.ops) is answered with ``"shed": true`` — refused
 loudly and cheaply, never queued and timed out.
 
-JSON keeps the protocol inspectable and dependency-free; the frame layer is
-codec-agnostic, so a binary payload (packed bitplanes) is a drop-in when
-wire size ever matters.
+Codec handling: JSON keeps the protocol inspectable; v2 (negotiated via
+"hello" at connect, self-describing per frame through the magic) ships the
+bitplanes in the gf2_packed device layout — mixed v1/v2 clients coexist on
+one server.  A malformed BINARY payload is answered with a structured
+error and the connection keeps serving (the outer frame boundary is
+intact); malformed JSON keeps its pre-v2 semantics (answer, then close —
+v1 framing errors are indistinguishable from stream corruption).
+``serve.bytes_rx`` / ``serve.bytes_tx`` count every framed byte both ways
+and the ``wire.codec_version`` gauge records the last negotiated codec.
 
 ``shutdown(drain=True)`` is the graceful path: stop accepting connections,
 reject NEW decode ops with an error response, drain the batcher (every
@@ -55,7 +66,13 @@ from .wire import (
     IDEM_FIELD,
     MAX_FRAME_BYTES,
     TRACE_FIELD,
+    WIRE_CODEC_JSON,
+    WIRE_CODEC_PACKED,
+    WIRE_CODECS,
+    WireCodecError,
+    decode_payload,
     encode_frame,
+    encode_response_frame,
 )
 
 __all__ = ["DecodeServer", "ServerHandle", "start_server_thread",
@@ -79,10 +96,11 @@ def _wire_idem(msg) -> str | None:
 
 
 async def read_frame(reader: asyncio.StreamReader):
-    """One length-prefixed JSON frame, or None on EOF / disconnect —
-    including a client dropping MID-frame (after the header, before the
-    full body), which must take the clean-disconnect path, not kill the
-    connection task with an unretrieved exception."""
+    """One length-prefixed payload's RAW bytes, or None on EOF /
+    disconnect — including a client dropping MID-frame (after the header,
+    before the full body), which must take the clean-disconnect path, not
+    kill the connection task with an unretrieved exception.  Decoding
+    (JSON v1 / packed v2) is the caller's ``wire.decode_payload``."""
     try:
         head = await reader.readexactly(HEADER.size)
         (length,) = HEADER.unpack(head)
@@ -92,7 +110,7 @@ async def read_frame(reader: asyncio.StreamReader):
         body = await reader.readexactly(length)
     except (asyncio.IncompleteReadError, ConnectionError):
         return None
-    return json.loads(body.decode("utf-8"))
+    return body
 
 
 class DecodeServer:
@@ -125,14 +143,16 @@ class DecodeServer:
         try:
             while True:
                 try:
-                    msg = await read_frame(reader)
-                except (ValueError, json.JSONDecodeError) as exc:
+                    payload = await read_frame(reader)
+                except ValueError as exc:
                     await self._write(writer, wlock,
                                       {"ok": False,
                                        "error": f"bad frame: {exc}"})
                     break
-                if msg is None:
+                if payload is None:
                     break
+                telemetry.count("serve.bytes_rx",
+                                len(payload) + HEADER.size)
                 # network chaos (ISSUE 14): under a fault plan this frame
                 # may be answered with a torn frame and/or the connection
                 # hard-dropped — the client's reconnect + resubmit path
@@ -143,6 +163,23 @@ class DecodeServer:
                             actions={"conn_drop": on, "torn_frame": on,
                                      "stall": on}),
                         writer, wlock):
+                    break
+                try:
+                    msg = decode_payload(payload)
+                except WireCodecError as exc:
+                    # malformed v2 payload: the OUTER frame boundary is
+                    # intact (the length prefix framed it), so only THIS
+                    # request is lost — answer a structured error and
+                    # keep serving everything pipelined on the connection
+                    telemetry.count("serve.wire_errors")
+                    await self._write(writer, wlock, {
+                        "id": exc.request_id, "ok": False,
+                        "error": f"bad frame: {exc}"})
+                    continue
+                except (json.JSONDecodeError, UnicodeDecodeError) as exc:
+                    await self._write(writer, wlock,
+                                      {"ok": False,
+                                       "error": f"bad frame: {exc}"})
                     break
                 if not isinstance(msg, dict):
                     # valid JSON but not an object: a structured reply,
@@ -160,6 +197,8 @@ class DecodeServer:
                         "draining": self._draining})
                 elif op == "decode":
                     await self._handle_decode(msg, writer, wlock)
+                elif op == "hello":
+                    await self._write(writer, wlock, self._hello(msg))
                 else:
                     await self._write(writer, wlock, {
                         "id": msg.get("id"), "ok": False,
@@ -216,8 +255,27 @@ class DecodeServer:
         except Exception:  # noqa: BLE001 — already dead is fine
             pass
 
+    def _hello(self, msg) -> dict:
+        """Codec negotiation (ISSUE 15): pick the highest wire codec both
+        ends speak.  The reply tells the client what to SEND; responses
+        always mirror each request's arrival codec, so the negotiation
+        never needs per-connection state server-side."""
+        offered = msg.get("codecs")
+        if not isinstance(offered, (list, tuple)):
+            offered = [WIRE_CODEC_JSON]
+        usable = [int(c) for c in offered
+                  if isinstance(c, (int, float)) and int(c) in WIRE_CODECS]
+        codec = max(usable, default=WIRE_CODEC_JSON)
+        telemetry.count(f"serve.codec.v{codec}_hellos")
+        telemetry.set_gauge("wire.codec_version", codec)
+        return {"ok": True, "hello": True, "codec": codec,
+                "codecs": list(WIRE_CODECS),
+                "sessions": self.batcher.sessions.names(),
+                "draining": self._draining}
+
     async def _handle_decode(self, msg, writer, wlock) -> None:
         rid = msg.get("id")
+        codec = int(msg.get("_codec", WIRE_CODEC_JSON))
         # trace propagation (ISSUE 11): the optional wire field becomes a
         # request context whose span id IS the serve.request root span —
         # pre-minted here so the batcher's stage spans parent to it, and
@@ -254,7 +312,7 @@ class DecodeServer:
         task = asyncio.ensure_future(
             self._respond(rid, fut, writer, wlock,
                           client_ctx=client_ctx, req_ctx=req_ctx,
-                          t_accept=t_accept))
+                          t_accept=t_accept, codec=codec))
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
@@ -278,21 +336,27 @@ class DecodeServer:
         return payload
 
     async def _respond(self, rid, fut, writer, wlock, *, client_ctx=None,
-                       req_ctx=None, t_accept=0.0) -> None:
+                       req_ctx=None, t_accept=0.0,
+                       codec=WIRE_CODEC_JSON) -> None:
         ok = True
         error = None
+        packed = codec == WIRE_CODEC_PACKED
         try:
             res = await asyncio.wrap_future(fut)
             payload = {
                 "id": rid, "ok": True,
-                # .tolist() alone yields native ints — no int64 copy
-                "corrections": res.corrections.tolist(),
+                # v1 serializes via .tolist() at encode time (native ints,
+                # no int64 copy); v2 packs the np planes directly — the
+                # response codec mirrors the request's
+                "corrections": (res.corrections if packed
+                                else res.corrections.tolist()),
                 "converged": (None if res.converged is None
                               else [bool(x) for x in res.converged]),
                 "latency_ms": round(res.latency_s * 1e3, 3),
             }
         except Exception as exc:  # noqa: BLE001
             ok, error = False, f"{type(exc).__name__}: {exc}"
+            packed = False  # errors are structured JSON in every codec
             payload = {"id": rid, "ok": False, "error": error}
         if req_ctx is not None:
             payload["trace_id"] = req_ctx.trace_id
@@ -309,7 +373,9 @@ class DecodeServer:
                 writer, wlock):
             return
         try:
-            await self._write(writer, wlock, payload)
+            await self._write(writer, wlock, payload,
+                              codec=(WIRE_CODEC_PACKED if packed
+                                     else WIRE_CODEC_JSON))
         except (ConnectionError, RuntimeError):
             pass  # client went away; the decode itself completed
         if req_ctx is not None:
@@ -326,19 +392,31 @@ class DecodeServer:
                 ok=ok, **({} if error is None else {"error": error}),
                 **({} if rid is None else {"request_id": str(rid)}))
 
-    @staticmethod
-    async def _write(writer, wlock, obj) -> None:
+    # drain (await transport backpressure) only past this much buffered
+    # response data: draining per frame costs an event-loop round-trip
+    # per response, which measured as a real serving tax under pipelined
+    # windows — the transport buffers small frames and TCP flow control
+    # still bounds the total via the high-water mark
+    _DRAIN_THRESHOLD = 256 * 1024
+
+    @classmethod
+    async def _write(cls, writer, wlock, obj,
+                     codec=WIRE_CODEC_JSON) -> None:
         try:
-            frame = encode_frame(obj)
+            frame = (encode_response_frame(obj, codec)
+                     if codec == WIRE_CODEC_PACKED else encode_frame(obj))
         except ValueError as exc:
             # a response too large for one frame (huge decode batch):
             # answer the request with a structured error instead of
             # killing the connection mid-pipeline
             frame = encode_frame({"id": obj.get("id"), "ok": False,
                                   "error": str(exc)})
+        telemetry.count("serve.bytes_tx", len(frame))
         async with wlock:
             writer.write(frame)
-            await writer.drain()
+            if (writer.transport.get_write_buffer_size()
+                    > cls._DRAIN_THRESHOLD):
+                await writer.drain()
 
     # ------------------------------------------------------------------
     async def shutdown(self, drain: bool = True, grace_s: float = 0.25,

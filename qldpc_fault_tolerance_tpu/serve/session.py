@@ -36,12 +36,14 @@ import numpy as np
 from ..decoders.bp_decoders import (
     DecoderClass,
     _decode_device_jit,
+    decode_device,
     device_syndrome_width,
     kernel_variant,
 )
 from ..utils import resilience, telemetry
 
-__all__ = ["DEFAULT_BUCKETS", "DecodeOutput", "DecodeSession", "SessionCache"]
+__all__ = ["DEFAULT_BUCKETS", "DecodeOutput", "DecodeSession",
+           "FusedDecodeGroup", "SessionCache", "bucket_family"]
 
 # request batches pad up to the smallest bucket that fits; the ladder is
 # geometric so padding waste is bounded at ~2x worst case and the compiled-
@@ -87,11 +89,23 @@ class DecodeSession:
     """
 
     def __init__(self, name: str, *, decoder=None, decoder_class=None,
-                 params=None, buckets=DEFAULT_BUCKETS):
+                 params=None, buckets=DEFAULT_BUCKETS, mesh=None):
         if (decoder is None) == (decoder_class is None):
             raise ValueError(
                 "pass exactly one of decoder= or (decoder_class=, params=)")
         self.name = str(name)
+        # hot-session mesh sharding (ISSUE 15): when a mesh is attached,
+        # ``shard()`` (driven by the autoscaler when the session's queue
+        # crosses its threshold) compiles shot-axis-sharded twins of the
+        # warm buckets — decode is per-shot independent, so the sharded
+        # program is bit-exact with the single-device one (the OSD /
+        # two-phase compaction tiers select program PATHS, never a shot's
+        # result).  ``unshard()`` is both the retire path and the elastic
+        # degrade rung a mesh-lost dispatch steps.
+        self._mesh = mesh
+        self._mesh_devices = (0 if mesh is None
+                              else int(np.prod(mesh.devices.shape)))
+        self._sharded = False
         if decoder is not None:
             if getattr(decoder, "needs_host_postprocess", False):
                 raise ValueError(
@@ -142,7 +156,8 @@ class DecodeSession:
         if not self.buckets or self.buckets[0] < 1:
             raise ValueError(f"invalid bucket ladder {buckets!r}")
         self._lock = threading.RLock()
-        self._programs: dict[int, object] = {}
+        self._programs: dict = {}
+        self._family = None  # (generation, bucket_family) lazy cache
         self.compiles = 0
         # bumped by every state swap (invalidate / heal): lets the health
         # probe and tests tell "already healed" from "still serving the
@@ -185,31 +200,76 @@ class DecodeSession:
                 return b
         return self.buckets[-1]
 
-    def program(self, bucket: int):
+    def _compile_program(self, static, state, width, bucket: int,
+                         sharded: bool):
+        """One AOT compile: the plain per-bucket program, or its
+        mesh-sharded twin (shot axis split over the session's mesh — the
+        state is replicated, the syndrome/correction planes shard, and
+        decode's per-shot independence makes the two bit-exact).  The
+        compiled executable takes ``(state, syndromes)`` by VALUE either
+        way, so heals/restacks swap state without recompiling."""
+        import jax
+        import jax.numpy as jnp
+
+        shape = jax.ShapeDtypeStruct((int(bucket), width), jnp.uint8)
+        if not sharded:
+            return _decode_device_jit.lower(static, state, shape).compile()
+        from jax.sharding import PartitionSpec as P
+
+        from ..parallel.shots import SHOT_AXIS, _shard_map
+
+        def local(st, synd):
+            cor, aux = decode_device(static, st, synd)
+            conv = aux.get("converged") if isinstance(aux, dict) else None
+            # same (corrections, aux) contract as the plain program so
+            # decode() consumes both identically; only the planes the
+            # server actually fetches stay in the output
+            return cor, {"converged": conv}
+
+        out_sd = jax.eval_shape(local, state, shape)
+        out_specs = jax.tree_util.tree_map(lambda _: P(SHOT_AXIS), out_sd)
+        run = _shard_map(local, mesh=self._mesh,
+                         in_specs=(P(), P(SHOT_AXIS)),
+                         out_specs=out_specs, check_vma=False)
+        return jax.jit(run).lower(state, shape).compile()
+
+    def _route_sharded(self, bucket: int) -> bool:
+        """Whether this bucket's decode runs the mesh-sharded program
+        right now.  A bucket the mesh size doesn't divide keeps the plain
+        program (counted — sharding must degrade loudly, not wrongly)."""
+        if not self._sharded or self._mesh is None:
+            return False
+        if int(bucket) % self._mesh_devices:
+            telemetry.count("serve.session.mesh_misfit")
+            return False
+        return True
+
+    def program(self, bucket: int, sharded: bool | None = None):
         """The AOT-compiled executable for one bucket (compiling on miss).
+        ``sharded=None`` routes through the session's current sharding
+        state (``shard()`` / ``unshard()``).
 
         The compiled object is self-contained — it keeps serving after
         ``jax.clear_caches()`` / ``reset_device_state`` drop the global jit
         caches, which is what makes the warm path of a long-lived service
         retrace-free by construction."""
-        prog = self._programs.get(bucket)
+        if sharded is None:
+            sharded = self._route_sharded(bucket)
+        key = (int(bucket), bool(sharded))
+        prog = self._programs.get(key)
         if prog is not None:
             telemetry.count("serve.session.hits")
             return prog
         with self._lock:
-            prog = self._programs.get(bucket)
+            prog = self._programs.get(key)
             if prog is not None:
                 return prog
-            import jax
-            import jax.numpy as jnp
-
             t0 = time.perf_counter()
-            shape = jax.ShapeDtypeStruct((int(bucket), self.syndrome_width),
-                                         jnp.uint8)
-            prog = _decode_device_jit.lower(
-                self.static, self.state, shape).compile()
+            prog = self._compile_program(self.static, self.state,
+                                         self.syndrome_width, bucket,
+                                         sharded)
             dt = time.perf_counter() - t0
-            self._programs[bucket] = prog
+            self._programs[key] = prog
             self.compiles += 1
             telemetry.count("serve.session.compiles")
             telemetry.observe("serve.session.compile_s", dt)
@@ -217,6 +277,7 @@ class DecodeSession:
                             event="compile", bucket=int(bucket),
                             compile_s=round(dt, 4),
                             syndrome_width=self.syndrome_width,
+                            sharded=bool(sharded),
                             # per-BUCKET resolution: small buckets can
                             # disengage the head path (batch gates), so
                             # the compiled program's variant may differ
@@ -272,18 +333,13 @@ class DecodeSession:
         of paying the recompile (or failing) inline.  A bucket compiled
         concurrently between the warm-set snapshot and the swap is simply
         dropped by the swap and recompiles on its next request."""
-        import jax
-        import jax.numpy as jnp
-
         t0 = time.perf_counter()
         with self._lock:
             warm = sorted(self._programs)
         static, state, width, kvariant, osd = self._resolved()
         programs = {
-            b: _decode_device_jit.lower(
-                static, state,
-                jax.ShapeDtypeStruct((int(b), width), jnp.uint8)).compile()
-            for b in warm}
+            key: self._compile_program(static, state, width, key[0], key[1])
+            for key in warm}
         dt = time.perf_counter() - t0
         with self._lock:
             self.static, self.state = static, state
@@ -302,6 +358,69 @@ class DecodeSession:
                         syndrome_width=width, kernel_variant=kvariant,
                         osd_backend=osd)
         return len(programs)
+
+    @property
+    def family(self) -> tuple:
+        """This session's ``bucket_family`` (cached per generation — a
+        heal/invalidate may change leaf shapes only through a config
+        change, but the cache must not serve a stale shape)."""
+        fam = self._family
+        if fam is None or fam[0] != self.generation:
+            self._family = fam = (self.generation, bucket_family(self))
+        return fam[1]
+
+    # ------------------------------------------------------------------
+    # hot-session mesh sharding (ISSUE 15)
+    # ------------------------------------------------------------------
+    @property
+    def sharded(self) -> bool:
+        return self._sharded
+
+    def shard(self, reason: str = "autoscale") -> bool:
+        """Start serving this session's decodes mesh-sharded on the shot
+        axis.  Compiles sharded twins of every currently-warm divisible
+        bucket on the CALLING thread (the autoscaler's, never the
+        dispatcher's) BEFORE flipping the route, so the next request hits
+        a warm sharded program.  No-op (False) without a mesh or when
+        already sharded."""
+        if self._mesh is None or self._sharded:
+            return False
+        t0 = time.perf_counter()
+        with self._lock:
+            warm = sorted({b for (b, _s) in self._programs})
+        progs = {
+            (b, True): self._compile_program(
+                self.static, self.state, self.syndrome_width, b, True)
+            for b in warm
+            if b % self._mesh_devices == 0 and
+            (b, True) not in self._programs}
+        with self._lock:
+            self._programs.update(progs)
+            self.compiles += len(progs)
+            self._sharded = True
+        telemetry.count("serve.session.shards")
+        telemetry.count("serve.session.compiles", len(progs))
+        telemetry.event("serve_session", session=self.name, event="shard",
+                        reason=str(reason), programs=len(progs),
+                        compile_s=round(time.perf_counter() - t0, 4),
+                        sharded=True, syndrome_width=self.syndrome_width)
+        return True
+
+    def unshard(self, reason: str = "autoscale") -> bool:
+        """Route decodes back to the single-device programs (they stayed
+        warm — sharding never evicts them).  Both the autoscaler's retire
+        path and the elastic degrade rung a mesh-lost dispatch steps: the
+        plain program consumes the identical request planes, so the retry
+        after an unshard is bit-exact with the sharded run that died."""
+        if not self._sharded:
+            return False
+        with self._lock:
+            self._sharded = False
+        telemetry.count("serve.session.unshards")
+        telemetry.event("serve_session", session=self.name,
+                        event="unshard", reason=str(reason), sharded=False,
+                        syndrome_width=self.syndrome_width)
+        return True
 
     # ------------------------------------------------------------------
     # serving
@@ -369,6 +488,298 @@ class DecodeSession:
             buckets=tuple(buckets_used),
             timings={"pad": pad_s, "device_decode": device_s,
                      "slice": slice_s})
+
+
+def family_digest(family: tuple) -> str:
+    """6-hex content digest of a family tuple — restart- and
+    process-stable (builtin ``hash`` is salted per process, which would
+    make every telemetry label un-correlatable across a fleet)."""
+    import hashlib
+
+    return hashlib.sha1(repr(family).encode("utf-8")).hexdigest()[:6]
+
+
+def bucket_family(session: "DecodeSession") -> tuple:
+    """The hashable SHAPE identity of a session's decode program: static
+    config, syndrome width, bucket ladder, and the state pytree's
+    structure + leaf shapes/dtypes.  Sessions with equal families can ride
+    ONE cell-fused program (session = cell axis) — the values differ per
+    session (another code of equal shape, another p's LLR priors), the
+    traced program doesn't."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(session.state)
+    shapes = tuple(
+        (tuple(np.shape(x)) if hasattr(x, "shape") else None,
+         str(getattr(x, "dtype", type(x).__name__)))
+        for x in leaves)
+    return (session.static, int(session.syndrome_width),
+            tuple(session.buckets), str(treedef), shapes)
+
+
+class FusedDecodeGroup:
+    """Cross-session fused dispatch (ISSUE 15): one AOT program decodes a
+    whole bucket family's rounds — session is the cell axis.
+
+    Built over the sessions of one ``bucket_family``; their device states
+    stack along a leading lane axis exactly like a fused sweep bucket
+    (``sim.common.stack_cell_states``: leaves identical across sessions
+    stay shared, per-session leaves gain the axis).  The compiled unit is
+    ``vmap(decode_device)`` over the lanes with the per-lane state
+    GATHERED by a TRACED ``lane_cell`` vector
+    (``sim.common.gather_lane_states``) — so one executable per
+    ``(n_lanes, bucket)`` shape serves ANY subset of the member sessions,
+    and the scheduler's round composition never retraces.  The stacked
+    state is an ARGUMENT of the compiled program, so a member heal (state
+    swap) restacks without recompiling.
+
+    Bit-exactness: BP freezes every shot at its own convergence and the
+    OSD/two-phase compaction ``lax.cond`` tiers become ``select`` under
+    vmap — both branches run, the selected one computes exactly what the
+    per-session program computes (pinned by tests against both the
+    per-session path and offline ``decode_batch``)."""
+
+    def __init__(self, sessions, name: str | None = None):
+        sessions = list(sessions)
+        if len(sessions) < 2:
+            raise ValueError("a fused group needs >= 2 member sessions")
+        families = {bucket_family(s) for s in sessions}
+        if len(families) != 1:
+            raise ValueError(
+                "fused-group members must share one bucket family "
+                f"(got {len(families)} distinct shapes)")
+        self.family = families.pop()
+        self.sessions = sessions
+        self.names = tuple(s.name for s in sessions)
+        self.name = name or "fused:" + "+".join(self.names)
+        rep = sessions[0]
+        self.static = rep.static
+        self.syndrome_width = rep.syndrome_width
+        self.buckets = rep.buckets
+        self.kernel_variant = rep.kernel_variant
+        self.osd_backend = rep.osd_backend
+        self._lock = threading.RLock()
+        self._programs: dict = {}
+        self.compiles = 0
+        self.restacks = 0
+        self.generation = 0
+        self._axes = None
+        self._gens = None
+        self._restack_locked()
+
+    # -- state stacking ------------------------------------------------
+    def _restack_locked(self) -> None:
+        """(Re)stack the member states.  Axes (which leaves are per-lane)
+        are part of the traced program's identity: on first stack they
+        come from the value compare; later restacks PIN the original axes
+        — a leaf whose values happen to coincide post-heal is force-
+        stacked rather than silently changing the program — and only a
+        leaf going shared->per-lane (impossible for a rebuild of the same
+        configs, but checked) drops the compiled programs."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..sim.common import stack_cell_states
+
+        stacked, treedef, axes = stack_cell_states(
+            [s.state for s in self.sessions])
+        if self._axes is not None and axes != self._axes:
+            if any(a == 0 and b is None
+                   for a, b in zip(axes, self._axes)):
+                # a previously-shared leaf now differs per member: the
+                # stacked shapes changed, the old executables are wrong
+                self._programs.clear()
+                telemetry.count("serve.fused.reprograms")
+                self._axes = axes
+            else:
+                # values coincide where they used to differ: force the
+                # original per-lane layout so the programs stay valid
+                flat = treedef.flatten_up_to(stacked)
+                flat = [jnp.stack([x] * len(self.sessions))
+                        if old == 0 and new is None else x
+                        for x, old, new in zip(flat, self._axes, axes)]
+                stacked = treedef.unflatten(flat)
+        elif self._axes is None:
+            self._axes = axes
+        self._stacked = stacked
+        self._treedef = treedef
+        self._gens = tuple(s.generation for s in self.sessions)
+        self.restacks += 1
+
+    def ensure_fresh(self) -> bool:
+        """Cheap pre-dispatch check: restack when any member's generation
+        moved (heal / invalidate swapped its state).  Returns True when a
+        restack happened."""
+        gens = tuple(s.generation for s in self.sessions)
+        if gens == self._gens:
+            return False
+        with self._lock:
+            if tuple(s.generation for s in self.sessions) == self._gens:
+                return False
+            self._restack_locked()
+            self.generation += 1
+        telemetry.count("serve.fused.restacks")
+        return True
+
+    def invalidate(self) -> None:
+        """The fused recovery rung (mirrors ``DecodeSession.invalidate``):
+        drop the group's compiled programs, invalidate every member (their
+        per-H memos were cleared by the retry's ``reset_device_state``, so
+        the re-resolve re-uploads live buffers) and restack — the retry's
+        next attempt recompiles against live state."""
+        with self._lock:
+            self._programs.clear()
+            for s in self.sessions:
+                s.invalidate()
+            self._restack_locked()
+            self.generation += 1
+        telemetry.count("serve.fused.invalidations")
+
+    # -- programs ------------------------------------------------------
+    def bucket_for(self, n_shots: int) -> int:
+        for b in self.buckets:
+            if n_shots <= b:
+                return b
+        return self.buckets[-1]
+
+    def _fused_fn(self):
+        import jax
+
+        from ..sim.common import gather_lane_states
+
+        static, treedef, axes = self.static, self._treedef, self._axes
+
+        def run(stacked, lane_cell, syndromes):
+            lane_states, in_axes = gather_lane_states(
+                stacked, treedef, axes, lane_cell)
+
+            def one(state, synd):
+                cor, aux = decode_device(static, state, synd)
+                conv = (aux.get("converged")
+                        if isinstance(aux, dict) else None)
+                return cor, conv
+
+            return jax.vmap(one, in_axes=(in_axes, 0))(
+                lane_states, syndromes)
+
+        return run
+
+    def program(self, n_lanes: int, bucket: int):
+        """The AOT executable decoding ``n_lanes`` lanes of one padded
+        ``bucket`` (compiling on miss).  ``lane_cell`` is traced, so the
+        same executable serves every member subset of that size."""
+        key = (int(n_lanes), int(bucket))
+        prog = self._programs.get(key)
+        if prog is not None:
+            telemetry.count("serve.fused.hits")
+            return prog
+        with self._lock:
+            prog = self._programs.get(key)
+            if prog is not None:
+                return prog
+            import jax
+            import jax.numpy as jnp
+
+            t0 = time.perf_counter()
+            synd = jax.ShapeDtypeStruct(
+                (key[0], key[1], self.syndrome_width), jnp.uint8)
+            cells = jax.ShapeDtypeStruct((key[0],), jnp.int32)
+            prog = jax.jit(self._fused_fn()).lower(
+                self._stacked, cells, synd).compile()
+            dt = time.perf_counter() - t0
+            self._programs[key] = prog
+            self.compiles += 1
+            telemetry.count("serve.fused.compiles")
+            telemetry.observe("serve.session.compile_s", dt)
+            telemetry.event("serve_session", session=self.name,
+                            event="fused_compile", bucket=key[1],
+                            lanes=key[0], family=self.family_label(),
+                            compile_s=round(dt, 4),
+                            syndrome_width=self.syndrome_width,
+                            kernel_variant=kernel_variant(
+                                self.static, self.sessions[0].state,
+                                key[1]),
+                            osd_backend=self.osd_backend)
+            return prog
+
+    def family_label(self) -> str:
+        """Short STABLE label for telemetry/health (the full family tuple
+        is an implementation detail): built from a content digest, not
+        the salted builtin ``hash`` — operators correlate these labels
+        across restarts and across a fleet's processes."""
+        return (f"{self.static[0]}.w{self.syndrome_width}."
+                f"{family_digest(self.family)}")
+
+    def warm(self, max_shots: int | None = None,
+             lanes: "tuple | None" = None) -> int:
+        """Precompile every (n_lanes, bucket) combination up to
+        ``bucket_for(max_shots)`` for ``lanes`` (default: every member
+        count 2..N) — the warmup discipline that keeps the timed/served
+        path retrace-free."""
+        top = (self.buckets[-1] if max_shots is None
+               else self.bucket_for(int(max_shots)))
+        lanes = (tuple(range(2, len(self.sessions) + 1))
+                 if lanes is None else tuple(int(x) for x in lanes))
+        done = 0
+        for n_lanes in lanes:
+            for b in self.buckets:
+                if b > top:
+                    break
+                self.program(n_lanes, b)
+                done += 1
+        return done
+
+    # -- serving -------------------------------------------------------
+    def decode(self, parts) -> list:
+        """Decode one fused round: ``parts`` is a list of
+        ``(member_index, syndromes)`` — at most one per member, each at
+        most the top bucket (the scheduler falls back per-session
+        otherwise).  Returns one ``DecodeOutput`` per part, sliced on
+        HOST from the fused planes; all parts share the dispatch's stage
+        timings (the scheduler amortizes them across requests)."""
+        import jax
+        import jax.numpy as jnp
+
+        arrs = [np.atleast_2d(np.asarray(s, np.uint8)) for _i, s in parts]
+        cells = [int(i) for i, _s in parts]
+        if len(set(cells)) != len(cells):
+            raise ValueError("one lane per member session and round")
+        top = self.buckets[-1]
+        if any(a.shape[0] > top for a in arrs):
+            raise ValueError(f"fused parts must fit the top bucket {top}")
+        bucket = max(self.bucket_for(a.shape[0]) for a in arrs)
+        n_lanes = len(parts)
+        with self._lock:
+            prog = self.program(n_lanes, bucket)
+            stacked = self._stacked
+        t0 = time.perf_counter()
+        pad = np.zeros((n_lanes, bucket, self.syndrome_width), np.uint8)
+        for l, a in enumerate(arrs):
+            pad[l, :a.shape[0]] = a
+        lane_cell = np.asarray(cells, np.int32)
+        t1 = time.perf_counter()
+        with telemetry.span("serve.fused_decode"):
+            cor, conv = prog(stacked, jnp.asarray(lane_cell),
+                             jnp.asarray(pad))
+            host = resilience.guarded_fetch(
+                lambda: jax.device_get((cor, conv)),
+                label="serve_fused_fetch")
+        t2 = time.perf_counter()
+        outs = []
+        for l, a in enumerate(arrs):
+            b = a.shape[0]
+            outs.append(DecodeOutput(
+                corrections=np.asarray(host[0][l])[:b],
+                converged=(None if host[1] is None
+                           else np.asarray(host[1][l])[:b].astype(bool)),
+                shots=int(b), padded_shots=int(bucket),
+                buckets=(int(bucket),), timings=None))
+        slice_s = time.perf_counter() - t2
+        timings = {"pad": t1 - t0, "device_decode": t2 - t1,
+                   "slice": slice_s}
+        for out in outs:
+            out.timings = timings
+        return outs
 
 
 class SessionCache:

@@ -105,7 +105,8 @@ SITES = {
     "mesh_dispatch": "sim/common.py mesh_batch_stats sharded dispatch",
     "mesh_replay_dispatch": "sim/common.py mesh-degrade replay dispatch",
     "sweep_ckpt_put": "utils/checkpoint.py JSONL append",
-    "serve_dispatch": "serve/scheduler.py batch dispatch",
+    "serve_dispatch": "serve/scheduler.py per-session batch dispatch",
+    "serve_fused_dispatch": "serve/scheduler.py cross-session fused dispatch",
     "serve_conn_rx": "serve/server.py per-received-frame (network chaos)",
     "serve_respond": "serve/server.py before a response frame is written",
 }

@@ -422,7 +422,15 @@ def event(kind: str, **fields) -> None:
 # environment provenance so cross-round drift can be attributed to
 # jax/backend/host changes).  Purely additive again — the v1/v2/v3 kind
 # sets are frozen below and the back-compat tests cover all three.
-EVENT_SCHEMA_VERSION = 4
+#
+# v5 (ISSUE 15): the serving scaling half adds ``scale_event`` (one per
+# autoscaler action — serve.ops.AutoScaler resizing batch targets or
+# sharding/unsharding a hot session) and the additive serve-event fields
+# for cross-session fused dispatch (serve_batch ``fused``/``lanes``/
+# ``family``, serve_session ``sharded``/``lanes``/``family``).  The
+# v1..v4 kind sets are frozen below; the back-compat test chain extends
+# to all four.
+EVENT_SCHEMA_VERSION = 5
 
 # the v1 kind set, frozen for the back-compat guarantee: these kinds and
 # their required fields must keep validating across schema bumps
@@ -442,10 +450,14 @@ _V2_EVENT_KINDS = frozenset({
 _V3_EVENT_KINDS = frozenset({"rare_stratum"})
 
 # the v4 additions (ISSUE 11 observability layer), frozen with the same
-# guarantee for the eventual v5 bump.  qldpc-lint's R005 pins every
-# frozen set's size and membership against EVENT_SCHEMAS, so shrinking
-# any of these is a tier-1 failure before it is a consumer outage.
+# guarantee at the v5 bump.  qldpc-lint's R005 pins every frozen set's
+# size and membership against EVENT_SCHEMAS, so shrinking any of these is
+# a tier-1 failure before it is a consumer outage.
 _V4_EVENT_KINDS = frozenset({"trace", "slo_alert", "process_info"})
+
+# the v5 additions (ISSUE 15 serving scaling half), frozen with the same
+# guarantee for the eventual v6 bump
+_V5_EVENT_KINDS = frozenset({"scale_event"})
 
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
@@ -566,10 +578,15 @@ EVENT_SCHEMAS: dict[str, dict] = {
         # session construction, so "host" never appears here.
         # reason/programs (ISSUE 14, additive): the self-healing
         # event="heal" names why the probe fired and how many warm
-        # buckets were recompiled in the background
+        # buckets were recompiled in the background.
+        # sharded/lanes/family (ISSUE 15, additive): mesh-sharded hot
+        # sessions (event="shard"/"unshard" + per-compile routing) and
+        # cross-session fused-group compiles (event="fused_compile" with
+        # the lane count + bucket-family label)
         "optional": {"bucket": int, "compile_s": _NUM,
                      "syndrome_width": int, "kernel_variant": str,
-                     "osd_backend": str, "reason": str, "programs": int},
+                     "osd_backend": str, "reason": str, "programs": int,
+                     "sharded": bool, "lanes": int, "family": str},
     },
     "serve_request": {
         "required": {"session": str, "tenant": str, "shots": int},
@@ -581,10 +598,14 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "bucket": int},
         # requeued (ISSUE 14, additive): how many of a failed batch's
         # requests re-queued for exactly-once re-dispatch instead of
-        # being answered with the error
+        # being answered with the error.
+        # fused/lanes/family (ISSUE 15, additive): whether this round
+        # rode a cross-session fused dispatch, how many lanes (sessions)
+        # shared it, and the bucket-family label
         "optional": {"occupancy": _NUM, "tenants": int, "wait_s": _NUM,
                      "dispatch_s": _NUM, "ok": bool, "error": str,
-                     "requeued": int},
+                     "requeued": int, "fused": bool, "lanes": int,
+                     "family": str},
     },
     "serve_drain": {
         "required": {"pending_requests": int, "completed": int},
@@ -620,6 +641,16 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "burn_latency": _NUM, "burn_error": _NUM,
                      "objective": str, "window_s": _NUM, "requests": int,
                      "bad_fraction": _NUM, "queue_depth": int},
+    },
+    # --- v5: serving scaling half (ISSUE 15) ------------------------------
+    # one autoscaler action (serve.ops.AutoScaler): a batch-target resize
+    # or a hot-session shard/unshard, with the signals that drove it
+    "scale_event": {
+        "required": {"action": str},
+        "optional": {"target": str, "session": _OPT_STR,
+                     "from_value": _NUM, "to_value": _NUM,
+                     "queue_depth": int, "queued_shots": int,
+                     "burn_rate": _NUM, "reason": str},
     },
     # environment provenance, once per telemetry enable (and embedded in
     # every RunLedger record): lets sweep_dashboard --drift and

@@ -92,11 +92,20 @@ GATED_FIELDS = (
     "osd_ab.device_shots_per_s",
     "osd_ab.host_shots_per_s",
     "bposd.host_round_trips",
+    # serving scaling half (bench.py serve, ISSUE 15): the packed wire's
+    # bytes/request gates on INCREASES (a layout/header regression shows
+    # up as more bytes on the wire), the cross-session fused dispatch
+    # A/B's fused arm gates as a rate alongside the new fused+packed
+    # headline ("value").  Rounds before r06 lack the keys, so the
+    # checked-in r01-r05 history gates unchanged.
+    "wire_ab.packed_bytes_per_req",
+    "fused_ab.fused_req_per_s",
 )
 
 # gated fields where a RISE is the regression (latencies, host round-trips)
 LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms",
-                                    "bposd.host_round_trips"})
+                                    "bposd.host_round_trips",
+                                    "wire_ab.packed_bytes_per_req"})
 
 
 def _dig(d: dict, dotted: str):

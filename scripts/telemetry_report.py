@@ -215,6 +215,13 @@ def summary_from_state(state: dict) -> dict:
                            if occ.get("mean") is not None else None),
         "latency_p50_s": _hist_quantile(lat, 0.50),
         "latency_p99_s": _hist_quantile(lat, 0.99),
+        # wire accounting (ISSUE 15): framed bytes both ways + the last
+        # negotiated codec, and the cross-session fused-dispatch counters
+        "bytes_rx": _metric(snap, "serve.bytes_rx"),
+        "bytes_tx": _metric(snap, "serve.bytes_tx"),
+        "wire_codec_version": _metric(snap, "wire.codec_version") or None,
+        "fused_dispatches": _metric(snap, "serve.fused.dispatches"),
+        "fused_fallbacks": _metric(snap, "serve.fused.fallbacks"),
         "tenants": {
             name[len("serve.tenant."):-len(".requests")]: m.get("value", 0)
             for name, m in snap.items()
@@ -384,6 +391,14 @@ def render(summary: dict, title: str = "") -> str:
             L.append(f"  {'latency p50/p99':<22}"
                      f"{1e3 * p50:.1f} / {1e3 * p99:.1f} ms")
         L.append(f"  {'queue depth (max)':<22}{srv['queue_depth_max']}")
+        if srv.get("bytes_rx") or srv.get("bytes_tx"):
+            codec = srv.get("wire_codec_version")
+            L.append(f"  {'wire bytes rx/tx':<22}"
+                     f"{srv['bytes_rx']} / {srv['bytes_tx']}"
+                     + (f"  (codec v{codec})" if codec else ""))
+        if srv.get("fused_dispatches") or srv.get("fused_fallbacks"):
+            L.append(f"  {'fused dispatches':<22}{srv['fused_dispatches']}"
+                     f"  ({srv['fused_fallbacks']} fallbacks)")
         L.append(f"  {'sessions':<22}{srv['sessions']}"
                  f"  ({srv['session_compiles']} compiles, "
                  f"{srv['session_evictions']} evictions)")

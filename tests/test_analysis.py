@@ -468,11 +468,44 @@ def test_r007_shared_body_reached_through_imports():
                            extra={helper_rel: helper})
 
 
+def test_r007_role_shared_pins_directional_bodies():
+    """The ISSUE 15 extension: a directional pair (wire codec) pins
+    per-role bodies on top of the common ones — a pack that stops
+    reaching pack_shots is a finding even while the common layout helper
+    is still reached."""
+    rule = KernelContractRule(contracts=(
+        KernelContract("fixture", CONTRACT_REL, "kern", "twin",
+                       ("_layout",),
+                       role_shared=(("_pack",), ("_unpack",))),))
+    good = """
+        def _layout(x):
+            return x
+
+        def _pack(x):
+            return x + 1
+
+        def _unpack(x):
+            return x - 1
+
+        def kern(x):
+            return _pack(_layout(x))
+
+        def twin(x):
+            return _unpack(_layout(x))
+    """
+    assert not findings_of(rule, good, rel=CONTRACT_REL)
+    drifted = good.replace("return _pack(_layout(x))",
+                           "return _layout(x) + 1")
+    found = findings_of(rule, drifted, rel=CONTRACT_REL)
+    assert len(found) == 1
+    assert "kern" in found[0].message and "_pack" in found[0].message
+
+
 def test_r007_registry_covers_declared_kernel_twin_pairs():
     names = {c.name for c in analysis.KERNEL_CONTRACTS}
     assert {"bp_v2_head", "bp_v1_v2_loop", "fused_sample",
             "fused_residual", "fused_decode",
-            "packed_residual"} <= names
+            "packed_residual", "wire_packed_codec"} <= names
 
 
 # ---------------------------------------------------------------------------

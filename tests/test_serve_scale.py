@@ -1,0 +1,912 @@
+"""The serving scaling half (ISSUE 15): packed binary wire codec v2
+(gf2_packed layout on the wire, negotiated at connect, v1 JSON clients
+still served, fuzz/robustness against torn and malformed binary frames),
+cross-session fused dispatch (one cell-fused program per bucket family,
+bit-exact vs the per-session path AND offline ``decode_batch`` with zero
+warm-path retraces, counted fallbacks), hot-session mesh sharding (shot
+axis over a mesh, bit-exact, unshard degrade rung), the admission-driven
+autoscaler (deterministic injected ``now``, ``scale_event`` telemetry,
+/varz exposure), the v5 event-schema back-compat chain, and the
+bench_compare gates for the new wire/fused fields."""
+import json
+import os
+import socket
+import struct
+import sys
+import threading
+import time
+from collections import deque
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+SCRIPTS = os.path.join(REPO_ROOT, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+from qldpc_fault_tolerance_tpu.parallel import shot_mesh
+from qldpc_fault_tolerance_tpu.serve import (
+    AutoScaler,
+    ContinuousBatcher,
+    DecodeClient,
+    DecodeSession,
+    FusedDecodeGroup,
+    ScalePolicy,
+    SLOEngine,
+    SLOPolicy,
+    bucket_family,
+    start_server_thread,
+)
+from qldpc_fault_tolerance_tpu.serve import wire
+from qldpc_fault_tolerance_tpu.serve.ops import OpsServer
+from qldpc_fault_tolerance_tpu.utils import (
+    faultinject,
+    resilience,
+    telemetry,
+)
+
+DEC_CLS = BP_Decoder_Class(4, "minimum_sum", 0.625)
+CODE3 = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+CODE4 = hgp(rep_code(4), rep_code(4), name="hgp_rep4")
+P = 0.05
+
+TRIVIAL_POLICY = resilience.RetryPolicy(max_attempts=1)
+FAST_POLICY = resilience.RetryPolicy(
+    max_attempts=2, base_delay=0.01, backoff=1.0, jitter=0.0,
+    reset_caches=False, degrade_after=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean_world():
+    telemetry.disable()
+    telemetry.reset()
+    faultinject.deactivate()
+    prev_policy = resilience.current_policy()
+    yield
+    resilience.set_default_policy(prev_policy)
+    faultinject.deactivate()
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _params(code, p=P):
+    return {"h": code.hx, "p_data": p}
+
+
+def _session(name, code, p=P, buckets=(8, 32, 128), mesh=None):
+    return DecodeSession(name, decoder_class=DEC_CLS,
+                         params=_params(code, p), buckets=buckets,
+                         mesh=mesh)
+
+
+def _synd(code, k, rng, p=P):
+    err = (rng.random((k, code.N)) < p).astype(np.uint8)
+    return (err @ np.asarray(code.hx, np.uint8).T % 2).astype(np.uint8)
+
+
+def _offline(code, synd, p=P):
+    return DEC_CLS.GetDecoder(_params(code, p)).decode_batch(synd)
+
+
+def _counter(name):
+    return telemetry.snapshot().get(name, {}).get("value", 0)
+
+
+def _retraces():
+    return telemetry.compile_stats().get("jax.retraces", 0)
+
+
+# ---------------------------------------------------------------------------
+# wire codec v2: layout contract + frame round-trips
+# ---------------------------------------------------------------------------
+def test_pack_plane_matches_gf2_packed_bodies():
+    """The wire layout IS the device layout: pack_plane's words equal
+    ops/gf2_packed.pack_shots' words bit for bit (ragged tails included),
+    and unpack_plane inverts both."""
+    from qldpc_fault_tolerance_tpu.ops import gf2_packed
+
+    rng = np.random.default_rng(3)
+    for b, cols in ((1, 3), (17, 42), (32, 6), (33, 13), (96, 25),
+                    (100, 1)):
+        dense = (rng.random((b, cols)) < 0.4).astype(np.uint8)
+        full = gf2_packed.num_words(b) * gf2_packed.LANE
+        padded = np.zeros((full, cols), np.uint8)
+        padded[:b] = dense
+        ref = np.asarray(gf2_packed.pack_shots(padded), np.uint32)
+        data = wire.pack_plane(dense)
+        assert len(data) == gf2_packed.num_words(b) * cols * 4
+        assert np.array_equal(
+            np.frombuffer(data, "<u4").reshape(ref.shape), ref)
+        assert np.array_equal(wire.unpack_plane(data, b, cols), dense)
+
+
+def test_request_and_response_frames_roundtrip_both_codecs():
+    rng = np.random.default_rng(5)
+    synd = _synd(CODE4, 9, rng)
+    msg = {"op": "decode", "id": "r-1", "session": "s", "tenant": "t",
+           "idem": "k-1", "syndromes": synd}
+    # v1 is byte-compatible with plain JSON framing
+    obj = json.loads(wire.encode_request_frame(msg, 1)[4:])
+    assert obj["syndromes"] == synd.tolist() and obj["idem"] == "k-1"
+    # v2 round-trips the dense plane + every header field
+    out = wire.decode_payload(wire.encode_request_frame(msg, 2)[4:])
+    assert out["_codec"] == 2 and out["op"] == "decode"
+    assert out["id"] == "r-1" and out["idem"] == "k-1"
+    assert np.array_equal(out["syndromes"], synd)
+
+    cor = (rng.random((9, CODE4.N)) < 0.5).astype(np.uint8)
+    conv = [bool(x) for x in rng.random(9) < 0.7]
+    payload = {"id": "r-1", "ok": True, "corrections": cor,
+               "converged": conv, "latency_ms": 1.5, "trace_id": "ab"}
+    out = wire.decode_payload(wire.encode_response_frame(payload, 2)[4:])
+    assert np.array_equal(out["corrections"], cor)
+    assert out["converged"] == conv and out["trace_id"] == "ab"
+    # converged=None round-trips as None
+    payload["converged"] = None
+    out = wire.decode_payload(wire.encode_response_frame(payload, 2)[4:])
+    assert out["converged"] is None
+
+
+def test_malformed_binary_payloads_raise_wire_codec_error():
+    """Every malformed-binary shape is a WireCodecError (recoverable
+    per-request), never a crash or a silent wrong plane."""
+    good = wire.encode_request_frame(
+        {"op": "decode", "id": "x", "session": "s",
+         "syndromes": np.zeros((3, 5), np.uint8)}, 2)[4:]
+    cases = [
+        good[:4],                                    # shorter than header
+        b"QW" + bytes([9, 1]) + good[4:],            # unknown version
+        b"QW" + bytes([2, 7]) + good[4:],            # unknown kind
+        good[:4] + struct.pack(">I", 1 << 20) + good[8:],  # header overrun
+        good[:8] + b"not json" + good[8 + 8:],       # unparseable header
+    ]
+    for payload in cases:
+        with pytest.raises(wire.WireCodecError):
+            wire.decode_payload(payload)
+    # body length mismatch carries the request id for the error reply
+    torn = good[:-4]
+    with pytest.raises(wire.WireCodecError) as exc:
+        wire.decode_payload(torn)
+    assert exc.value.request_id == "x"
+    # a hostile header cannot claim an OOM-sized dense plane
+    with pytest.raises(wire.WireCodecError):
+        wire.unpack_plane(b"", 10 ** 9, 10 ** 4)
+    # JSON payloads keep their pre-v2 error types
+    with pytest.raises(json.JSONDecodeError):
+        wire.decode_payload(b"{torn")
+
+
+# ---------------------------------------------------------------------------
+# mixed v1/v2 clients on one live server, bit-exact + structured errors
+# ---------------------------------------------------------------------------
+def test_mixed_codec_clients_bitexact_and_negotiation():
+    """A JSON v1 client and a negotiated packed v2 client on ONE server
+    decode the same syndromes to identical corrections (and both equal
+    offline); codec negotiation reports what each client sends; the
+    bytes counters see both directions."""
+    telemetry.enable()
+    sessions = {"hgp_rep3": _session("hgp_rep3", CODE3),
+                "hgp_rep4": _session("hgp_rep4", CODE4)}
+    bat = ContinuousBatcher(sessions, max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        cli1 = DecodeClient(host, port, codec=1)
+        cli2 = DecodeClient(host, port)  # auto -> packed
+        assert cli1.wire_codec == 1 and cli2.wire_codec == 2
+        rng = np.random.default_rng(11)
+        for code, name in ((CODE3, "hgp_rep3"), (CODE4, "hgp_rep4")):
+            synd = _synd(code, 13, rng)
+            r1 = cli1.decode(name, synd)
+            r2 = cli2.decode(name, synd)
+            off = _offline(code, synd)
+            assert np.array_equal(r1.corrections, off)
+            assert np.array_equal(r2.corrections, off)
+            assert r1.converged == r2.converged
+        # explicit codec=2 against a v2 server works; traced v2 requests
+        # echo the trace id through the binary header
+        cli3 = DecodeClient(host, port, codec=2, traced=True)
+        synd = _synd(CODE3, 4, rng)
+        res = cli3.decode("hgp_rep3", synd)
+        assert res.trace_id is not None
+        assert np.array_equal(res.corrections, _offline(CODE3, synd))
+        assert _counter("serve.bytes_rx") > 0
+        assert _counter("serve.bytes_tx") > 0
+        assert _counter("serve.client.bytes_tx") > 0
+        assert telemetry.snapshot().get(
+            "wire.codec_version", {}).get("value") == 2
+        cli1.close(), cli2.close(), cli3.close()
+    finally:
+        handle.stop(drain=True)
+
+
+def test_idempotent_replay_over_binary_wire():
+    """The exactly-once journal semantics survive the codec: two binary
+    submits with one idempotency key decode once (dedupe counters), and
+    the replayed answer is bit-identical."""
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session("hgp_rep3", CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        cli = DecodeClient(host, port, codec=2, idempotent=True)
+        rng = np.random.default_rng(2)
+        synd = _synd(CODE3, 6, rng)
+        first = cli.decode("hgp_rep3", synd)
+        # resubmit the same logical request by hand: same idem, new id
+        frame_msg = {"op": "decode", "id": "dup-1",
+                     "session": "hgp_rep3", "tenant": "default",
+                     "syndromes": synd,
+                     wire.IDEM_FIELD: "fixed-key"}
+        raw = socket.create_connection((host, port), timeout=10)
+        try:
+            raw.sendall(wire.encode_request_frame(frame_msg, 2))
+            raw.sendall(wire.encode_request_frame(
+                {**frame_msg, "id": "dup-2"}, 2))
+            got = {}
+            buf = b""
+            while len(got) < 2:
+                chunk = raw.recv(1 << 16)
+                assert chunk, "server closed mid-replay"
+                buf += chunk
+                while len(buf) >= 4:
+                    (length,) = struct.unpack(">I", buf[:4])
+                    if len(buf) < 4 + length:
+                        break
+                    msg = wire.decode_payload(buf[4:4 + length])
+                    buf = buf[4 + length:]
+                    got[msg["id"]] = msg
+        finally:
+            raw.close()
+        assert np.array_equal(got["dup-1"]["corrections"],
+                              got["dup-2"]["corrections"])
+        assert np.array_equal(got["dup-1"]["corrections"],
+                              first.corrections)
+        assert (_counter("serve.dedup.attached")
+                + _counter("serve.dedup.replayed")) >= 1
+        cli.close()
+    finally:
+        handle.stop(drain=True)
+
+
+def test_server_answers_malformed_binary_and_keeps_serving():
+    """A malformed v2 payload (framing intact) gets a structured error
+    reply naming the request and the CONNECTION KEEPS SERVING — unlike a
+    v1 framing error, the binary header's outer length still delimits
+    the stream.  An oversized dense claim is refused the same way."""
+    bat = ContinuousBatcher({"hgp_rep3": _session("hgp_rep3", CODE3)},
+                            max_batch_shots=32, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        raw = socket.create_connection((host, port), timeout=10)
+
+        def send_payload(payload):
+            raw.sendall(struct.pack(">I", len(payload)) + payload)
+
+        def read_msg():
+            buf = b""
+            while len(buf) < 4:
+                buf += raw.recv(4 - len(buf))
+            (length,) = struct.unpack(">I", buf)
+            body = b""
+            while len(body) < length:
+                chunk = raw.recv(length - len(body))
+                assert chunk
+                body += chunk
+            return wire.decode_payload(body)
+
+        # bad version byte
+        send_payload(b"QW" + bytes([9, 1]) + b"\x00\x00\x00\x00")
+        msg = read_msg()
+        assert msg["ok"] is False and "bad frame" in msg["error"]
+        # body length mismatch: error names the request id
+        good = wire.encode_request_frame(
+            {"op": "decode", "id": "short-body", "session": "hgp_rep3",
+             "syndromes": np.zeros((3, CODE3.hx.shape[0]), np.uint8)},
+            2)[4:]
+        send_payload(good[:-4])
+        msg = read_msg()
+        assert msg["ok"] is False and msg["id"] == "short-body"
+        # oversized packed payload claim -> structured error
+        huge = wire._binary_frame(
+            {"op": "decode", "id": "huge", "session": "hgp_rep3",
+             "shots": 10 ** 9, "width": 10 ** 4}, b"", wire.BIN_KIND_REQUEST)
+        send_payload(huge[4:])
+        msg = read_msg()
+        assert msg["ok"] is False and msg["id"] == "huge"
+        # ... and the connection still decodes fine afterwards
+        rng = np.random.default_rng(0)
+        synd = _synd(CODE3, 3, rng)
+        raw.sendall(wire.encode_request_frame(
+            {"op": "decode", "id": "ok-1", "session": "hgp_rep3",
+             "syndromes": synd}, 2))
+        msg = read_msg()
+        assert msg["ok"] is True and msg["id"] == "ok-1"
+        assert np.array_equal(msg["corrections"], _offline(CODE3, synd))
+        raw.close()
+    finally:
+        handle.stop(drain=True)
+
+
+def test_torn_binary_frame_mid_body_is_clean_disconnect():
+    """A client dying mid-binary-frame (header promised more bytes) takes
+    the clean-disconnect path; the server stays healthy for the next
+    connection."""
+    bat = ContinuousBatcher({"hgp_rep3": _session("hgp_rep3", CODE3)},
+                            max_batch_shots=32, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        frame = wire.encode_request_frame(
+            {"op": "decode", "id": "t", "session": "hgp_rep3",
+             "syndromes": np.zeros((8, CODE3.hx.shape[0]), np.uint8)}, 2)
+        raw = socket.create_connection((host, port), timeout=10)
+        raw.sendall(frame[:len(frame) // 2])  # torn mid-frame
+        raw.close()
+        time.sleep(0.05)
+        cli = DecodeClient(host, port)
+        rng = np.random.default_rng(1)
+        synd = _synd(CODE3, 2, rng)
+        out = cli.decode("hgp_rep3", synd)
+        assert np.array_equal(out.corrections, _offline(CODE3, synd))
+        cli.close()
+    finally:
+        handle.stop(drain=True)
+
+
+def test_conn_drop_chaos_recovers_over_binary_codec():
+    """The PR 14 chaos sites cover the binary codec — including its
+    NEGOTIATION: the injected conn_drop at serve_conn_rx eats the hello
+    frame (the first frame on the wire), so the client degrades to JSON
+    on a transport the server already aborted, reconnects, renegotiates
+    the packed codec on the fresh dial and decodes — answered exactly
+    once, bit-exact."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    bat = ContinuousBatcher({"hgp_rep3": _session("hgp_rep3", CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(7)
+        synd = _synd(CODE3, 4, rng)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_conn_rx", kind="conn_drop")])
+        with plan.active():
+            with DecodeClient(host, port, reconnect=True,
+                              timeout=30.0) as cli:
+                out = cli.submit("hgp_rep3", synd).result(timeout=60)
+                # the redial renegotiated the packed codec
+                assert cli.wire_codec == 2
+        assert np.array_equal(out.corrections, _offline(CODE3, synd))
+        assert _counter("serve.client.reconnects") >= 1
+        assert bat.completed == 1  # exactly once
+    finally:
+        handle.stop(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# FusedDecodeGroup: bit-exactness, program reuse, restack semantics
+# ---------------------------------------------------------------------------
+def test_bucket_family_groups_same_shape_sessions_only():
+    a = _session("a", CODE3, p=0.02)
+    b = _session("b", CODE3, p=0.08)
+    c = _session("c", CODE4)
+    assert bucket_family(a) == bucket_family(b)
+    assert bucket_family(a) != bucket_family(c)
+    with pytest.raises(ValueError):
+        FusedDecodeGroup([a, c])
+    with pytest.raises(ValueError):
+        FusedDecodeGroup([a])
+
+
+def test_fused_group_bitexact_vs_per_session_and_offline():
+    """The cell-fused program's lanes equal the per-session programs AND
+    offline decode_batch bit for bit — for full rounds, subsets (traced
+    lane_cell) and ragged per-lane sizes."""
+    sessions = [_session("a", CODE3, p=0.02),
+                _session("b", CODE3, p=0.05),
+                _session("c", CODE3, p=0.09)]
+    grp = FusedDecodeGroup(sessions)
+    rng = np.random.default_rng(0)
+    s0, s1, s2 = (_synd(CODE3, k, rng) for k in (3, 17, 8))
+    outs = grp.decode([(0, s0), (1, s1), (2, s2)])
+    for sess, synd, out in zip(sessions, (s0, s1, s2), outs):
+        per = sess.decode(synd)
+        assert np.array_equal(out.corrections, per.corrections)
+        assert np.array_equal(out.converged, per.converged)
+        off = DEC_CLS.GetDecoder(
+            {"h": CODE3.hx,
+             "p_data": {"a": 0.02, "b": 0.05, "c": 0.09}[sess.name]}
+        ).decode_batch(synd)
+        assert np.array_equal(out.corrections, off)
+    # member SUBSETS reuse the (n_lanes, bucket) programs via the traced
+    # lane_cell — once the shape set is warm, ANY same-shape subset
+    # compiles nothing
+    grp.warm(32)
+    compiles = grp.compiles
+    sub = grp.decode([(2, s2), (0, s0)])
+    assert np.array_equal(sub[0].corrections,
+                          sessions[2].decode(s2).corrections)
+    sub2 = grp.decode([(1, s1), (2, s2)])
+    assert np.array_equal(sub2[0].corrections,
+                          sessions[1].decode(s1).corrections)
+    assert grp.compiles == compiles  # same-shape subsets: zero compiles
+
+
+def test_fused_group_warm_path_zero_retraces():
+    telemetry.enable()
+    sessions = [_session("a", CODE3, p=0.03),
+                _session("b", CODE3, p=0.07)]
+    grp = FusedDecodeGroup(sessions)
+    grp.warm(32, lanes=(1, 2))
+    rng = np.random.default_rng(1)
+    before = _retraces()
+    for ks in ((1, 2), (5, 9), (32, 32), (2, 31)):
+        grp.decode([(0, _synd(CODE3, ks[0], rng)),
+                    (1, _synd(CODE3, ks[1], rng))])
+        grp.decode([(1, _synd(CODE3, ks[0], rng))])
+    assert _retraces() - before == 0
+
+
+def test_fused_group_restacks_on_heal_without_recompiling():
+    sessions = [_session("a", CODE3, p=0.02),
+                _session("b", CODE3, p=0.06)]
+    grp = FusedDecodeGroup(sessions)
+    rng = np.random.default_rng(4)
+    synd = _synd(CODE3, 7, rng)
+    base = grp.decode([(0, synd), (1, synd)])
+    compiles = grp.compiles
+    assert grp.ensure_fresh() is False  # steady state: no restack
+    sessions[1].heal(reason="test")
+    assert grp.ensure_fresh() is True
+    after = grp.decode([(0, synd), (1, synd)])
+    assert np.array_equal(base[1].corrections, after[1].corrections)
+    assert grp.compiles == compiles  # state is an argument: no recompile
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: cross-session fused rounds + fallback accounting + health
+# ---------------------------------------------------------------------------
+def _storm_batcher(fused=True, mesh=None):
+    sessions = {
+        "fam_a": _session("fam_a", CODE3, p=0.03, mesh=mesh),
+        "fam_b": _session("fam_b", CODE3, p=0.07),
+        "other": _session("other", CODE4),
+    }
+    bat = ContinuousBatcher(sessions, max_batch_shots=64,
+                            max_wait_s=0.004, fused=fused)
+    return sessions, bat
+
+
+def test_scheduler_fuses_co_family_rounds_bitexact():
+    """Concurrent submits to two co-family sessions + a third code ride
+    fused dispatches (counted, eligible in health()), per-session
+    corrections bit-exact vs offline; the serve_batch events carry the
+    v5 fused fields and validate."""
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    _sessions, bat = _storm_batcher()
+    bat.warm()
+    try:
+        rng = np.random.default_rng(5)
+        rows = {"fam_a": [], "fam_b": [], "other": []}
+        futs = []
+        for i in range(45):
+            name = ("fam_a", "fam_b", "other")[i % 3]
+            code = CODE4 if name == "other" else CODE3
+            synd = _synd(code, int(rng.integers(1, 9)), rng)
+            futs.append((name, synd, bat.submit(name, synd,
+                                                tenant=f"t{i % 2}")))
+        for name, synd, fut in futs:
+            rows[name].append((synd, fut.result(timeout=60).corrections))
+        for name, p in (("fam_a", 0.03), ("fam_b", 0.07), ("other", P)):
+            code = CODE4 if name == "other" else CODE3
+            synd = np.concatenate([s for s, _ in rows[name]])
+            served = np.concatenate([c for _, c in rows[name]])
+            off = DEC_CLS.GetDecoder(
+                {"h": code.hx, "p_data": p}).decode_batch(synd)
+            assert np.array_equal(served, off), name
+        assert bat.fused_dispatches >= 1
+        health = bat.health()
+        assert health["fused"]["enabled"] is True
+        assert health["fused"]["dispatches"] == bat.fused_dispatches
+        fams = health["fused"]["families"]
+        assert any(st["eligible"] and set(st["sessions"]) ==
+                   {"fam_a", "fam_b"} for st in fams.values())
+        fused_events = [r for r in sink.records
+                        if r.get("kind") == "serve_batch" and r.get("fused")]
+        assert fused_events and all(
+            telemetry.validate_event(e) == [] for e in fused_events)
+        assert all(e["lanes"] >= 2 and "family" in e for e in fused_events)
+    finally:
+        telemetry.remove_sink(sink)
+        bat.drain(timeout=30)
+
+
+def test_scheduler_oversize_round_falls_back_counted():
+    """A co-family round past the top bucket dispatches per-session —
+    and the fallback is COUNTED (health + counter), never silent."""
+    telemetry.enable()
+    sessions = {"fa": _session("fa", CODE3, p=0.03, buckets=(8, 16)),
+                "fb": _session("fb", CODE3, p=0.07, buckets=(8, 16))}
+    bat = ContinuousBatcher(sessions, max_batch_shots=64, max_wait_s=0.02)
+    try:
+        rng = np.random.default_rng(9)
+        rows = []
+        # oversize (> top bucket 16) rounds for both sessions, queued
+        # within one deadline window so they co-pick
+        for name in ("fa", "fb"):
+            synd = _synd(CODE3, 24, rng)
+            rows.append((name, synd, bat.submit(name, synd)))
+        for name, synd, fut in rows:
+            out = fut.result(timeout=60)
+            p = 0.03 if name == "fa" else 0.07
+            off = DEC_CLS.GetDecoder(
+                {"h": CODE3.hx, "p_data": p}).decode_batch(synd)
+            assert np.array_equal(out.corrections, off)
+        # the oversize fallback may or may not co-pick depending on
+        # timing; force one deterministic co-pick through drain
+        futs = [bat.submit(n, _synd(CODE3, 24, rng)) for n in ("fa", "fb")]
+        bat.drain(timeout=30)
+        for f in futs:
+            f.result(timeout=5)
+        assert bat.fused_fallbacks >= 1
+        assert _counter("serve.fused.fallback.oversize") >= 1
+        health = bat.health()
+        assert health["fused"]["fallbacks"] == bat.fused_fallbacks
+        assert any(st["last_fallback"] == "oversize"
+                   for st in health["fused"]["families"].values())
+    finally:
+        bat.close()
+
+
+def test_fused_dispatch_failure_requeues_and_heals_all_members():
+    """A transiently-failed FUSED dispatch re-queues every lane's
+    requests (exactly-once re-dispatch) and records one incident PER
+    member session, so the health probe heals each of them."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    _sessions, bat = _storm_batcher()
+    bat.warm()
+    try:
+        plan = faultinject.FaultPlan([faultinject.Fault(
+            site="serve_fused_dispatch", kind="raise")])
+        rng = np.random.default_rng(3)
+        sa, sb = _synd(CODE3, 4, rng), _synd(CODE3, 5, rng)
+        with plan.active():
+            fa = bat.submit("fam_a", sa)
+            fb = bat.submit("fam_b", sb)
+            ra, rb = fa.result(timeout=60), fb.result(timeout=60)
+        assert np.array_equal(
+            ra.corrections,
+            DEC_CLS.GetDecoder(
+                {"h": CODE3.hx, "p_data": 0.03}).decode_batch(sa))
+        assert np.array_equal(
+            rb.corrections,
+            DEC_CLS.GetDecoder(
+                {"h": CODE3.hx, "p_data": 0.07}).decode_batch(sb))
+        incidents = bat.take_incidents()
+        names = {i["session"] for i in incidents}
+        assert {"fam_a", "fam_b"} <= names
+        assert bat.redispatched >= 2 and bat.failed == 0
+    finally:
+        bat.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# Hot-session mesh sharding
+# ---------------------------------------------------------------------------
+def test_mesh_sharded_session_bitexact_and_unshard_rung():
+    """shard() serves bit-exact through the mesh program (shot axis
+    sharded, state replicated); a transiently-failing dispatch steps the
+    serve_mesh_unshard rung first — the session retires its mesh and the
+    retry answers bit-exact on the single-device twin."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    mesh = shot_mesh()
+    sess = _session("hot", CODE3, mesh=mesh, buckets=(8, 32))
+    rng = np.random.default_rng(8)
+    synd = _synd(CODE3, 21, rng)
+    base = sess.decode(synd)
+    assert sess.shard() and sess.sharded
+    out = sess.decode(synd)
+    assert np.array_equal(out.corrections, base.corrections)
+    assert np.array_equal(out.converged, base.converged)
+    # heal recompiles the sharded warm set too
+    sess.heal(reason="test")
+    assert np.array_equal(sess.decode(synd).corrections, base.corrections)
+    # dispatch fault with the session sharded: the ladder unshards first
+    bat = ContinuousBatcher({"hot": sess}, max_batch_shots=64,
+                            max_wait_s=0.002)
+    try:
+        plan = faultinject.FaultPlan([faultinject.Fault(
+            site="serve_dispatch", kind="raise")])
+        with plan.active():
+            res = bat.submit("hot", synd).result(timeout=60)
+        assert np.array_equal(res.corrections, base.corrections)
+        assert not sess.sharded  # the rung retired the mesh
+        assert _counter("serve.session.unshards") >= 1
+    finally:
+        bat.drain(timeout=30)
+
+
+# ---------------------------------------------------------------------------
+# AutoScaler: deterministic control law + exposure
+# ---------------------------------------------------------------------------
+def test_autoscaler_reacts_to_synthetic_slo_burn():
+    """A synthetic latency burn (injected now) grows the batch target and
+    cuts the wait; when the burn clears and the queue empties the scaler
+    walks both knobs back; every action is a validating scale_event."""
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    bat = ContinuousBatcher({"s": _session("s", CODE3)},
+                            max_batch_shots=128, max_wait_s=0.002)
+    slo = SLOEngine(SLOPolicy(latency_target_s=0.01, min_requests=5,
+                              window_s=30.0))
+    sc = AutoScaler(bat, slo=slo,
+                    policy=ScalePolicy(cooldown_s=1.0,
+                                       grow_queue_depth=1000),
+                    start=False)
+    try:
+        for i in range(20):
+            slo.observe_request("t", 0.5, ok=True, now=100.0 + i * 0.01)
+        slo.evaluate(now=101.0)
+        acts = sc.evaluate_once(now=101.0)
+        kinds = [a["action"] for a in acts]
+        assert "grow_batch" in kinds and "cut_wait" in kinds
+        assert bat.max_batch_shots == 256
+        assert bat.max_wait_s == sc.policy.overload_wait_s
+        # cooldown: an immediate second pass is a no-op
+        assert sc.evaluate_once(now=101.5) == []
+        # burn clears + empty queue: walk back toward the base targets
+        slo.evaluate(now=200.0)  # window aged out
+        acts = sc.evaluate_once(now=200.0)
+        kinds = [a["action"] for a in acts]
+        assert "shrink_batch" in kinds and "restore_wait" in kinds
+        assert bat.max_batch_shots == sc.base_batch_shots
+        assert bat.max_wait_s == sc.base_wait_s
+        events = [r for r in sink.records if r.get("kind") == "scale_event"]
+        assert len(events) >= 4
+        assert all(telemetry.validate_event(e) == [] for e in events)
+        assert sc.report()["actions"] == len(events)
+    finally:
+        telemetry.remove_sink(sink)
+        bat.close()
+
+
+def test_autoscaler_shards_hot_session_and_retires_it():
+    """Per-session queue pressure past the threshold shards the session
+    across the mesh; cooling below the retire threshold unshards —
+    hysteresis between, scale_events name the session."""
+    telemetry.enable()
+    mesh = shot_mesh()
+    sess = _session("hot", CODE3, mesh=mesh, buckets=(8, 32))
+    bat = ContinuousBatcher({"hot": sess}, max_batch_shots=64,
+                            max_wait_s=0.002)
+    sc = AutoScaler(bat, policy=ScalePolicy(cooldown_s=0.0,
+                                            shard_queued_shots=100,
+                                            unshard_queued_shots=10),
+                    start=False)
+    try:
+        depth_box = {"queued_shots": {"hot": 500}, "queued_requests": 50}
+        bat.queue_stats = lambda: depth_box  # deterministic pressure
+        acts = sc.evaluate_once(now=10.0)
+        assert any(a["action"] == "shard" and a["session"] == "hot"
+                   for a in acts)
+        assert sess.sharded
+        # hysteresis: between the thresholds nothing happens
+        depth_box = {"queued_shots": {"hot": 50}, "queued_requests": 5}
+        bat.queue_stats = lambda: depth_box
+        assert not any(a["action"] in ("shard", "unshard")
+                       for a in sc.evaluate_once(now=20.0))
+        assert sess.sharded
+        depth_box = {"queued_shots": {"hot": 0}, "queued_requests": 0}
+        bat.queue_stats = lambda: depth_box
+        acts = sc.evaluate_once(now=30.0)
+        assert any(a["action"] == "unshard" for a in acts)
+        assert not sess.sharded
+        # decode still bit-exact after the full shard/unshard cycle
+        rng = np.random.default_rng(1)
+        synd = _synd(CODE3, 9, rng)
+        assert np.array_equal(sess.decode(synd).corrections,
+                              _offline(CODE3, synd))
+    finally:
+        bat.close()
+
+
+def test_ops_plane_exposes_autoscaler():
+    bat = ContinuousBatcher({"s": _session("s", CODE3)},
+                            max_batch_shots=64, max_wait_s=0.002)
+    sc = AutoScaler(bat, start=False)
+    try:
+        ops = OpsServer(batcher=bat, scaler=sc)
+        assert ops.varz()["autoscale"]["max_batch_shots"] == 64
+        hz = ops.healthz()
+        assert hz["autoscale"]["base_batch_shots"] == 64
+        assert hz["fused"]["enabled"] is True  # batcher health block
+    finally:
+        bat.close()
+
+
+# ---------------------------------------------------------------------------
+# v5 schema back-compat chain
+# ---------------------------------------------------------------------------
+def test_v5_schema_backcompat_chain():
+    """The frozen v1..v4 kind sets are untouched, v5 adds exactly
+    scale_event, every frozen kind still has a registry entry, and the
+    new additive serve fields validate."""
+    frozen = [telemetry._V1_EVENT_KINDS, telemetry._V2_EVENT_KINDS,
+              telemetry._V3_EVENT_KINDS, telemetry._V4_EVENT_KINDS,
+              telemetry._V5_EVENT_KINDS]
+    assert telemetry._V5_EVENT_KINDS == frozenset({"scale_event"})
+    assert len(telemetry._V4_EVENT_KINDS) == 3
+    seen = set()
+    for s in frozen:
+        assert not (s & seen)  # pairwise disjoint
+        assert s <= set(telemetry.EVENT_SCHEMAS)
+        seen |= s
+    assert telemetry.EVENT_SCHEMA_VERSION == 5
+    samples = {
+        "scale_event": {"action": "grow_batch", "target":
+                        "max_batch_shots", "from_value": 128,
+                        "to_value": 256, "queue_depth": 80,
+                        "burn_rate": 3.2, "reason": "queue_depth"},
+        "serve_batch": {"session": "s", "requests": 3, "shots": 12,
+                        "bucket": 32, "fused": True, "lanes": 2,
+                        "family": "bp.w6.abc123", "ok": True},
+        "serve_session": {"session": "s", "event": "fused_compile",
+                          "lanes": 3, "family": "bp.w6.abc123",
+                          "bucket": 32, "sharded": False},
+    }
+    for kind, fields in samples.items():
+        assert telemetry.validate_event(
+            {"ts": 1.0, "kind": kind, **fields}) == [], kind
+
+
+# ---------------------------------------------------------------------------
+# telemetry_report serve block: bytes + fused counters
+# ---------------------------------------------------------------------------
+def test_telemetry_report_renders_wire_and_fused_counters():
+    import importlib
+
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    try:
+        _sessions, bat = _storm_batcher()
+        bat.warm()
+        handle = start_server_thread(bat)
+        host, port = handle.address
+        cli = DecodeClient(host, port)
+        rng = np.random.default_rng(2)
+        futs = [cli.submit(n, _synd(CODE3 if n != "other" else CODE4,
+                                    3, rng))
+                for n in ("fam_a", "fam_b", "other") for _ in range(3)]
+        for f in futs:
+            f.result(timeout=60)
+        cli.close()
+        handle.stop(drain=True)
+        telemetry.write_snapshot_event()
+        events = list(sink.records)
+    finally:
+        telemetry.remove_sink(sink)
+        telemetry.disable()
+
+    report = importlib.import_module("scripts.telemetry_report")
+    summary = report.summarize(events)
+    srv = summary["serve"]
+    assert srv["bytes_rx"] > 0 and srv["bytes_tx"] > 0
+    assert srv["wire_codec_version"] == 2
+    text = report.render(summary)
+    assert "wire bytes rx/tx" in text
+
+
+# ---------------------------------------------------------------------------
+# bench_compare gates the scaling-half fields
+# ---------------------------------------------------------------------------
+def test_bench_compare_gates_wire_and_fused_fields(tmp_path):
+    import importlib
+
+    bench_compare = importlib.import_module("bench_compare")
+
+    def write_round(n, qps, packed_bpr, fused_rps):
+        obj = {"schema": 2, "round": n, "result": {
+            "metric": "decode-service sustained QPS", "value": qps,
+            "unit": "req/s",
+            "wire_ab": {"packed_bytes_per_req": packed_bpr},
+            "fused_ab": {"fused_req_per_s": fused_rps}}}
+        path = tmp_path / f"BENCH_r{n:02d}.json"
+        path.write_text(json.dumps(obj))
+        return str(path)
+
+    a = write_round(6, 300.0, 600.0, 9000.0)
+    # packed bytes/request UP = wire regression (lower-is-better field)
+    b = write_round(7, 305.0, 900.0, 9100.0)
+    assert bench_compare.main(["--gate", a, b]) == 1
+    # fused req/s DOWN = fused-dispatch regression
+    c = write_round(8, 305.0, 610.0, 5000.0)
+    assert bench_compare.main(["--gate", a, c]) == 1
+    # within band passes
+    d = write_round(9, 310.0, 590.0, 9300.0)
+    assert bench_compare.main(["--gate", a, d]) == 0
+
+
+# ---------------------------------------------------------------------------
+# acceptance: mixed-code 3-tenant storm, fused + packed, zero retraces
+# ---------------------------------------------------------------------------
+def test_acceptance_fused_packed_storm_bitexact_zero_retraces():
+    """ISSUE 15 acceptance: a mixed-code 3-tenant storm through the full
+    TCP stack with cross-session fused dispatch AND the packed binary
+    wire — every served correction bit-exact vs offline decode_batch,
+    fused dispatches happened, zero retraces after warmup."""
+    telemetry.enable()
+    _sessions, bat = _storm_batcher()
+    bat.warm()
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        warm_rng = np.random.default_rng(0)
+
+        def run_storm(n_per_tenant, rows):
+            errors = []
+
+            def worker(idx):
+                try:
+                    cli = DecodeClient(host, port, tenant=f"tenant{idx}")
+                    assert cli.wire_codec == 2
+                    rng = np.random.default_rng(100 + idx)
+                    pending = deque()
+                    for i in range(n_per_tenant):
+                        name = ("fam_a", "fam_b", "other")[(i + idx) % 3]
+                        code = CODE4 if name == "other" else CODE3
+                        synd = _synd(code, int(rng.integers(1, 9)), rng)
+                        pending.append(
+                            (name, synd, cli.submit(name, synd)))
+                        if len(pending) >= 8:
+                            n_, s_, f_ = pending.popleft()
+                            rows.append((n_, s_,
+                                         f_.result(timeout=60)))
+                    while pending:
+                        n_, s_, f_ = pending.popleft()
+                        rows.append((n_, s_, f_.result(timeout=60)))
+                    cli.close()
+                except Exception as exc:  # noqa: BLE001
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(3)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors[0]
+
+        run_storm(8, rows=[])  # warm the wire/dispatch path
+        _synd(CODE3, 1, warm_rng)
+        before = _retraces()
+        rows: list = []
+        run_storm(15, rows)
+        assert _retraces() - before == 0
+        assert bat.fused_dispatches >= 1
+        for name, p, code in (("fam_a", 0.03, CODE3),
+                              ("fam_b", 0.07, CODE3),
+                              ("other", P, CODE4)):
+            pairs = [(s, r.corrections) for n, s, r in rows if n == name]
+            assert pairs, name
+            synd = np.concatenate([s for s, _ in pairs])
+            served = np.concatenate([c for _, c in pairs])
+            off = DEC_CLS.GetDecoder(
+                {"h": code.hx, "p_data": p}).decode_batch(synd)
+            assert np.array_equal(served, off), name
+    finally:
+        handle.stop(drain=True)
