@@ -2054,6 +2054,160 @@ def mode_chaos():
     }
 
 
+def mode_stream():
+    """Streaming space-time decode (ISSUE 16): sustained committed
+    cycles/s per stream and p99 commit latency vs window size on the
+    LIVE serve path (stream_open / stream_chunk / stream_commit over the
+    packed v2 wire), plus the windowed-vs-whole-history A/B gated on
+    compute per COMMITTED cycle.
+
+    A/B protocol (BASELINE.md): at total history T = 10*w cycles, the
+    whole-history arm re-decodes the full T-cycle ST program to commit
+    its next w cycles (cost/cycle = t_T / w) where the windowed arm
+    decodes only its w-cycle window (cost/cycle = t_w / w) — the ratio
+    t_T / t_w is the acceptance metric (>= 5x at T >= 10*w).  Arms are
+    interleaved sample-by-sample and take medians, so ambient drift
+    (thermal, background load) lands on both equally.
+    Env knobs: BENCH_STREAM_STEPS / BENCH_STREAM_LANES /
+    BENCH_STREAM_AB_REPS."""
+    import jax
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import ST_BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.serve import (
+        ContinuousBatcher,
+        DecodeClient,
+        DecodeSession,
+        start_server_thread,
+    )
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    steps = int(os.environ.get("BENCH_STREAM_STEPS", "120"))
+    lanes = int(os.environ.get("BENCH_STREAM_LANES", "8"))
+    ab_reps = int(os.environ.get("BENCH_STREAM_AB_REPS", "9"))
+    windows = (2, 4, 8)
+    p = 0.01
+    code = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+    cls = ST_BP_Decoder_Class(2, "minimum_sum", 0.625)
+    rng = np.random.default_rng(16)
+
+    with _tele_region():
+        # -- serve-path sustained streaming, one session per window size --
+        per_window = {}
+        sessions = {
+            f"st_w{w}": DecodeSession(
+                f"st_w{w}", decoder_class=cls,
+                params={"h": code.hx, "p_data": p, "p_syndrome": True,
+                        "num_rep": w},
+                buckets=(lanes,))
+            for w in windows
+        }
+        bat = ContinuousBatcher(sessions, max_batch_shots=max(lanes, 64),
+                                max_wait_s=0.002)
+        handle = start_server_thread(bat)
+        host, port = handle.address
+        try:
+            for w in windows:
+                cli = DecodeClient(host, port, reconnect=True)
+                try:
+                    ack = cli.stream_open(f"st_w{w}", lanes=lanes)
+                    sid = ack["stream"]
+                    width = ack["width"]
+                    # warm the AOT program + the stream path off the clock
+                    warm = (rng.random((lanes, width)) < 0.02).astype(
+                        np.uint8)
+                    cli.stream_step(sid, 1, warm)
+                    lat_ms = []
+                    t0 = time.perf_counter()
+                    for seq in range(2, steps + 2):
+                        chunk = (rng.random((lanes, width)) < 0.02).astype(
+                            np.uint8)
+                        t1 = time.perf_counter()
+                        res = cli.stream_step(sid, seq, chunk)
+                        lat_ms.append(1e3 * (time.perf_counter() - t1))
+                        assert res.get("ok"), res
+                    wall = time.perf_counter() - t0
+                    cli.stream_commit(sid, close=True)
+                    per_window[str(w)] = {
+                        "cycles_per_s": round(steps * w / wall, 1),
+                        "steps_per_s": round(steps / wall, 1),
+                        "p50_commit_ms": round(
+                            float(np.percentile(lat_ms, 50)), 3),
+                        "p99_commit_ms": round(
+                            float(np.percentile(lat_ms, 99)), 3),
+                    }
+                finally:
+                    cli.close()
+        finally:
+            handle.stop(drain=True)
+        # -- windowed-vs-whole-history A/B (device programs, interleaved) --
+        w = 4
+        T = 10 * w
+        ab_batch = int(os.environ.get("BENCH_STREAM_AB_BATCH", "512"))
+        params_w = {"h": code.hx, "p_data": p, "p_syndrome": True,
+                    "num_rep": w}
+        params_T = {"h": code.hx, "p_data": p, "p_syndrome": True,
+                    "num_rep": T}
+        dec_w = cls.GetDecoder(params_w)
+        dec_T = cls.GetDecoder(params_T)
+        m = np.asarray(code.hx).shape[0]
+        # the A/B runs at a compute-bound batch so per-call dispatch
+        # overhead doesn't mask the O(window)-vs-O(T) work difference the
+        # arms exist to measure (lanes-sized calls are latency-bound)
+        hist = (rng.random((ab_batch, T, m)) < 0.02).astype(np.uint8)
+
+        import jax.numpy as jnp
+
+        def _time_decode(dec, arr):
+            t1 = time.perf_counter()
+            folded, _ = dec.decode_batch_device(jnp.asarray(arr))
+            jax.block_until_ready(folded)
+            return time.perf_counter() - t1
+
+        _time_decode(dec_w, hist[:, :w])   # compile both arms off-clock
+        _time_decode(dec_T, hist)
+        t_w, t_T = [], []
+        for _ in range(ab_reps):           # interleaved arms
+            t_w.append(_time_decode(dec_w, hist[:, :w]))
+            t_T.append(_time_decode(dec_T, hist))
+        med_w = float(np.median(t_w))
+        med_T = float(np.median(t_T))
+        # each update commits w cycles: windowed decodes w of them, the
+        # whole-history arm re-decodes all T
+        ratio = med_T / med_w if med_w else float("inf")
+        tele_block = _tele_counters_block(telemetry_enabled=True)
+
+    headline = per_window[str(max(windows))]
+    return {
+        "metric": f"stream decode sustained cycles/s "
+                  f"(w={max(windows)}, {lanes} lanes, live serve path)",
+        "value": headline["cycles_per_s"],
+        "unit": "cycles/s",
+        "vs_baseline": None,
+        "stream": {
+            "cycles_per_s": headline["cycles_per_s"],
+            "p99_commit_ms": headline["p99_commit_ms"],
+            "ab_compute_per_cycle_ratio": round(ratio, 2),
+            "per_window": per_window,
+            "ab": {
+                "w": w, "T": T, "reps": ab_reps, "batch": ab_batch,
+                "windowed_ms_per_cycle": round(1e3 * med_w / w, 4),
+                "whole_ms_per_cycle": round(1e3 * med_T / w, 4),
+            },
+        },
+        "telemetry": tele_block,
+        "gates": {
+            # the acceptance floor: windowed overlap-commit is >= 5x
+            # cheaper per committed cycle than whole-history re-decode
+            # at T = 10*w
+            "ab_ratio_ge_5x": bool(ratio >= 5.0),
+            "all_windows_streamed": bool(
+                len(per_window) == len(windows)),
+        },
+    }
+
+
 MODES = {
     "bp": mode_bp,
     "bposd": mode_bposd,
@@ -2064,6 +2218,7 @@ MODES = {
     "serve": mode_serve,
     "rare": mode_rare,
     "chaos": mode_chaos,
+    "stream": mode_stream,
 }
 
 

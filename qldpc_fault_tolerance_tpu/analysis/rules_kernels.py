@@ -93,6 +93,17 @@ KERNEL_CONTRACTS = (
         "qldpc_fault_tolerance_tpu/serve/wire.py",
         "pack_plane", "unpack_plane", ("num_words",),
         role_shared=(("pack_shots",), ("unpack_shots",))),
+    # stream framing (ISSUE 16): a stream chunk's body IS a gf2_packed
+    # bitplane — encode and decode must keep routing through the SAME
+    # plane codec the batch wire uses (and thus the same num_words lane
+    # geometry), or committed corrections would desync from the batch
+    # path one layout drift at a time.
+    KernelContract(
+        "wire_stream_chunk",
+        "qldpc_fault_tolerance_tpu/serve/wire.py",
+        "encode_stream_chunk_frame", "_decode_stream_chunk",
+        ("num_words",),
+        role_shared=(("pack_plane",), ("unpack_plane",))),
 )
 
 

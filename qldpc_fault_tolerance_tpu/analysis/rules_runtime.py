@@ -42,6 +42,7 @@ DEFAULT_FROZEN_FLOORS = {
     "_V3_EVENT_KINDS": 1,
     "_V4_EVENT_KINDS": 3,
     "_V5_EVENT_KINDS": 1,
+    "_V6_EVENT_KINDS": 3,
 }
 
 

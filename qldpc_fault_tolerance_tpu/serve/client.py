@@ -48,6 +48,16 @@ old server.  ``codec=1`` forces JSON (no hello, frames byte-identical to
 pre-v2 builds); ``codec=2`` requires the packed codec.  Reconnects
 renegotiate on the fresh socket.  ``serve.client.bytes_rx/tx`` count
 framed bytes both ways.
+
+Streaming decode (ISSUE 16): ``stream_open`` opens an overlap-commit
+stream on the server, ``stream_step`` sends one window's detector
+increment and blocks for its committed corrections, ``stream_commit``
+queries the commit watermark (the resume handshake) or closes the
+stream.  Stream responses resolve as RAW dicts (they are not decode
+results), and a stream request is never auto-resubmitted: the step
+helper retries the SAME seq itself — the server's commit-before-respond
+ledger replays an already-committed seq from cache, so a retry can
+never double-commit a window.
 """
 from __future__ import annotations
 
@@ -75,6 +85,7 @@ from .wire import (
     decode_payload,
     encode_frame,
     encode_request_frame,
+    encode_stream_chunk_frame,
 )
 
 __all__ = ["ClientResult", "DecodeClient"]
@@ -98,9 +109,9 @@ class _Inflight:
     server matches responses to whichever transmission answered)."""
 
     __slots__ = ("future", "t0", "base", "rids", "last_tx", "hedges",
-                 "resubmits")
+                 "resubmits", "raw")
 
-    def __init__(self, base: dict, t0: float):
+    def __init__(self, base: dict, t0: float, raw: bool = False):
         self.future: Future = Future()
         self.t0 = t0
         self.base = base
@@ -108,6 +119,10 @@ class _Inflight:
         self.last_tx = t0
         self.hedges = 0
         self.resubmits = 0
+        # raw requests (stream ops) resolve with the response DICT, not a
+        # ClientResult, and are never auto-resubmitted or hedged (base is
+        # None): stream seqs must only ever be retried by their caller
+        self.raw = raw
 
 
 class DecodeClient:
@@ -246,8 +261,13 @@ class DecodeClient:
         # connection (a packed frame on a JSON-only server kills the
         # whole pipelined connection)
         with self._wlock:
-            frame = (encode_request_frame(obj, self.wire_codec)
-                     if obj.get("op") == "decode" else encode_frame(obj))
+            op = obj.get("op")
+            if op == "decode":
+                frame = encode_request_frame(obj, self.wire_codec)
+            elif op == "stream_chunk":
+                frame = encode_stream_chunk_frame(obj, self.wire_codec)
+            else:
+                frame = encode_frame(obj)
             telemetry.count("serve.client.bytes_tx", len(frame))
             self._sock.sendall(frame)
 
@@ -322,6 +342,12 @@ class DecodeClient:
                 continue
             fut, t0 = req.future, req.t0
             if fut.done():
+                continue
+            if req.raw:
+                # stream ops resolve with the raw response dict — ok and
+                # structured-error alike; the caller owns interpretation
+                # (retry on "busy", resume on shed, fold corrections)
+                fut.set_result(dict(msg))
                 continue
             if msg.get("ok"):
                 try:
@@ -471,8 +497,19 @@ class DecodeClient:
         with self._plock:
             reqs = self._logical_reqs()
             sends = []
+            fails = []
             for req in reqs:
-                if req.future.done() or req.base is None:
+                if req.future.done():
+                    continue
+                if req.base is None:
+                    # unanswered requests with no retained frame (raw
+                    # stream ops) cannot ride the resubmit: fail them NOW
+                    # so their caller retries the same seq itself instead
+                    # of hanging until the client timeout — the server
+                    # replays committed seqs, so the retry is exact-once
+                    for r in list(req.rids):
+                        self._reqs.pop(r, None)
+                    fails.append(req)
                     continue
                 rid = f"{self._prefix}-{next(self._ids)}"
                 req.rids.add(rid)
@@ -480,6 +517,10 @@ class DecodeClient:
                 req.last_tx = time.perf_counter()
                 self._reqs[rid] = req
                 sends.append((req, {**req.base, "id": rid}))
+        err = ConnectionError("connection replaced")
+        for req in fails:
+            if not req.future.done():
+                req.future.set_exception(err)
         for req, msg in sends:
             try:
                 self._send(msg)
@@ -595,6 +636,121 @@ class DecodeClient:
                trace: "tracing.TraceContext | None" = None) -> ClientResult:
         return self.submit(session, syndromes, tenant=tenant,
                            trace=trace).result(timeout=self.timeout)
+
+    # ------------------------------------------------------------------
+    # streaming decode (ISSUE 16)
+    # ------------------------------------------------------------------
+    def _submit_raw(self, msg: dict) -> Future:
+        """Send one raw (stream) op; the future resolves with the raw
+        response dict.  Never retained for resubmit or hedging — a raw
+        request that loses its transport fails with ``ConnectionError``
+        and its CALLER retries (the server's per-seq replay cache makes
+        that exactly-once)."""
+        rid = f"{self._prefix}-{next(self._ids)}"
+        req = _Inflight(None, time.perf_counter(), raw=True)
+        with self._plock:
+            if self._closed:
+                raise RuntimeError("client closed")
+            if self._dead:
+                req.future.set_exception(ConnectionError(
+                    "decode-service connection closed"))
+                return req.future
+            req.rids.add(rid)
+            self._reqs[rid] = req
+        try:
+            self._send({**msg, "id": rid})
+        except ValueError as exc:
+            self._fail_request(req, exc)
+        except OSError as exc:
+            # even with reconnect enabled a raw request does NOT ride the
+            # resubmit (base is None): fail it here so the caller's retry
+            # loop owns the resend
+            self._fail_request(req, ConnectionError(
+                f"stream op hit a dead connection: {exc}"))
+        return req.future
+
+    def _stream_rpc(self, msg: dict, *, retries: int = 8) -> dict:
+        """Raw op + retry-on-transport-death loop.  Safe for every stream
+        op: ``stream_open`` before any reply is idempotent-by-reopen-cost
+        only at the caller's discretion (retried opens may mint an orphan
+        stream server-side; harmless — shed/shutdown reaps it), and
+        chunk/commit retries are deduplicated by the server's seq
+        ledger."""
+        last: Exception | None = None
+        for attempt in range(max(1, int(retries))):  # qldpc: ignore[R102]
+            if attempt:
+                resilience.sleep_for(
+                    min(2.0, self.reconnect_backoff_s * (2 ** attempt)))
+            try:
+                return self._submit_raw(msg).result(timeout=self.timeout)
+            except ConnectionError as exc:
+                last = exc
+                continue
+        raise ConnectionError(
+            f"stream op failed after {retries} attempts: {last}")
+
+    def stream_open(self, profile: str, *, lanes: int = 1,
+                    tenant: str | None = None, retries: int = 8) -> dict:
+        """Open an overlap-commit stream on ``profile`` (a registered
+        stream profile, or a bare session name for a frame-mode stream).
+        Returns the server's open ack (``stream`` id, ``width``,
+        ``cycles_per_window``); raises on a structured error."""
+        res = self._stream_rpc({"op": "stream_open", "profile": str(profile),
+                                "lanes": int(lanes),
+                                "tenant": tenant or self.tenant},
+                               retries=retries)
+        if not res.get("ok"):
+            raise RuntimeError(res.get("error", "stream_open failed"))
+        return res
+
+    def stream_chunk(self, stream: str, seq: int, chunk) -> Future:
+        """Send one window's detector increment; the future resolves with
+        the raw response dict (commit payload, replay, or structured
+        error).  Most callers want ``stream_step``."""
+        arr = np.atleast_2d(np.asarray(chunk, np.uint8))
+        return self._submit_raw({"op": "stream_chunk", "stream": str(stream),
+                                 "seq": int(seq), "chunk": arr})
+
+    def stream_step(self, stream: str, seq: int, chunk, *,
+                    retries: int = 8) -> dict:
+        """One committed window: send ``(stream, seq, chunk)`` and block
+        for the commit payload.  A transport death or a transient "busy"
+        retries the SAME seq — the server's commit-before-respond ledger
+        either decodes it (never committed) or replays the cached commit
+        (response lost on the wire), so the window lands exactly once.
+        Terminal structured errors (shed, unknown stream, gap/stale)
+        return the raw dict for the caller's resume logic."""
+        arr = np.atleast_2d(np.asarray(chunk, np.uint8))
+        msg = {"op": "stream_chunk", "stream": str(stream),
+               "seq": int(seq), "chunk": arr}
+        last: Exception | None = None
+        for attempt in range(max(1, int(retries))):  # qldpc: ignore[R102]
+            if attempt:
+                resilience.sleep_for(
+                    min(2.0, self.reconnect_backoff_s * (2 ** attempt)))
+            try:
+                res = self._submit_raw(msg).result(timeout=self.timeout)
+            except ConnectionError as exc:
+                last = exc
+                continue
+            if res.get("stream_error") == "busy":
+                # the previous transmission of this seq is still decoding
+                # server-side (our response died on the wire): wait for
+                # its commit, then the retry replays from cache
+                last = RuntimeError(res.get("error", "stream busy"))
+                continue
+            return res
+        raise ConnectionError(
+            f"stream step seq={seq} failed after {retries} attempts: {last}")
+
+    def stream_commit(self, stream: str, *, close: bool = False,
+                      retries: int = 8) -> dict:
+        """Commit-watermark query (the resume handshake after a kill) or,
+        with ``close=True``, retire the stream."""
+        msg = {"op": "stream_commit", "stream": str(stream)}
+        if close:
+            msg["close"] = True
+        return self._stream_rpc(msg, retries=retries)
 
     def ping(self) -> dict:
         fut: Future = Future()

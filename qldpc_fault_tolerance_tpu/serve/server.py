@@ -61,6 +61,7 @@ import numpy as np
 from ..utils import faultinject, telemetry, tracing
 from .ops import AdmissionError, spawn_server_loop
 from .scheduler import ContinuousBatcher
+from .session import StreamProfile, StreamProtocolError, StreamSession
 from .wire import (
     HEADER,
     IDEM_FIELD,
@@ -118,7 +119,7 @@ class DecodeServer:
     ContinuousBatcher, streams responses back per request."""
 
     def __init__(self, batcher: ContinuousBatcher, host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, stream_profiles: dict | None = None):
         self.batcher = batcher
         self.host = host
         self.port = int(port)
@@ -126,6 +127,14 @@ class DecodeServer:
         self._tasks: set[asyncio.Task] = set()
         self._conns: set[asyncio.Task] = set()
         self._draining = False
+        # streaming decode (ISSUE 16): named open recipes + the live
+        # per-stream overlap-commit sessions.  A registered session name
+        # doubles as an implicit frame-mode profile, so phenom-style
+        # streams need no registration.
+        self.stream_profiles: dict[str, StreamProfile] = dict(
+            stream_profiles or {})
+        self._streams: dict[str, StreamSession] = {}
+        self._stream_counter = 0
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -199,6 +208,14 @@ class DecodeServer:
                     await self._handle_decode(msg, writer, wlock)
                 elif op == "hello":
                     await self._write(writer, wlock, self._hello(msg))
+                elif op == "stream_open":
+                    await self._write(writer, wlock, self._stream_open(msg))
+                elif op == "stream_chunk":
+                    if await self._handle_stream_chunk(msg, writer, wlock):
+                        break  # chaos killed the connection mid-window
+                elif op == "stream_commit":
+                    await self._write(writer, wlock,
+                                      self._stream_commit(msg))
                 else:
                     await self._write(writer, wlock, {
                         "id": msg.get("id"), "ok": False,
@@ -270,8 +287,220 @@ class DecodeServer:
         telemetry.set_gauge("wire.codec_version", codec)
         return {"ok": True, "hello": True, "codec": codec,
                 "codecs": list(WIRE_CODECS),
+                "streams": True,
                 "sessions": self.batcher.sessions.names(),
                 "draining": self._draining}
+
+    # ------------------------------------------------------------------
+    # streaming decode (ISSUE 16)
+    # ------------------------------------------------------------------
+    def _stream_open(self, msg) -> dict:
+        """Open one stream: mint an id, build the per-stream overlap-
+        commit ledger over the profile's DecodeSession.  A registered
+        session name with no profile opens a frame-mode stream on it."""
+        rid = msg.get("id")
+        if self._draining:
+            return {"id": rid, "ok": False, "error": "server is draining"}
+        name = str(msg.get("profile") or msg.get("session") or "")
+        profile = self.stream_profiles.get(name)
+        if profile is None:
+            try:
+                self.batcher.sessions.get(name)
+            except KeyError:
+                return {"id": rid, "ok": False,
+                        "error": f"unknown stream profile or session "
+                                 f"{name!r}"}
+            profile = StreamProfile(session=name)
+        try:
+            session = self.batcher.sessions.get(profile.session)
+        except KeyError:
+            return {"id": rid, "ok": False,
+                    "error": f"stream profile {name!r} names unknown "
+                             f"session {profile.session!r}"}
+        tenant = str(msg.get("tenant", "default"))
+        try:
+            lanes = int(msg.get("lanes", 1))
+        except (TypeError, ValueError):
+            return {"id": rid, "ok": False,
+                    "error": f"lanes must be an int, got "
+                             f"{msg.get('lanes')!r}"}
+        self._stream_counter += 1
+        sid = f"st-{self._stream_counter:04d}"
+        try:
+            stream = StreamSession(
+                sid, session, lanes=lanes, space_cor=profile.space_cor,
+                log_mat=profile.log_mat,
+                cycles_per_window=profile.cycles_per_window, tenant=tenant)
+        except ValueError as exc:
+            return {"id": rid, "ok": False, "error": str(exc)}
+        self._streams[sid] = stream
+        telemetry.count("stream.opens")
+        telemetry.set_gauge("stream.open_streams", len(self._streams))
+        telemetry.event("stream_open", stream=sid, session=profile.session,
+                        tenant=tenant, lanes=stream.lanes,
+                        width=stream.width,
+                        cycles_per_window=stream.cycles_per_window)
+        return {"id": rid, "ok": True, "stream": sid, "committed": 0,
+                "lanes": stream.lanes, "width": stream.width,
+                "cycles_per_window": stream.cycles_per_window}
+
+    def _stream_commit(self, msg) -> dict:
+        """Watermark query / close: the resume handshake.  After a kill
+        mid-window the client asks where to continue; ``close`` retires
+        the stream."""
+        rid = msg.get("id")
+        sid = msg.get("stream")
+        stream = self._streams.get(sid)
+        if stream is None:
+            return {"id": rid, "ok": False, "stream": sid,
+                    "stream_unknown": True,
+                    "error": f"unknown stream {sid!r} (shed, closed, or "
+                             "never opened)"}
+        snap = stream.snapshot()
+        if msg.get("close"):
+            self._streams.pop(sid, None)
+            info = stream.close()
+            telemetry.set_gauge("stream.open_streams", len(self._streams))
+            telemetry.event("stream_close", stream=str(sid),
+                            committed=info["committed"],
+                            committed_cycles=info["committed_cycles"],
+                            reason="client")
+            snap["closed"] = True
+        return {"id": rid, "ok": True, **snap}
+
+    async def _handle_stream_chunk(self, msg, writer, wlock) -> bool:
+        """One window's detector increment.  Returns True when chaos
+        killed the connection (the caller stops serving it).
+
+        Commit protocol: the chunk decodes through the batcher (journaled
+        ``stream:<id>:<seq>`` idempotency key, co-family fusion for free),
+        then the StreamSession folds the corrections into the carry and
+        advances the watermark atomically — replays of a committed seq get
+        the cached response without re-decoding, so a kill anywhere in
+        this path loses at most uncommitted work, never doubles a commit."""
+        rid = msg.get("id")
+        codec = int(msg.get("_codec", WIRE_CODEC_JSON))
+        sid = msg.get("stream")
+        stream = self._streams.get(sid)
+        if stream is None:
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False, "stream": sid,
+                "stream_unknown": True,
+                "error": f"unknown stream {sid!r} (shed, closed, or "
+                         "never opened)"})
+            return False
+        # stream chaos: the step dies mid-window — after the chunk was
+        # read, before decode/commit.  Nothing was committed, so the
+        # client's resume path (stream_commit watermark query + resend)
+        # must land the window exactly once.
+        if await self._consume_conn_fault(
+                lambda on: faultinject.site(
+                    "serve_stream_step",
+                    actions={"stream_kill": on, "conn_drop": on,
+                             "stall": on}),
+                writer, wlock):
+            return True
+        seq = msg.get("seq")
+        chunk = msg.get("chunk")
+        if chunk is None:
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False, "stream": stream.stream_id,
+                "error": "stream chunk misses its chunk plane"})
+            return False
+        try:
+            action, staged = stream.prepare(seq, chunk)
+        except StreamProtocolError as exc:
+            telemetry.count("stream.protocol_errors")
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False, "stream": stream.stream_id,
+                "stream_error": exc.code, "committed": stream.committed,
+                "error": str(exc)})
+            return False
+        if action == "replay":
+            payload = dict(staged, id=rid, replayed=True)
+            await self._write_stream_response(writer, wlock, payload, codec)
+            return False
+        try:
+            fut = self.batcher.submit(
+                stream.session.name, staged, tenant=stream.tenant,
+                request_id=None if rid is None else str(rid),
+                idem=f"stream:{stream.stream_id}:{int(seq)}")
+        except AdmissionError as exc:
+            # the streaming SLO rung: burn-rate pressure sheds the WHOLE
+            # stream, not one chunk — its state is dropped, the client is
+            # told loudly, and subsequent chunks answer "unknown stream"
+            # (reopen when the burn subsides)
+            stream.abort(int(seq))
+            self._streams.pop(stream.stream_id, None)
+            stream.close()
+            telemetry.count("stream.shed")
+            telemetry.set_gauge("stream.open_streams", len(self._streams))
+            telemetry.event("stream_shed", stream=stream.stream_id,
+                            tenant=exc.tenant, committed=stream.committed,
+                            burn_rate=float(exc.burn_rate),
+                            signal=str(exc.signal))
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False, "stream": stream.stream_id,
+                "shed": True, "stream_shed": True,
+                "committed": stream.committed,
+                "error": f"{type(exc).__name__}: {exc}"})
+            return False
+        except Exception as exc:  # noqa: BLE001 — answered, not dropped
+            stream.abort(int(seq))
+            await self._write(writer, wlock, {
+                "id": rid, "ok": False, "stream": stream.stream_id,
+                "committed": stream.committed,
+                "error": f"{type(exc).__name__}: {exc}"})
+            return False
+        task = asyncio.ensure_future(self._stream_respond(
+            rid, stream, int(seq), fut, writer, wlock, codec))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return False
+
+    async def _stream_respond(self, rid, stream, seq, fut, writer, wlock,
+                              codec) -> None:
+        try:
+            res = await asyncio.wrap_future(fut)
+        except Exception as exc:  # noqa: BLE001
+            stream.abort(seq)
+            try:
+                await self._write(writer, wlock, {
+                    "id": rid, "ok": False, "stream": stream.stream_id,
+                    "committed": stream.committed,
+                    "error": f"{type(exc).__name__}: {exc}"})
+            except (ConnectionError, RuntimeError):
+                pass
+            return
+        try:
+            payload = stream.commit(seq, res.corrections,
+                                    converged=res.converged)
+        except StreamProtocolError as exc:
+            # the stream was shed/closed while its decode was in flight
+            try:
+                await self._write(writer, wlock, {
+                    "id": rid, "ok": False, "stream": stream.stream_id,
+                    "stream_error": exc.code,
+                    "committed": stream.committed, "error": str(exc)})
+            except (ConnectionError, RuntimeError):
+                pass
+            return
+        payload["id"] = rid
+        payload["latency_ms"] = round(res.latency_s * 1e3, 3)
+        try:
+            await self._write_stream_response(writer, wlock, payload, codec)
+        except (ConnectionError, RuntimeError):
+            # the commit stands; a reconnecting client replays this seq
+            # and gets the cached response
+            pass
+
+    async def _write_stream_response(self, writer, wlock, payload,
+                                     codec) -> None:
+        if codec != WIRE_CODEC_PACKED:
+            payload = dict(payload,
+                           corrections=np.asarray(
+                               payload["corrections"]).tolist())
+        await self._write(writer, wlock, payload, codec=codec)
 
     async def _handle_decode(self, msg, writer, wlock) -> None:
         rid = msg.get("id")
@@ -447,6 +676,15 @@ class DecodeServer:
         await asyncio.get_running_loop().run_in_executor(
             None, ((lambda: self.batcher.drain(timeout=drain_timeout))
                    if drain else self.batcher.close))
+        # retire surviving streams loudly: their watermarks are the last
+        # committed cycles, so the accounting trail ends with a close
+        for sid, stream in list(self._streams.items()):
+            self._streams.pop(sid, None)
+            info = stream.close()
+            telemetry.event("stream_close", stream=str(sid),
+                            committed=info["committed"],
+                            committed_cycles=info["committed_cycles"],
+                            reason="shutdown")
         if self._tasks:
             await asyncio.gather(*list(self._tasks), return_exceptions=True)
         for conn in list(self._conns):
@@ -494,9 +732,11 @@ class ServerHandle:
 
 
 def start_server_thread(batcher: ContinuousBatcher, host: str = "127.0.0.1",
-                        port: int = 0) -> ServerHandle:
+                        port: int = 0,
+                        stream_profiles: dict | None = None) -> ServerHandle:
     """Start a DecodeServer on a daemon thread; returns once it accepts."""
-    server = DecodeServer(batcher, host=host, port=port)
+    server = DecodeServer(batcher, host=host, port=port,
+                          stream_profiles=stream_profiles)
     loop, thread = spawn_server_loop(server.start, "qldpc-serve-server",
                                      "decode server")
     return ServerHandle(server, loop, thread)

@@ -43,7 +43,8 @@ from ..decoders.bp_decoders import (
 from ..utils import resilience, telemetry
 
 __all__ = ["DEFAULT_BUCKETS", "DecodeOutput", "DecodeSession",
-           "FusedDecodeGroup", "SessionCache", "bucket_family"]
+           "FusedDecodeGroup", "SessionCache", "StreamProfile",
+           "StreamProtocolError", "StreamSession", "bucket_family"]
 
 # request batches pad up to the smallest bucket that fits; the ladder is
 # geometric so padding waste is bounded at ~2x worst case and the compiled-
@@ -830,3 +831,210 @@ class SessionCache:
 
     def __contains__(self, name: str) -> bool:
         return name in self._cache
+
+
+# ---------------------------------------------------------------------------
+# Streaming decode (ISSUE 16): persistent per-stream overlap-commit state
+# ---------------------------------------------------------------------------
+class StreamProtocolError(ValueError):
+    """A stream protocol violation (gap / stale / busy / shape mismatch).
+
+    The stream itself stays healthy — the server answers a structured
+    error for the offending chunk and keeps serving; ``code`` names the
+    violation so clients can branch without parsing messages."""
+
+    def __init__(self, message: str, code: str):
+        super().__init__(message)
+        self.code = code
+
+
+@dataclasses.dataclass
+class StreamProfile:
+    """Server-side recipe for opening streams: the ``DecodeSession`` that
+    decodes one window, plus the optional commit matrices.
+
+    ``space_cor`` (n_faults, m): folds a window's fault corrections into
+    the next window's first detector slice — the circuit engine's
+    ``h1_space_cor`` overlap-commit carry.  ``log_mat`` (n_faults, k):
+    folds corrections into the running logical frame (``L1``).  Both None
+    selects frame mode (the phenom engine's carry): the stream accumulates
+    the XOR of committed data corrections as a Pauli frame and chunks pass
+    to the decoder unadjusted."""
+
+    session: str
+    space_cor: np.ndarray | None = None
+    log_mat: np.ndarray | None = None
+    cycles_per_window: int | None = None
+
+
+class StreamSession:
+    """One live syndrome stream's overlap-commit ledger over a
+    ``DecodeSession``.
+
+    The expensive machinery is all reused: the window decode runs through
+    the wrapped session's AOT bucket programs (zero retraces, heal/shard
+    intact) and — on the server — through the ``ContinuousBatcher`` with
+    ``idem="stream:<id>:<seq>"``, so co-family stream steps fuse into the
+    same dispatch as batch traffic and the decode is exactly-once under
+    chaos.  What is new is the per-stream state: a commit watermark, the
+    boundary carry, and the last committed response, all updated
+    atomically under one lock so a kill mid-window loses only in-flight
+    work, never a commit.
+
+    Chunk protocol (enforced here, transport-agnostic):
+
+      * ``seq`` starts at 1 and increments by one per window;
+      * ``seq == committed``: replay — the cached response is returned
+        without re-decoding or re-folding (the no-double-commit half);
+      * ``seq <= committed`` otherwise: structured ``stale`` error;
+      * ``seq > committed + 1``: structured ``gap`` error (the no-lost-
+        commit half: the client must resend the missing window);
+      * a chunk for a seq already being decoded: structured ``busy`` error
+        (resubmit races resolve by retrying after the in-flight attempt
+        lands or dies).
+    """
+
+    def __init__(self, stream_id: str, session: DecodeSession, *,
+                 lanes: int, space_cor=None, log_mat=None,
+                 cycles_per_window: int | None = None,
+                 tenant: str = "default"):
+        self.stream_id = str(stream_id)
+        self.session = session
+        self.lanes = int(lanes)
+        if self.lanes < 1:
+            raise ValueError(f"need lanes >= 1, got {lanes}")
+        self.width = int(session.syndrome_width)
+        self.tenant = str(tenant)
+        self._space_cor = (None if space_cor is None
+                           else np.ascontiguousarray(space_cor, np.uint8))
+        self._log_mat = (None if log_mat is None
+                         else np.ascontiguousarray(log_mat, np.uint8))
+        if cycles_per_window is None:
+            static = getattr(session, "static", None)
+            cycles_per_window = (int(static[1])
+                                 if static and static[0] == "st_syndrome"
+                                 else 1)
+        self.cycles_per_window = int(cycles_per_window)
+        self._lock = threading.Lock()
+        self.committed = 0
+        self.closed = False
+        self._inflight: int | None = None
+        self._last_response: dict | None = None
+        # boundary carries: circuit mode folds corrections forward through
+        # the matrices; frame mode accumulates the correction XOR
+        self._carry_space = (None if self._space_cor is None else
+                             np.zeros((self.lanes, self._space_cor.shape[1]),
+                                      np.uint8))
+        self._carry_log = (None if self._log_mat is None else
+                           np.zeros((self.lanes, self._log_mat.shape[1]),
+                                    np.uint8))
+        self._frame: np.ndarray | None = None
+
+    @property
+    def committed_cycles(self) -> int:
+        return self.committed * self.cycles_per_window
+
+    def snapshot(self) -> dict:
+        """The resume handshake: where may the client continue?"""
+        with self._lock:
+            return {"stream": self.stream_id,
+                    "committed": self.committed,
+                    "committed_cycles": self.committed_cycles,
+                    "lanes": self.lanes, "width": self.width,
+                    "closed": self.closed}
+
+    def prepare(self, seq, chunk):
+        """Validate + stage chunk ``seq``.  Returns ``("replay", payload)``
+        for the already-committed watermark chunk, else ``("decode",
+        adjusted_chunk)`` with the overlap carry folded into the first
+        detector slice (circuit mode).  Raises ``StreamProtocolError`` on
+        protocol violations; nothing is mutated except the in-flight mark."""
+        try:
+            seq = int(seq)
+        except (TypeError, ValueError):
+            raise StreamProtocolError(
+                f"chunk seq must be an int, got {seq!r}", code="seq") from None
+        arr = np.atleast_2d(np.ascontiguousarray(chunk, np.uint8))
+        with self._lock:
+            if self.closed:
+                raise StreamProtocolError(
+                    f"stream {self.stream_id} is closed", code="closed")
+            if seq == self.committed and self._last_response is not None:
+                telemetry.count("stream.replays")
+                return "replay", dict(self._last_response)
+            if seq <= self.committed:
+                raise StreamProtocolError(
+                    f"chunk seq {seq} is behind the commit watermark "
+                    f"{self.committed} and no longer cached", code="stale")
+            if seq > self.committed + 1:
+                raise StreamProtocolError(
+                    f"chunk seq {seq} leaves a gap after committed "
+                    f"{self.committed} — resend window {self.committed + 1}",
+                    code="gap")
+            if self._inflight is not None:
+                raise StreamProtocolError(
+                    f"window {self._inflight} is already in flight",
+                    code="busy")
+            if arr.shape != (self.lanes, self.width):
+                raise StreamProtocolError(
+                    f"chunk shape {arr.shape} != ({self.lanes}, "
+                    f"{self.width})", code="shape")
+            self._inflight = seq
+            if self._carry_space is not None:
+                adjusted = arr.copy()
+                m = self._carry_space.shape[1]
+                adjusted[:, :m] ^= self._carry_space
+                return "decode", adjusted
+            return "decode", arr
+
+    def commit(self, seq: int, corrections, converged=None) -> dict:
+        """Fold window ``seq``'s corrections into the carry and advance the
+        watermark — the ONLY mutation of committed state, atomic under the
+        stream lock.  Returns the response payload (also cached for
+        replay)."""
+        cor = np.atleast_2d(np.asarray(corrections, np.uint8))
+        with self._lock:
+            if self._inflight != seq:
+                raise StreamProtocolError(
+                    f"commit of seq {seq} does not match the in-flight "
+                    f"window {self._inflight}", code="commit")
+            if self._carry_space is not None:
+                self._carry_space ^= (cor @ self._space_cor) % 2
+            else:
+                self._frame = (cor.copy() if self._frame is None
+                               else self._frame ^ cor)
+            if self._log_mat is not None:
+                self._carry_log ^= (cor @ self._log_mat) % 2
+            self.committed = seq
+            self._inflight = None
+            payload = {"ok": True, "stream": self.stream_id, "seq": seq,
+                       "committed": seq,
+                       "committed_cycles": self.committed_cycles,
+                       "corrections": cor,
+                       "converged": (None if converged is None else
+                                     [bool(x) for x in np.asarray(converged).ravel()])}
+            if self._carry_log is not None:
+                payload["log_frame"] = self._carry_log.tolist()
+            self._last_response = payload
+            telemetry.count("stream.commits")
+            telemetry.count("stream.cycles", self.cycles_per_window)
+            return dict(payload)
+
+    def abort(self, seq: int) -> None:
+        """Drop the in-flight mark after a failed decode attempt: the
+        window was NOT committed and the client may resend it."""
+        with self._lock:
+            if self._inflight == seq:
+                self._inflight = None
+
+    def frame(self) -> np.ndarray | None:
+        """Frame-mode accumulated Pauli frame (copy), None before the
+        first commit or in circuit mode."""
+        with self._lock:
+            return None if self._frame is None else self._frame.copy()
+
+    def close(self) -> dict:
+        with self._lock:
+            self.closed = True
+            return {"stream": self.stream_id, "committed": self.committed,
+                    "committed_cycles": self.committed_cycles}

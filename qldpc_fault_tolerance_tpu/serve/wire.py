@@ -76,7 +76,8 @@ __all__ = ["HEADER", "IDEM_FIELD", "MAX_FRAME_BYTES", "TRACE_FIELD",
            "WIRE_CODEC_JSON", "WIRE_CODEC_PACKED", "WIRE_CODECS",
            "WIRE_MAGIC", "WireCodecError", "encode_frame",
            "encode_request_frame", "encode_response_frame",
-           "decode_payload", "pack_plane", "unpack_plane"]
+           "encode_stream_chunk_frame", "decode_payload", "pack_plane",
+           "unpack_plane"]
 
 HEADER = struct.Struct(">I")
 MAX_FRAME_BYTES = 64 * 1024 * 1024  # a malformed length must not OOM us
@@ -105,6 +106,10 @@ WIRE_MAGIC = b"QW"
 _BIN_HEAD = struct.Struct(">2sBBI")  # magic | version | kind | header_len
 BIN_KIND_REQUEST = 1
 BIN_KIND_RESPONSE = 2
+# streaming decode (ISSUE 16): one window's detector increment for an open
+# stream — the body is one gf2_packed plane of lane words, exactly like a
+# batch request, plus stream/seq bookkeeping in the header
+BIN_KIND_STREAM = 3
 
 
 class WireCodecError(ValueError):
@@ -299,13 +304,47 @@ def encode_response_frame(payload: dict,
     return _binary_frame(header, body, BIN_KIND_RESPONSE)
 
 
+def encode_stream_chunk_frame(msg: dict,
+                              codec: int = WIRE_CODEC_JSON) -> bytes:
+    """One ``stream_chunk`` frame: an increment of detector data for an
+    open stream.  ``msg`` carries ``"chunk"`` as a (lanes, window_width)
+    array-like plus ``stream``/``seq`` bookkeeping; v1 ships the chunk as
+    a JSON int matrix, v2 as a ``BIN_KIND_STREAM`` binary frame whose body
+    is one gf2_packed plane (the same lane-word layout batch requests use,
+    pinned by the ``wire_stream_chunk`` lint contract)."""
+    if codec == WIRE_CODEC_JSON:
+        obj = {k: (np.asarray(v).tolist() if k == "chunk" else v)
+               for k, v in msg.items()}
+        return encode_frame(obj)
+    arr = np.atleast_2d(np.asarray(msg["chunk"], np.uint8))
+    header = {k: v for k, v in msg.items() if k != "chunk"}
+    header["shots"] = int(arr.shape[0])
+    header["width"] = int(arr.shape[1])
+    return _binary_frame(header, pack_plane(arr), BIN_KIND_STREAM)
+
+
+def _decode_stream_chunk(header: dict, body: bytes) -> np.ndarray:
+    """Validate a ``BIN_KIND_STREAM`` frame's header and unpack its chunk
+    plane.  Raises ``WireCodecError`` on any malformation — the frame
+    boundary is intact, so the server answers a structured error for this
+    chunk and keeps both the connection and the stream alive."""
+    for field in ("stream", "seq", "shots", "width"):
+        if field not in header:
+            raise WireCodecError(f"binary stream chunk misses {field!r}")
+    seq = header["seq"]
+    if not isinstance(seq, int) or isinstance(seq, bool) or seq < 1:
+        raise WireCodecError(f"stream chunk seq must be a positive int, "
+                             f"got {seq!r}")
+    return unpack_plane(body, header["shots"], header["width"])
+
+
 def _decode_binary(payload: bytes) -> dict:
     if len(payload) < _BIN_HEAD.size:
         raise WireCodecError("binary payload shorter than its fixed header")
     magic, version, kind, hlen = _BIN_HEAD.unpack_from(payload)
     if version != WIRE_CODEC_PACKED:
         raise WireCodecError(f"unsupported wire codec version {version}")
-    if kind not in (BIN_KIND_REQUEST, BIN_KIND_RESPONSE):
+    if kind not in (BIN_KIND_REQUEST, BIN_KIND_RESPONSE, BIN_KIND_STREAM):
         raise WireCodecError(f"unknown binary frame kind {kind}")
     if _BIN_HEAD.size + hlen > len(payload):
         raise WireCodecError(
@@ -330,6 +369,8 @@ def _decode_binary(payload: bytes) -> dict:
                     "binary decode request misses shots/width")
             msg["syndromes"] = unpack_plane(
                 body, header["shots"], header["width"])
+        elif kind == BIN_KIND_STREAM:
+            msg["chunk"] = _decode_stream_chunk(header, body)
         elif header.get("ok") and "shots" in header:
             shots, n = int(header["shots"]), int(header["n"])
             clen = num_words(shots) * n * 4

@@ -1,13 +1,23 @@
-from .common import ShotBatcher, SimResult, wer_per_cycle, wer_single_shot
+from .common import (
+    ShotBatcher,
+    SimResult,
+    st_round_counts,
+    st_window_count,
+    wer_per_cycle,
+    wer_single_shot,
+)
 from .data_error import CodeSimulator_DataError
 from .phenom import CodeSimulator_Phenon
 from .phenom_spacetime import CodeSimulator_Phenon_SpaceTime
 from .circuit import CodeSimulator_Circuit, build_memory_circuit
 from .circuit_spacetime import CodeSimulator_Circuit_SpaceTime
+from .stream_spacetime import CircuitStreamDriver, PhenomStreamDriver
 
 __all__ = [
     "ShotBatcher",
     "SimResult",
+    "st_round_counts",
+    "st_window_count",
     "wer_per_cycle",
     "wer_single_shot",
     "CodeSimulator_DataError",
@@ -15,5 +25,7 @@ __all__ = [
     "CodeSimulator_Phenon_SpaceTime",
     "CodeSimulator_Circuit",
     "CodeSimulator_Circuit_SpaceTime",
+    "CircuitStreamDriver",
+    "PhenomStreamDriver",
     "build_memory_circuit",
 ]

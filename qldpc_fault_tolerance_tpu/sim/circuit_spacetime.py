@@ -44,6 +44,7 @@ from .common import (
     accumulate_counts,
     mesh_batch_stats,
     record_wer_run,
+    st_window_count,
     wer_per_cycle,
     windowed_count,
 )
@@ -57,6 +58,24 @@ __all__ = ["CodeSimulator_Circuit_SpaceTime"]
 # one memory layout compiles once).
 # cfg = (batch_size, num_cycles, num_rounds, num_rep, num_checks,
 #        num_logicals, sampler, d1_static, d2_static)
+def _window_commit(state, m, d1_static, carry, syn_j):
+    """One window's decode + overlap-commit
+    (src/Simulators_SpaceTime.py:969-1006): fold the accumulated space
+    correction into the window's first detector slice, decode, and push the
+    window's correction forward through ``h1_space_cor`` / ``L1``.
+
+    Shared verbatim by the whole-history scan below and the streaming
+    driver (sim/stream_spacetime.py), so the windowed step is the same
+    program either way.  Returns the new carry plus the window's fault
+    corrections."""
+    total_space, total_log = carry
+    syn = syn_j.at[:, :m].set(syn_j[:, :m] ^ total_space)
+    cor, _ = decode_device(d1_static, state["d1"], syn)
+    total_space = total_space ^ gf2_matmul(cor, state["h1_space_cor_t"])
+    total_log = total_log ^ gf2_matmul(cor, state["L1_t"])
+    return (total_space, total_log), cor
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _windows_decode(cfg, state, key):
     """Sliding-window decode (src/Simulators_SpaceTime.py:969-1006) as a
@@ -71,12 +90,8 @@ def _windows_decode(cfg, state, key):
     final_syn_raw = hist[:, -1]
 
     def window_step(carry, syn_j):
-        total_space, total_log = carry
-        syn = syn_j.at[:, :m].set(syn_j[:, :m] ^ total_space)
-        cor, _ = decode_device(d1_static, state["d1"], syn)
-        total_space = total_space ^ gf2_matmul(cor, state["h1_space_cor_t"])
-        total_log = total_log ^ gf2_matmul(cor, state["L1_t"])
-        return (total_space, total_log), None
+        carry, _cor = _window_commit(state, m, d1_static, carry, syn_j)
+        return carry, None
 
     init = (
         jnp.zeros((batch_size, m), jnp.uint8),
@@ -139,10 +154,7 @@ class CodeSimulator_Circuit_SpaceTime:
         self.min_logical_weight = self.N
         self.num_cycles = int(num_cycles)
         self.num_rep = int(num_rep)
-        self.num_rounds = int((self.num_cycles - 1) / self.num_rep)
-        assert abs((self.num_cycles - 1) / self.num_rep - self.num_rounds) <= 1e-2, (
-            "num_cycles - 1 must be a multiple of num_rep"
-        )
+        self.num_rounds = st_window_count(self.num_cycles, self.num_rep)
         self.error_params = error_params
         self.batch_size = int(batch_size)
         self._base_key = jax.random.PRNGKey(seed)

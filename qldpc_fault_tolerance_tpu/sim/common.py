@@ -52,7 +52,60 @@ __all__ = [
     "fused_cell_stream",
     "fused_cell_adaptive",
     "LTYPE_CODES",
+    "st_round_counts",
+    "st_window_count",
 ]
+
+
+def st_round_counts(num_cycles: int, num_rep: int) -> tuple[int, int]:
+    """Phenomenological space-time round bookkeeping: how many windowed
+    rounds cover ``num_cycles`` noisy cycles (final perfect cycle included),
+    and how many cycles those rounds actually realize.
+
+    The reference computes ``int((num_cycles - 1) / num_rep + 1)``
+    (src/Simulators_SpaceTime.py:531-548) — a float division whose
+    truncation silently drifts for large cycle counts (the float rounds
+    *up* across a representability boundary, so the normalization cycle
+    count is off by one and the per-cycle WER inversion wobbles in its
+    last parity bit).  Integer arithmetic is exact at every size and
+    identical to the reference everywhere floats are exact.
+    """
+    num_cycles = int(num_cycles)
+    num_rep = int(num_rep)
+    if num_cycles < 1 or num_rep < 1:
+        raise ValueError(
+            f"need num_cycles >= 1 and num_rep >= 1, got "
+            f"num_cycles={num_cycles}, num_rep={num_rep}")
+    num_rounds = (num_cycles - 1) // num_rep + 1
+    total_num_cycles = (num_rounds - 1) * num_rep + 1
+    return num_rounds, total_num_cycles
+
+
+def st_window_count(num_cycles: int, num_rep: int) -> int:
+    """Circuit-level space-time window count: ``num_cycles`` holds
+    ``num_rounds`` windows of ``num_rep`` noisy cycles plus one final
+    perfect cycle, so ``num_cycles - 1`` must divide evenly.
+
+    Replaces the reference's float assert
+    (``abs((num_cycles-1)/num_rep - int(...)) <= 1e-2``,
+    src/Simulators_SpaceTime.py:727-730): for ``num_rep > 100`` a
+    non-multiple slips under the 1e-2 tolerance and the trailing cycles
+    are silently dropped from the window scan — an off-by-one that only
+    shows up as a parity wobble in the detector accounting.
+    """
+    num_cycles = int(num_cycles)
+    num_rep = int(num_rep)
+    if num_cycles < 1 or num_rep < 1:
+        raise ValueError(
+            f"need num_cycles >= 1 and num_rep >= 1, got "
+            f"num_cycles={num_cycles}, num_rep={num_rep}")
+    num_rounds, rem = divmod(num_cycles - 1, num_rep)
+    if rem:
+        raise ValueError(
+            f"num_cycles - 1 must be a multiple of num_rep "
+            f"(got num_cycles={num_cycles}, num_rep={num_rep}, "
+            f"remainder {rem})")
+    return num_rounds
 
 
 def accumulate_device(step_fn, keys, combine):

@@ -32,6 +32,7 @@ from .common import (
     accumulate_device,
     mesh_batch_stats,
     record_wer_run,
+    st_round_counts,
     wer_per_cycle,
     windowed_count,
 )
@@ -69,6 +70,26 @@ def _sub_round(cfg, state, carry, key, batch_size):
     return (cur_x[:, :n], cur_z[:, :n]), (synd_z, synd_x)
 
 
+def _window_commit(cfg, state, carry, hist_z, hist_x):
+    """Joint space-time decode of one window's stacked syndromes and the
+    commit that folds the corrections into the residual-error carry
+    (src/Simulators_SpaceTime.py:471-481).
+
+    Shared verbatim by the batch round scan below and the streaming driver
+    (sim/stream_spacetime.py), so windowed overlap-commit decode is the
+    same program as whole-history decode.  Returns the new carry plus the
+    committed per-window data corrections."""
+    # difference consecutive Z slices; X left raw (reference quirk)
+    det_z = jnp.concatenate(
+        [hist_z[:, :1], hist_z[:, 1:] ^ hist_z[:, :-1]], axis=1
+    )
+    det_x = hist_x
+    cor_z, _ = decode_device(cfg[5], state["d1z"], det_z)
+    cor_x, _ = decode_device(cfg[4], state["d1x"], det_x)
+    data_x, data_z = carry
+    return (data_x ^ cor_x, data_z ^ cor_z), (cor_x, cor_z)
+
+
 def _round_step(cfg, state, carry, key, batch_size):
     """One window: num_rep sub-rounds, then a joint space-time decode
     (src/Simulators_SpaceTime.py:454-481)."""
@@ -80,15 +101,8 @@ def _round_step(cfg, state, carry, key, batch_size):
     # (num_rep, B, m) -> (B, num_rep, m)
     hist_z = jnp.swapaxes(hist_z, 0, 1)
     hist_x = jnp.swapaxes(hist_x, 0, 1)
-    # difference consecutive Z slices; X left raw (reference quirk)
-    det_z = jnp.concatenate(
-        [hist_z[:, :1], hist_z[:, 1:] ^ hist_z[:, :-1]], axis=1
-    )
-    det_x = hist_x
-    cor_z, _ = decode_device(cfg[5], state["d1z"], det_z)
-    cor_x, _ = decode_device(cfg[4], state["d1x"], det_x)
-    data_x, data_z = carry
-    return (data_x ^ cor_x, data_z ^ cor_z)
+    carry, _cors = _window_commit(cfg, state, carry, hist_z, hist_x)
+    return carry
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
@@ -297,8 +311,8 @@ class CodeSimulator_Phenon_SpaceTime:
     def _word_error_rate(self, num_cycles: int, num_samples: int, key=None):
         apply_worker_batch_fence(self)
         self._assert_window_decoders_device()
-        num_rounds = int((num_cycles - 1) / self.num_rep + 1)
-        total_num_cycles = (num_rounds - 1) * self.num_rep + 1
+        num_rounds, total_num_cycles = st_round_counts(num_cycles,
+                                                       self.num_rep)
         if key is None:
             self._base_key, key = jax.random.split(self._base_key)
         # active resilience policy: transient faults retry bit-exact (the

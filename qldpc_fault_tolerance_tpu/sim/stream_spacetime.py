@@ -1,0 +1,172 @@
+"""Streaming space-time decode: sliding-window overlap-commit drivers.
+
+The batch space-time engines (sim/phenom_spacetime.py,
+sim/circuit_spacetime.py) decode a fixed number of cycles in one shot —
+serving an unbounded syndrome stream that way costs O(T) whole-history
+re-decode per update.  The drivers here run the SAME window step the batch
+engines use (the shared ``_window_commit`` bodies), one fixed-shape jitted
+program per step, so:
+
+  * per-commit cost is O(window) regardless of how long the stream runs;
+  * one compile serves every step (zero warm-path retraces by construction);
+  * the carry after k streamed windows is bit-exact vs the batch engine's
+    whole-history decode of k windows on the same shots — the streaming
+    step IS the batch step, extracted, with the same key schedule
+    (``fold_in(key, i)``) / window slicing.
+
+Window/commit structure: a "window" is ``num_rep`` cycles decoded jointly
+over the extended block-bidiagonal ``[H|I]`` matrix; committing the window
+folds its corrections into the boundary carry (phenom: the residual-error
+Pauli frame; circuit: the accumulated space/logical corrections) which
+adjusts the next window's first detector slice — the overlap between
+consecutive windows.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..decoders.bp_decoders import decode_device
+from . import circuit_spacetime as _cst
+from . import phenom_spacetime as _pst
+from .common import st_round_counts, st_window_count
+
+__all__ = [
+    "PhenomStreamDriver",
+    "CircuitStreamDriver",
+    "st_round_counts",
+    "st_window_count",
+]
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _phenom_stream_step(cfg, state, carry, key):
+    """One streamed phenom window: the literal body of
+    phenom_spacetime._round_step, returning the committed corrections too.
+    Fixed shapes (batch, window) -> one executable serves every step."""
+    batch_size, num_rep = cfg[0], cfg[2]
+    keys = jax.random.split(key, num_rep)
+    carry, (hist_z, hist_x) = jax.lax.scan(
+        lambda c, k: _pst._sub_round(cfg, state, c, k, batch_size), carry, keys
+    )
+    # (num_rep, B, m) -> (B, num_rep, m)
+    hist_z = jnp.swapaxes(hist_z, 0, 1)
+    hist_x = jnp.swapaxes(hist_x, 0, 1)
+    return _pst._window_commit(cfg, state, carry, hist_z, hist_x)
+
+
+@functools.partial(jax.jit, static_argnames=("m", "d1_static"))
+def _circuit_stream_step(state, m, d1_static, carry, syn_j):
+    """One streamed circuit window: the literal scan body of
+    circuit_spacetime._windows_decode as a standalone fixed-shape program."""
+    return _cst._window_commit(state, m, d1_static, carry, syn_j)
+
+
+class PhenomStreamDriver:
+    """Streaming driver over ``CodeSimulator_Phenon_SpaceTime``.
+
+    ``step()`` samples, decodes, and commits one window of ``num_rep``
+    cycles using the same ``fold_in(key, i)`` schedule as the batch
+    ``_noisy_rounds`` fori_loop, so after k steps ``carry`` equals
+    ``_noisy_rounds(cfg, state, key, num_rounds=k+1)`` bit-exactly on the
+    same key.  ``finalize(key)`` runs the perfect final round and returns
+    per-shot failure flags, completing the ``run_batch`` contract.
+    """
+
+    def __init__(self, sim, batch_size: int | None = None):
+        sim._assert_window_decoders_device()
+        self.sim = sim
+        self.batch_size = int(batch_size or sim.batch_size)
+        self._cfg = sim._cfg(self.batch_size)
+        self.reset(jax.random.PRNGKey(0))
+
+    def reset(self, key):
+        b, n = self.batch_size, self.sim.N
+        self.key = key
+        self.carry = (
+            jnp.zeros((b, n), jnp.uint8),
+            jnp.zeros((b, n), jnp.uint8),
+        )
+        self.committed_rounds = 0
+        return self
+
+    @property
+    def committed_cycles(self) -> int:
+        return self.committed_rounds * self.sim.num_rep
+
+    def step(self):
+        """Commit the next window; returns its (cor_x, cor_z) corrections."""
+        k = jax.random.fold_in(self.key, self.committed_rounds)
+        self.carry, cors = _phenom_stream_step(
+            self._cfg, self.sim._dev_state, self.carry, k
+        )
+        self.committed_rounds += 1
+        return cors
+
+    def finalize(self, key) -> np.ndarray:
+        """Perfect final round on the streamed carry -> failure flags."""
+        data_x, data_z = self.carry
+        pending = self.sim._final_round(key, data_x, data_z, self.batch_size)
+        return np.asarray(self.sim._finish_batch(pending))
+
+
+class CircuitStreamDriver:
+    """Streaming driver over ``CodeSimulator_Circuit_SpaceTime``.
+
+    The caller feeds per-window detector slices (shape
+    ``(batch, num_rep * m)``, exactly the rows the batch engine's window
+    scan consumes); each ``step`` decodes one window and commits it into
+    the (space correction, logical correction) carry.  After k steps the
+    carry is bit-exact vs the batch ``_windows_decode`` scan over the same
+    k windows.  ``finalize`` folds the carry into the final detector slice
+    and runs the final-layer decode.
+    """
+
+    def __init__(self, sim, batch_size: int | None = None):
+        sim._ensure_ready()
+        sim._assert_window_decoder_device()
+        self.sim = sim
+        self.batch_size = int(batch_size or sim.batch_size)
+        self.m = sim.num_checks
+        self._d1_static = sim.decoder1_z.device_static
+        self.reset()
+
+    def reset(self):
+        b = self.batch_size
+        self.carry = (
+            jnp.zeros((b, self.m), jnp.uint8),
+            jnp.zeros((b, self.sim.num_logicals), jnp.uint8),
+        )
+        self.committed_windows = 0
+        return self
+
+    @property
+    def committed_cycles(self) -> int:
+        return self.committed_windows * self.sim.num_rep
+
+    def step(self, window):
+        """Commit one window of detector data; returns its fault corrections."""
+        syn_j = jnp.asarray(window, jnp.uint8)
+        if syn_j.shape != (self.batch_size, self.sim.num_rep * self.m):
+            raise ValueError(
+                f"window shape {syn_j.shape} != "
+                f"{(self.batch_size, self.sim.num_rep * self.m)}")
+        self.carry, cor = _circuit_stream_step(
+            self.sim._dev_state, self.m, self._d1_static, self.carry, syn_j
+        )
+        self.committed_windows += 1
+        return cor
+
+    def finalize(self, final_syn_raw):
+        """Final-layer decode on the streamed carry; returns
+        (total_log, final_syn, final_cor, final_aux) — the same pending
+        tuple tail the batch engine's ``_windows_decode`` produces."""
+        total_space, total_log = self.carry
+        final_syn = jnp.asarray(final_syn_raw, jnp.uint8) ^ total_space
+        final_cor, final_aux = decode_device(
+            self.sim.decoder2_z.device_static, self.sim._dev_state["d2"],
+            final_syn)
+        return total_log, final_syn, final_cor, final_aux

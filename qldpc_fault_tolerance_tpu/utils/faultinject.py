@@ -50,7 +50,12 @@ Fault kinds:
       - ``mesh_device_loss`` raise ``resilience.MeshDeviceLoss``
                            (classified "resource": retrying the same mesh
                            cannot help, replanning onto surviving devices
-                           can) — the elastic mesh-degrade primitive.
+                           can) — the elastic mesh-degrade primitive;
+      - ``stream_kill``    the server kills a stream step mid-window: the
+                           in-flight (uncommitted) window is dropped and
+                           the connection hard-closes — the client must
+                           resume from the last committed cycle via the
+                           ``stream_commit`` watermark, exactly once.
 
 All literal site names live in the ``SITES`` table below; qldpc-lint rule
 R008 pins that every ``faultinject.site("...")`` literal in the package is
@@ -109,6 +114,7 @@ SITES = {
     "serve_fused_dispatch": "serve/scheduler.py cross-session fused dispatch",
     "serve_conn_rx": "serve/server.py per-received-frame (network chaos)",
     "serve_respond": "serve/server.py before a response frame is written",
+    "serve_stream_step": "serve/server.py stream chunk, before decode/commit",
 }
 
 
@@ -126,7 +132,7 @@ class Fault:
 
     KINDS = ("raise", "deterministic", "stall", "truncate",
              "conn_drop", "torn_frame", "session_evict", "device_restart",
-             "mesh_device_loss")
+             "mesh_device_loss", "stream_kill")
 
     def __init__(self, site: str, kind: str = "raise", after: int = 0,
                  count: int = 1, stall_s: float = 0.25,

@@ -430,7 +430,13 @@ def event(kind: str, **fields) -> None:
 # ``family``, serve_session ``sharded``/``lanes``/``family``).  The
 # v1..v4 kind sets are frozen below; the back-compat test chain extends
 # to all four.
-EVENT_SCHEMA_VERSION = 5
+#
+# v6 (ISSUE 16): streaming decode adds the stream lifecycle events —
+# ``stream_open`` (one per overlap-commit stream opened on the server),
+# ``stream_close`` (client close or server shutdown, with the final
+# commit watermark) and ``stream_shed`` (the streaming SLO rung dropped
+# the WHOLE stream under burn-rate pressure).  v1..v5 are frozen below.
+EVENT_SCHEMA_VERSION = 6
 
 # the v1 kind set, frozen for the back-compat guarantee: these kinds and
 # their required fields must keep validating across schema bumps
@@ -456,8 +462,12 @@ _V3_EVENT_KINDS = frozenset({"rare_stratum"})
 _V4_EVENT_KINDS = frozenset({"trace", "slo_alert", "process_info"})
 
 # the v5 additions (ISSUE 15 serving scaling half), frozen with the same
-# guarantee for the eventual v6 bump
+# guarantee at the v6 bump
 _V5_EVENT_KINDS = frozenset({"scale_event"})
+
+# the v6 additions (ISSUE 16 streaming decode), frozen with the same
+# guarantee for the eventual v7 bump
+_V6_EVENT_KINDS = frozenset({"stream_open", "stream_close", "stream_shed"})
 
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
@@ -651,6 +661,26 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "from_value": _NUM, "to_value": _NUM,
                      "queue_depth": int, "queued_shots": int,
                      "burn_rate": _NUM, "reason": str},
+    },
+    # --- v6: streaming decode (ISSUE 16) ----------------------------------
+    # one per overlap-commit stream opened on the serve front-end
+    # (serve.server.DecodeServer._stream_open)
+    "stream_open": {
+        "required": {"stream": str, "session": str},
+        "optional": {"tenant": str, "lanes": int, "width": int,
+                     "cycles_per_window": int},
+    },
+    # stream retirement — client close ("client") or server shutdown
+    # ("shutdown") — with the final commit watermark
+    "stream_close": {
+        "required": {"stream": str, "committed": int},
+        "optional": {"committed_cycles": int, "reason": str},
+    },
+    # the streaming SLO rung: burn-rate pressure shed the WHOLE stream
+    # (its state dropped, subsequent chunks answer unknown-stream)
+    "stream_shed": {
+        "required": {"stream": str, "tenant": str},
+        "optional": {"committed": int, "burn_rate": _NUM, "signal": str},
     },
     # environment provenance, once per telemetry enable (and embedded in
     # every RunLedger record): lets sweep_dashboard --drift and

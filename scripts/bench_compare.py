@@ -100,12 +100,23 @@ GATED_FIELDS = (
     # checked-in r01-r05 history gates unchanged.
     "wire_ab.packed_bytes_per_req",
     "fused_ab.fused_req_per_s",
+    # streaming decode (bench.py stream, ISSUE 16): sustained committed
+    # cycles/s per stream gates as a rate; the p99 commit latency gates on
+    # INCREASES; the windowed-vs-whole A/B's compute-per-committed-cycle
+    # advantage must not erode (the >=5x acceptance floor is enforced by
+    # the bench round's own gates block — here it gates round-to-round).
+    # Rounds before r16 lack the keys, so the checked-in history gates
+    # unchanged.
+    "stream.cycles_per_s",
+    "stream.ab_compute_per_cycle_ratio",
+    "stream.p99_commit_ms",
 )
 
 # gated fields where a RISE is the regression (latencies, host round-trips)
 LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms",
                                     "bposd.host_round_trips",
-                                    "wire_ab.packed_bytes_per_req"})
+                                    "wire_ab.packed_bytes_per_req",
+                                    "stream.p99_commit_ms"})
 
 
 def _dig(d: dict, dotted: str):

@@ -229,6 +229,16 @@ def summary_from_state(state: dict) -> dict:
             and name.endswith(".requests")
         },
     }
+    # streaming decode counters (ISSUE 16): rendered, not silently dropped
+    stream = {
+        "opens": _metric(snap, "stream.opens"),
+        "commits": _metric(snap, "stream.commits"),
+        "cycles": _metric(snap, "stream.cycles"),
+        "replays": _metric(snap, "stream.replays"),
+        "shed": _metric(snap, "stream.shed"),
+        "protocol_errors": _metric(snap, "stream.protocol_errors"),
+        "open_streams": _metric(snap, "stream.open_streams"),
+    }
     spans = {
         name[len("span."):-len(".seconds")]: m
         for name, m in snap.items()
@@ -262,6 +272,7 @@ def summary_from_state(state: dict) -> dict:
             "host_round_trips": _metric(snap, "osd.host_round_trips"),
         },
         "serve": serve,
+        "stream": stream,
         "jax": {
             "retraces": compile_stats.get(
                 "jax.retraces", _metric(snap, "jax.retraces")),
@@ -404,6 +415,18 @@ def render(summary: dict, title: str = "") -> str:
                  f"{srv['session_evictions']} evictions)")
         for tenant, n in sorted(srv.get("tenants", {}).items()):
             L.append(f"  {'tenant ' + tenant:<22}{n}")
+    stm = s.get("stream") or {}
+    if stm.get("opens") or stm.get("commits"):
+        L.append("-- stream (overlap-commit decode) --")
+        L.append(f"  {'streams opened':<22}{stm['opens']}"
+                 f"  ({stm['open_streams']} still open)")
+        L.append(f"  {'windows committed':<22}{stm['commits']}"
+                 f"  ({stm['cycles']} cycles)")
+        if stm.get("replays"):
+            L.append(f"  {'replayed seqs':<22}{stm['replays']}")
+        if stm.get("shed") or stm.get("protocol_errors"):
+            L.append(f"  {'shed / proto errors':<22}{stm['shed']}"
+                     f" / {stm['protocol_errors']}")
     osd = s["osd"]
     L.append("-- osd --")
     L.append(f"  {'invocations':<22}{osd['invocations']}")

@@ -29,13 +29,19 @@ if REPO_ROOT not in sys.path:
     sys.path.insert(0, REPO_ROOT)
 
 from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
-from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class, BPDecoder
+from qldpc_fault_tolerance_tpu.decoders import (
+    BP_Decoder_Class,
+    BPDecoder,
+    ST_BP_Decoder_Class,
+)
 from qldpc_fault_tolerance_tpu.parallel import shot_mesh
 from qldpc_fault_tolerance_tpu.serve import (
     ContinuousBatcher,
     DecodeClient,
     DecodeSession,
     HealthProbe,
+    SLOEngine,
+    SLOPolicy,
     start_ops_thread,
     start_server_thread,
 )
@@ -987,3 +993,124 @@ def test_seeded_random_schedule_invariants(seed):
     finally:
         probe.stop()
         handle.stop(drain=True)
+
+
+# ---------------------------------------------------------------------------
+# Streaming decode under chaos (ISSUE 16)
+# ---------------------------------------------------------------------------
+ST_CLS = ST_BP_Decoder_Class(2, "minimum_sum", 0.625)
+ST_W = 3
+ST_PARAMS = {"h": CODE3.hx, "p_data": P, "p_syndrome": True,
+             "num_rep": ST_W}
+
+
+def _st_stream_session(lanes=4):
+    return DecodeSession("st3", decoder_class=ST_CLS, params=ST_PARAMS,
+                         buckets=(lanes, 4 * lanes))
+
+
+def test_stream_kill_mid_window_resumes_from_committed_exactly_once():
+    """stream_kill chaos: the connection dies mid-window (chunk read,
+    nothing committed).  The reconnecting client retries the SAME seq;
+    the commit ledger lands every window exactly once — the resumed
+    stream's corrections are bit-exact vs the offline windowed decode,
+    the commit counter equals the window count (no double-commit), and
+    the watermark agrees."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    lanes, T = 4, 6
+    sess = _st_stream_session(lanes)
+    bat = ContinuousBatcher({"st3": sess}, max_batch_shots=64,
+                            max_wait_s=0.002)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="serve_stream_step", kind="stream_kill",
+                               after=2)])
+        rng = np.random.default_rng(21)
+        offline = ST_CLS.GetDecoder(ST_PARAMS)
+        with plan.active():
+            with DecodeClient(host, port, reconnect=True,
+                              timeout=30.0) as cli:
+                ack = cli.stream_open("st3", lanes=lanes)
+                sid, width = ack["stream"], ack["width"]
+                for seq in range(1, T + 1):
+                    chunk = (rng.random((lanes, width)) < P)\
+                        .astype(np.uint8)
+                    # stream_step retries the same seq through the
+                    # reconnect; a killed attempt was never committed, a
+                    # committed-but-unanswered one replays from cache
+                    res = cli.stream_step(sid, seq, chunk)
+                    assert res.get("ok"), res
+                    assert res["committed"] == seq
+                    ref = offline.decode_batch(
+                        chunk.reshape(lanes, ST_W, -1))
+                    assert np.array_equal(
+                        np.asarray(res["corrections"], np.uint8),
+                        np.asarray(ref, np.uint8)), f"seq {seq}"
+                wm = cli.stream_commit(sid)
+                assert wm["committed"] == T
+                assert wm["committed_cycles"] == T * ST_W
+                cli.stream_commit(sid, close=True)
+        assert _counter("faultinject.stream_kill") >= 1
+        assert _counter("serve.client.reconnects") >= 1
+        # exactly-once: every window committed once, none twice
+        assert _counter("stream.commits") == T
+        assert _counter("stream.cycles") == T * ST_W
+        assert bat.failed == 0
+    finally:
+        handle.stop(drain=True)
+
+
+def test_slo_burn_sheds_whole_stream_with_structured_error():
+    """The streaming SLO rung: burn-rate pressure sheds the WHOLE stream
+    — the chunk gets a structured shed response, a ``stream_shed`` event
+    fires (schema-valid), the stream's state is dropped, and subsequent
+    chunks answer "unknown stream" instead of half-serving a backlog the
+    tenant's budget can't pay for."""
+    resilience.set_default_policy(TRIVIAL_POLICY)
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    lanes = 4
+    sess = _st_stream_session(lanes)
+    slo = SLOEngine(SLOPolicy(latency_target_s=0.01, min_requests=5,
+                              eval_interval_s=0.0))
+    # pre-burn the default tenant far past the shed threshold: every
+    # observed request blew the 10ms target (timestamps pinned near the
+    # server's monotonic clock so the window is live at admission time)
+    now0 = time.monotonic()
+    for i in range(10):
+        slo.observe_request("default", 0.5, ok=True,
+                            now=now0 + i * 0.001)
+    slo.evaluate(now=now0 + 0.1)
+    bat = ContinuousBatcher({"st3": sess}, max_batch_shots=64,
+                            max_wait_s=0.002, slo=slo)
+    handle = start_server_thread(bat)
+    try:
+        host, port = handle.address
+        rng = np.random.default_rng(23)
+        with DecodeClient(host, port, reconnect=True,
+                          timeout=30.0) as cli:
+            ack = cli.stream_open("st3", lanes=lanes)
+            sid, width = ack["stream"], ack["width"]
+            chunk = (rng.random((lanes, width)) < P).astype(np.uint8)
+            res = cli.stream_step(sid, 1, chunk)
+            assert res.get("shed") and res.get("stream_shed"), res
+            assert not res.get("ok")
+            assert res["committed"] == 0
+            # the stream is gone, not half-alive
+            gone = cli.stream_step(sid, 2, chunk)
+            assert gone.get("stream_unknown"), gone
+        assert _counter("stream.shed") == 1
+        assert _counter("stream.commits") == 0
+        shed_events = [r for r in sink.records
+                       if r.get("kind") == "stream_shed"]
+        assert len(shed_events) == 1
+        assert telemetry.validate_event(shed_events[0]) == []
+        assert shed_events[0]["stream"] == sid
+        assert shed_events[0]["signal"] == "shed"
+    finally:
+        handle.stop(drain=True)
+        telemetry.remove_sink(sink)

@@ -755,7 +755,7 @@ def test_v5_schema_backcompat_chain():
         assert not (s & seen)  # pairwise disjoint
         assert s <= set(telemetry.EVENT_SCHEMAS)
         seen |= s
-    assert telemetry.EVENT_SCHEMA_VERSION == 5
+    assert telemetry.EVENT_SCHEMA_VERSION >= 5
     samples = {
         "scale_event": {"action": "grow_batch", "target":
                         "max_batch_shots", "from_value": 128,
