@@ -654,6 +654,76 @@ def mode_bp():
     else:
         diag_block = {"skipped": "BENCH_DIAG=0"}
 
+    # time-series scraper A/B arm — the <2% overhead acceptance gate of
+    # ISSUE 17's fleet observability plane.  The scraper + alert engine
+    # ride the telemetry registry, so BOTH arms run telemetry-enabled (the
+    # switch's own cost is gated by the telemetry arm above); the toggled
+    # part is a live background Scraper on an aggressive 50 ms interval
+    # with the default alert rules evaluated on every tick — 100x the
+    # production 5 s cadence, so a pass here bounds the real deployment
+    # with margin.  Same order-alternating min-of-4 protocol as the other
+    # arms.  BENCH_TS=0 skips.
+    from qldpc_fault_tolerance_tpu.serve import ops as _ops
+    from qldpc_fault_tolerance_tpu.utils import timeseries as _ts
+
+    if os.environ.get("BENCH_TS", "1") != "0":
+        times_tsoff, times_tson, wer_ts = [], [], None
+        scraper = _ts.Scraper(interval_s=0.05, retention=4096)
+        engine = _ops.AlertEngine(rules=_ops.default_alert_rules(0.05))
+        engine.attach(scraper)
+        try:
+            with _no_env_jsonl():
+                telemetry.reset()
+                telemetry.enable()
+                # warm: the telemetry-enabled program variant is already
+                # compiled by the telemetry arm; one rep settles caches
+                sim.WordErrorRate(shots, key=jax.random.fold_in(key, 0))
+
+                def _rep_ts(arm_on: bool):
+                    nonlocal wer_ts
+                    if arm_on:
+                        scraper.start()
+                    try:
+                        t0 = time.perf_counter()
+                        wer = sim.WordErrorRate(
+                            shots, key=jax.random.fold_in(key, 1))
+                        dt = time.perf_counter() - t0
+                    finally:
+                        if arm_on:
+                            scraper.stop()
+                    (times_tson if arm_on else times_tsoff).append(dt)
+                    if arm_on:
+                        wer_ts = wer
+
+                for rep in range(4):
+                    first, second = ((False, True) if rep % 2 == 0
+                                     else (True, False))
+                    _rep_ts(first)
+                    _rep_ts(second)
+                # counters survive disable(): snapshot() reads the registry
+                # regardless of the switch
+                n_scrapes = telemetry.snapshot().get(
+                    "timeseries.scrapes", {}).get("value", 0)
+        finally:
+            scraper.stop()
+            telemetry.disable()
+        rate_tsoff = shots / min(times_tsoff)
+        rate_tson = shots / min(times_tson)
+        ts_block = {
+            "scraper_on_shots_per_s": round(rate_tson, 1),
+            "scraper_off_shots_per_s": round(rate_tsoff, 1),
+            "overhead_pct": round(
+                (rate_tsoff - rate_tson) / rate_tsoff * 100, 2),
+            "wer_bitexact_vs_off": bool(
+                wer_ts[0] == wer_main[0] and wer_ts[1] == wer_main[1]),
+            "scrape_interval_s": 0.05,
+            "scrapes": int(n_scrapes),
+            "alert_rules": len(engine.rules()),
+            "alerts_firing": engine.firing(),
+        }
+    else:
+        ts_block = {"skipped": "BENCH_TS=0"}
+
     # --- BP kernel v1/v2 A/B arm (ISSUE 9): same sim config + key, the
     # decoders pinned to each Pallas generation (dense one-hot stack vs
     # sparse index-gather incidence).  The two kernels share one arithmetic
@@ -829,6 +899,7 @@ def mode_bp():
         "telemetry": tele_block,
         "resilience": res_block,
         "diagnostics": diag_block,
+        "timeseries_ab": ts_block,
         **prof_blocks,
         **out_ab,
         **_bp_utilization(dec_x, dec_z, code, p, rate,

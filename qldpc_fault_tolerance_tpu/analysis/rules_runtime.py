@@ -43,6 +43,7 @@ DEFAULT_FROZEN_FLOORS = {
     "_V4_EVENT_KINDS": 3,
     "_V5_EVENT_KINDS": 1,
     "_V6_EVENT_KINDS": 3,
+    "_V7_EVENT_KINDS": 2,
 }
 
 

@@ -46,7 +46,14 @@ request-driven decoder service:
                 incidents + device-reset epochs into background session
                 heals; AutoScaler (ISSUE 15) — the control loop ACTING on
                 the admission signals: batch-target resize + mesh
-                shard/retire with versioned scale_event telemetry.
+                shard/retire with versioned scale_event telemetry;
+                AlertEngine (ISSUE 17) — declarative threshold/deadman
+                rules over the utils.timeseries store, evaluated on the
+                scrape tick, /alertz + schema-v7 transition events.
+  fleet.py      federation gateway (ISSUE 17): scrapes N ops endpoints,
+                merges counters bit-exactly / histogram buckets
+                additively with per-host labels, re-serves fleet
+                /metrics /healthz /alertz; host-down is a deadman alert.
 
 Per-request observability (ISSUE 11): trace contexts ride an optional
 wire-frame field end to end (utils.tracing) — queue_wait / batch_assemble
@@ -70,6 +77,8 @@ from .session import (
 from .scheduler import ContinuousBatcher, DecodeResult, assemble_round_robin
 from .ops import (
     AdmissionError,
+    AlertEngine,
+    AlertRule,
     AutoScaler,
     HealthProbe,
     OpsHandle,
@@ -77,8 +86,10 @@ from .ops import (
     ScalePolicy,
     SLOEngine,
     SLOPolicy,
+    default_alert_rules,
     start_ops_thread,
 )
+from .fleet import FleetGateway, FleetHandle, FleetServer, start_fleet_thread
 from .server import DecodeServer, ServerHandle, start_server_thread
 from .client import ClientResult, DecodeClient
 
@@ -93,6 +104,8 @@ __all__ = [
     "DecodeResult",
     "assemble_round_robin",
     "AdmissionError",
+    "AlertEngine",
+    "AlertRule",
     "AutoScaler",
     "ScalePolicy",
     "HealthProbe",
@@ -100,7 +113,12 @@ __all__ = [
     "OpsServer",
     "SLOEngine",
     "SLOPolicy",
+    "default_alert_rules",
     "start_ops_thread",
+    "FleetGateway",
+    "FleetHandle",
+    "FleetServer",
+    "start_fleet_thread",
     "DecodeServer",
     "ServerHandle",
     "start_server_thread",

@@ -48,7 +48,7 @@ import threading
 import time
 import urllib.parse
 
-from ..utils import resilience, telemetry, tracing
+from ..utils import resilience, telemetry, timeseries, tracing
 
 __all__ = [
     "AdmissionError",
@@ -57,6 +57,9 @@ __all__ = [
     "SLOPolicy",
     "SLOEngine",
     "HealthProbe",
+    "AlertRule",
+    "AlertEngine",
+    "default_alert_rules",
     "OpsServer",
     "OpsHandle",
     "spawn_server_loop",
@@ -608,6 +611,9 @@ class HealthProbe:
         (empty = healthy).  A failed heal keeps its session in the
         pending map, so the NEXT pass retries it — the signals are
         consumed here, but the obligation only clears on success."""
+        # probe-liveness heartbeat: the deadman alert kind watches this
+        # counter move, so a wedged/dead probe thread becomes an alert
+        telemetry.count("serve.probe_passes")
         for inc in self.batcher.take_incidents():
             # deterministic failures are program bugs — recompiling the
             # same program against the same state cannot fix them
@@ -666,6 +672,235 @@ class HealthProbe:
 
 
 # ---------------------------------------------------------------------------
+# Alert-rules engine (ISSUE 17)
+# ---------------------------------------------------------------------------
+@dataclasses.dataclass
+class AlertRule:
+    """One declarative alert rule over the time-series store.
+
+    ``kind="threshold"``: derive a number from ``metric`` per ``mode`` —
+    ``"value"`` (last sample), ``"rate"`` (counter rate over ``window_s``)
+    or ``"quantile"`` (windowed histogram quantile ``q``) — and compare it
+    to ``threshold`` with ``op``.  The condition must hold ``for_s``
+    seconds of scrape ticks before the alert fires (a blip shorter than
+    ``for_s`` never pages).
+
+    ``kind="deadman"``: the inverse — fire when ``metric`` has NOT changed
+    (counter moved / gauge re-set / histogram observed) within ``window_s``.
+    A metric never seen at all is a missing heartbeat, not a healthy one.
+    ``threshold``/``op``/``mode``/``q`` are ignored for deadman rules.
+    """
+
+    name: str
+    metric: str
+    kind: str = "threshold"      # "threshold" | "deadman"
+    mode: str = "value"          # "value" | "rate" | "quantile"
+    q: float = 0.99
+    window_s: float = 60.0
+    op: str = ">"                # ">" | ">=" | "<" | "<="
+    threshold: float = 0.0
+    for_s: float = 0.0
+    severity: str = "warning"    # "info" | "warning" | "critical"
+
+    _OPS = {">": lambda v, t: v > t, ">=": lambda v, t: v >= t,
+            "<": lambda v, t: v < t, "<=": lambda v, t: v <= t}
+
+    def __post_init__(self):
+        if self.kind not in ("threshold", "deadman"):
+            raise ValueError(f"rule {self.name!r}: unknown kind "
+                             f"{self.kind!r}")
+        if self.kind == "threshold" and self.mode not in (
+                "value", "rate", "quantile"):
+            raise ValueError(f"rule {self.name!r}: unknown mode "
+                             f"{self.mode!r}")
+        if self.op not in self._OPS:
+            raise ValueError(f"rule {self.name!r}: unknown op {self.op!r}")
+
+    def observe(self, store, now):
+        """(condition_breached, observed_value) against ``store`` at
+        ``now``.  For threshold rules a metric with no derivable value is
+        healthy (a rule on traffic that never started must not page); for
+        deadman rules the observed value is the heartbeat age and None IS
+        the breach."""
+        if self.kind == "deadman":
+            age = store.age(self.metric, now=now)
+            return (age is None or age > self.window_s), age
+        if self.mode == "rate":
+            v = store.rate(self.metric, self.window_s, now=now)
+        elif self.mode == "quantile":
+            v = store.quantile(self.metric, self.q, self.window_s, now=now)
+        else:
+            v = store.last_value(self.metric)
+        if v is None:
+            return False, None
+        return self._OPS[self.op](float(v), self.threshold), v
+
+
+def default_alert_rules(
+        scrape_interval_s: float = timeseries.DEFAULT_INTERVAL_S) -> list:
+    """The shipped heartbeat deadman rules: scraper self-watch, serve
+    health-probe liveness, stream-commit liveness.  The scraper's own
+    tick counter is watched at 4x the scrape interval, so a dead sampler
+    pages through any OTHER live evaluator (the fleet gateway evaluates
+    rules too — a host whose scraper died stops moving the counter)."""
+    grace = max(4.0 * float(scrape_interval_s), 1.0)
+    return [
+        AlertRule(name="scraper_deadman", metric="timeseries.scrapes",
+                  kind="deadman", window_s=grace, severity="critical"),
+        AlertRule(name="health_probe_deadman", metric="serve.probe_passes",
+                  kind="deadman", window_s=max(grace, 5.0),
+                  severity="critical"),
+        AlertRule(name="stream_commit_deadman", metric="stream.commits",
+                  kind="deadman", window_s=max(grace, 30.0),
+                  severity="warning"),
+    ]
+
+
+class AlertEngine:
+    """Rule-state machines over a :class:`utils.timeseries.SeriesStore`,
+    evaluated on the scrape tick.
+
+    Per-rule states: ``inactive`` -> ``pending`` (condition breached,
+    burning its ``for_s`` fuse) -> ``firing`` -> ``inactive`` again on the
+    first healthy tick.  Events (schema v7) and counters are emitted on
+    TRANSITIONS only, exactly like the SLO engine's ``slo_alert`` — a
+    firing alert is silent until it resolves.  ``evaluate`` has the tick
+    hook signature (``fn(store, now)``) so ``attach(scraper)`` is one
+    line; tests drive it directly with an injectable clock.  Recently
+    resolved alerts are kept in a bounded ring for ``/alertz``.
+    """
+
+    def __init__(self, rules=(), store=None, now=time.time,
+                 resolved_keep: int = 32):
+        self.store = store
+        self._now = now
+        self._lock = threading.Lock()
+        self._rules: dict[str, AlertRule] = {}
+        self._state: dict[str, dict] = {}
+        self._resolved: collections.deque = collections.deque(
+            maxlen=int(resolved_keep))
+        self.evaluations = 0
+        for r in rules:
+            self.add_rule(r)
+
+    def add_rule(self, rule: AlertRule) -> None:
+        with self._lock:
+            if rule.name in self._rules:
+                raise ValueError(f"duplicate alert rule {rule.name!r}")
+            self._rules[rule.name] = rule
+            self._state[rule.name] = {"state": "inactive", "since": None,
+                                      "fired_at": None, "value": None}
+
+    def rules(self) -> list:
+        with self._lock:
+            return [dataclasses.replace(r) for r in self._rules.values()]
+
+    def attach(self, scraper) -> "AlertEngine":
+        """Ride ``scraper``'s tick (and adopt its store when none was
+        given)."""
+        if self.store is None:
+            self.store = scraper.store
+        scraper.add_tick_hook(self.evaluate)
+        return self
+
+    # ------------------------------------------------------------------
+    def evaluate(self, store=None, now=None) -> dict:
+        """One evaluation pass; returns {rule_name: state}.  Runs every
+        rule's observe/transition under the engine lock — rule counts are
+        operator-small, and the tick cadence is seconds."""
+        store = store if store is not None else self.store
+        if store is None:
+            return {}
+        now = self._now() if now is None else now
+        out = {}
+        with self._lock:
+            self.evaluations += 1
+            for name, rule in self._rules.items():
+                st = self._state[name]
+                breached, value = rule.observe(store, now)
+                st["value"] = value
+                if breached:
+                    if st["state"] == "inactive":
+                        st["state"] = "pending"
+                        st["since"] = now
+                    if st["state"] == "pending" and \
+                            now - st["since"] >= rule.for_s:
+                        st["state"] = "firing"
+                        st["fired_at"] = now
+                        self._emit_fired(rule, st, now)
+                else:
+                    if st["state"] == "firing":
+                        self._emit_resolved(rule, st, now)
+                    st["state"] = "inactive"
+                    st["since"] = None
+                    st["fired_at"] = None
+                out[name] = st["state"]
+        return out
+
+    def _emit_fired(self, rule: AlertRule, st: dict, now: float) -> None:
+        telemetry.count("alerts.fired")
+        fields = dict(alert=rule.name, severity=rule.severity,
+                      rule_kind=rule.kind, metric=rule.metric,
+                      for_s=float(rule.for_s), window_s=float(rule.window_s))
+        if rule.kind == "deadman":
+            fields["age_s"] = st["value"]
+        else:
+            fields.update(mode=rule.mode, value=st["value"],
+                          threshold=float(rule.threshold))
+        telemetry.event("alert_fired", **fields)
+
+    def _emit_resolved(self, rule: AlertRule, st: dict, now: float) -> None:
+        telemetry.count("alerts.resolved")
+        active_s = now - st["fired_at"]
+        telemetry.event("alert_resolved", alert=rule.name,
+                        severity=rule.severity, rule_kind=rule.kind,
+                        metric=rule.metric, value=st["value"],
+                        active_s=float(active_s))
+        self._resolved.append({
+            "alert": rule.name, "severity": rule.severity,
+            "rule_kind": rule.kind, "metric": rule.metric,
+            "resolved_at": now, "active_s": round(active_s, 3),
+        })
+
+    # ------------------------------------------------------------------
+    def report(self, now=None) -> dict:
+        """The /alertz body: firing + fuse-burning rules, the recently
+        resolved ring, and per-rule state for dashboards."""
+        now = self._now() if now is None else now
+        with self._lock:
+            active = []
+            states = {}
+            for name, rule in self._rules.items():
+                st = self._state[name]
+                states[name] = st["state"]
+                if st["state"] == "inactive":
+                    continue
+                entry = {
+                    "alert": name, "state": st["state"],
+                    "severity": rule.severity, "rule_kind": rule.kind,
+                    "metric": rule.metric, "value": st["value"],
+                    "pending_s": (None if st["since"] is None
+                                  else round(now - st["since"], 3)),
+                }
+                if st["state"] == "firing":
+                    entry["firing_s"] = round(now - st["fired_at"], 3)
+                active.append(entry)
+            return {
+                "active": active,
+                "resolved": list(self._resolved),
+                "rules": len(self._rules),
+                "states": states,
+                "evaluations": int(self.evaluations),
+            }
+
+    def firing(self) -> list:
+        """Names of rules currently in the firing state."""
+        with self._lock:
+            return sorted(n for n, st in self._state.items()
+                          if st["state"] == "firing")
+
+
+# ---------------------------------------------------------------------------
 # HTTP ops plane
 # ---------------------------------------------------------------------------
 _HTTP_REASONS = {200: "OK", 404: "Not Found", 405: "Method Not Allowed",
@@ -691,7 +926,8 @@ class OpsServer:
                  host: str = "127.0.0.1", port: int = 0,
                  flight: "tracing.FlightRecorder | None" = None,
                  probe: "HealthProbe | None" = None,
-                 scaler: "AutoScaler | None" = None):
+                 scaler: "AutoScaler | None" = None,
+                 alerts: "AlertEngine | None" = None):
         self.batcher = batcher
         self.slo = slo
         self.host = host
@@ -699,6 +935,7 @@ class OpsServer:
         self.flight = flight
         self.probe = probe
         self.scaler = scaler
+        self.alerts = alerts
         self._server: asyncio.AbstractServer | None = None
         self.t_started = time.monotonic()
 
@@ -749,8 +986,11 @@ class OpsServer:
         query = urllib.parse.parse_qs(url.query)
         try:
             if url.path == "/metrics":
-                return _http_response(200, telemetry.prometheus_text(),
-                                      content_type="text/plain")
+                # the exposition-format version real Prometheus scrapers
+                # negotiate on (conformance pinned by tier-1)
+                return _http_response(
+                    200, telemetry.prometheus_text(),
+                    content_type=telemetry.PROMETHEUS_CONTENT_TYPE)
             if url.path == "/healthz":
                 body = self.healthz()
                 status = 200 if body.get("ok") else 503
@@ -762,9 +1002,12 @@ class OpsServer:
             if url.path == "/tracez":
                 return _http_response(200, json.dumps(
                     self.tracez(query), sort_keys=True, default=str))
+            if url.path == "/alertz":
+                return _http_response(200, json.dumps(
+                    self.alertz(), sort_keys=True, default=str))
             return _http_response(404, json.dumps(
                 {"error": f"unknown path {url.path!r}", "paths":
-                 ["/metrics", "/healthz", "/varz", "/tracez"]}))
+                 ["/metrics", "/healthz", "/varz", "/tracez", "/alertz"]}))
         except Exception as exc:  # noqa: BLE001 — an ops bug must answer
             return _http_response(500, json.dumps(
                 {"error": f"{type(exc).__name__}: {exc}"}))
@@ -786,7 +1029,18 @@ class OpsServer:
             body["probe"] = self.probe.report()
         if self.scaler is not None:
             body["autoscale"] = self.scaler.report()
+        if self.alerts is not None:
+            firing = self.alerts.firing()
+            body["alerts"] = {"firing": firing, "count": len(firing)}
         return body
+
+    def alertz(self) -> dict:
+        """The /alertz body: active + recently-resolved alerts (an empty
+        engine-less plane still answers, so fleet scraping stays uniform)."""
+        if self.alerts is None:
+            return {"active": [], "resolved": [], "rules": 0, "states": {},
+                    "evaluations": 0}
+        return self.alerts.report()
 
     def varz(self) -> dict:
         body = {"metrics": telemetry.snapshot(),
@@ -884,10 +1138,11 @@ def spawn_server_loop(start, thread_name: str, what: str):
 def start_ops_thread(batcher=None, slo: SLOEngine | None = None,
                      host: str = "127.0.0.1", port: int = 0,
                      probe: "HealthProbe | None" = None,
-                     scaler: "AutoScaler | None" = None) -> OpsHandle:
+                     scaler: "AutoScaler | None" = None,
+                     alerts: "AlertEngine | None" = None) -> OpsHandle:
     """Start the ops plane on a daemon thread; returns once it accepts."""
     server = OpsServer(batcher=batcher, slo=slo, host=host, port=port,
-                       probe=probe, scaler=scaler)
+                       probe=probe, scaler=scaler, alerts=alerts)
     loop, thread = spawn_server_loop(server.start, "qldpc-serve-ops",
                                      "ops server")
     return OpsHandle(server, loop, thread)

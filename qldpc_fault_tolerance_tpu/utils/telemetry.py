@@ -46,7 +46,8 @@ __all__ = [
     "registry", "add_sink", "remove_sink", "JsonlSink", "MemorySink",
     "write_snapshot_event", "compile_stats", "process_info",
     "ITER_BUCKETS", "LATENCY_BUCKETS", "set_default_buckets",
-    "default_buckets",
+    "default_buckets", "set_metric_help", "metric_help",
+    "PROMETHEUS_CONTENT_TYPE",
     "TELE_LEN", "device_tele_vec", "publish_device_tele",
     "record_bp_aux",
     "EVENT_SCHEMA_VERSION", "EVENT_SCHEMAS", "validate_event",
@@ -84,6 +85,32 @@ LATENCY_BUCKETS = tuple(
 # object {"metric.name": [edge, ...]}).
 _BUCKET_SPECS: dict = {}
 _BUCKET_LOCK = threading.Lock()
+
+# per-metric HELP strings for the Prometheus exposition (``# HELP`` lines,
+# ISSUE 17 satellite): registered by the subsystems that own the metrics;
+# unregistered names fall back to a generated line so every family still
+# carries HELP (real scrapers warn on TYPE-without-HELP).
+_HELP_TEXTS: dict = {}
+_HELP_LOCK = threading.Lock()
+
+
+def set_metric_help(name: str, text: str | None) -> None:
+    """Register the ``# HELP`` string for ``name`` (None removes it).
+    Newlines/backslashes are escaped at render time per the exposition
+    format."""
+    with _HELP_LOCK:
+        if text is None:
+            _HELP_TEXTS.pop(str(name), None)
+        else:
+            _HELP_TEXTS[str(name)] = str(text)
+
+
+def metric_help(name: str) -> str:
+    """The HELP string rendered for ``name`` (generated when unregistered)."""
+    text = _HELP_TEXTS.get(str(name))
+    if text is None:
+        text = f"qldpc telemetry metric '{name}'"
+    return text
 
 
 def set_default_buckets(name: str, buckets) -> None:
@@ -137,7 +164,11 @@ class Counter:
 
 
 class Gauge:
-    """Last-write-wins scalar (plus a high-water mark for depth-style gauges)."""
+    """Last-write-wins scalar (plus a high-water mark for depth-style gauges).
+
+    ``ts`` is the wall-clock of the last ``set`` — snapshot consumers
+    (telemetry_report, sweep_dashboard, the fleet gateway) use it to mark a
+    gauge STALE instead of silently rendering a frozen value."""
 
     kind = "gauge"
 
@@ -146,15 +177,18 @@ class Gauge:
         self._lock = lock
         self.value = 0
         self.max_value = 0
+        self.ts = None
 
     def set(self, v):
         with self._lock:
             self.value = v
             if v > self.max_value:
                 self.max_value = v
+            self.ts = time.time()
 
     def to_dict(self):
-        return {"type": "gauge", "value": self.value, "max": self.max_value}
+        return {"type": "gauge", "value": self.value, "max": self.max_value,
+                "ts": self.ts}
 
 
 class Histogram:
@@ -436,7 +470,13 @@ def event(kind: str, **fields) -> None:
 # ``stream_close`` (client close or server shutdown, with the final
 # commit watermark) and ``stream_shed`` (the streaming SLO rung dropped
 # the WHOLE stream under burn-rate pressure).  v1..v5 are frozen below.
-EVENT_SCHEMA_VERSION = 6
+#
+# v7 (ISSUE 17): the fleet observability plane adds ``alert_fired`` /
+# ``alert_resolved`` (serve.ops.AlertEngine rule-state transitions —
+# threshold rules over time-series rates/quantiles and deadman rules over
+# heartbeats; emitted on transitions ONLY, like slo_alert).  v1..v6 are
+# frozen below.
+EVENT_SCHEMA_VERSION = 7
 
 # the v1 kind set, frozen for the back-compat guarantee: these kinds and
 # their required fields must keep validating across schema bumps
@@ -466,8 +506,12 @@ _V4_EVENT_KINDS = frozenset({"trace", "slo_alert", "process_info"})
 _V5_EVENT_KINDS = frozenset({"scale_event"})
 
 # the v6 additions (ISSUE 16 streaming decode), frozen with the same
-# guarantee for the eventual v7 bump
+# guarantee at the v7 bump
 _V6_EVENT_KINDS = frozenset({"stream_open", "stream_close", "stream_shed"})
+
+# the v7 additions (ISSUE 17 fleet observability plane), frozen with the
+# same guarantee for the eventual v8 bump
+_V7_EVENT_KINDS = frozenset({"alert_fired", "alert_resolved"})
 
 _NUM = (int, float)
 _OPT_NUM = (int, float, type(None))
@@ -681,6 +725,24 @@ EVENT_SCHEMAS: dict[str, dict] = {
     "stream_shed": {
         "required": {"stream": str, "tenant": str},
         "optional": {"committed": int, "burn_rate": _NUM, "signal": str},
+    },
+    # --- v7: fleet observability plane (ISSUE 17) -------------------------
+    # one alert-rule state transition pending->firing (serve.ops.AlertEngine,
+    # evaluated on the time-series scrape tick): threshold rules carry the
+    # observed value; deadman rules carry the heartbeat age instead
+    "alert_fired": {
+        "required": {"alert": str, "severity": str},
+        "optional": {"rule_kind": str, "metric": str, "mode": str,
+                     "value": _OPT_NUM, "threshold": _OPT_NUM,
+                     "for_s": _NUM, "window_s": _NUM, "age_s": _OPT_NUM,
+                     "host": str},
+    },
+    # the matching firing->resolved transition, with how long it burned
+    "alert_resolved": {
+        "required": {"alert": str, "severity": str},
+        "optional": {"rule_kind": str, "metric": str, "mode": str,
+                     "value": _OPT_NUM, "threshold": _OPT_NUM,
+                     "active_s": _NUM, "host": str},
     },
     # environment provenance, once per telemetry enable (and embedded in
     # every RunLedger record): lets sweep_dashboard --drift and
@@ -1059,6 +1121,11 @@ def compile_stats() -> dict:
 # ---------------------------------------------------------------------------
 # Prometheus-style text exposition
 # ---------------------------------------------------------------------------
+# the exposition-format version real Prometheus scrapers negotiate on; every
+# /metrics endpoint (ops plane, fleet gateway) serves with this content type
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4"
+
+
 def _prom_name(name: str) -> str:
     out = []
     for ch in name:
@@ -1073,19 +1140,32 @@ def _prom_num(v) -> str:
     return repr(float(v)) if isinstance(v, float) else str(v)
 
 
+def _prom_help(text: str) -> str:
+    # exposition format: HELP text escapes backslash and newline only
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def prometheus_text(snap: dict | None = None) -> str:
     """Render a snapshot in the Prometheus text exposition format (counters,
-    gauges, cumulative-bucket histograms)."""
+    gauges, cumulative-bucket histograms), ``# HELP`` + ``# TYPE`` per
+    family.  Serve with the ``text/plain; version=0.0.4`` content type
+    (serve.ops.OpsServer does) so real scrapers ingest it cleanly."""
     snap = snapshot() if snap is None else snap
     lines = []
     for name, m in snap.items():
         pn = _prom_name(name)
         kind = m["type"]
+        lines.append(f"# HELP {pn} {_prom_help(metric_help(name))}")
         lines.append(f"# TYPE {pn} {kind}")
         if kind == "counter":
             lines.append(f"{pn} {_prom_num(m['value'])}")
         elif kind == "gauge":
             lines.append(f"{pn} {_prom_num(m['value'])}")
+            # the high-water mark is its own family: give it HELP/TYPE so
+            # strict parsers don't see an undeclared qldpc_*_max series
+            lines.append(f"# HELP {pn}_max "
+                         f"{_prom_help('high-water mark of ' + name)}")
+            lines.append(f"# TYPE {pn}_max gauge")
             lines.append(f"{pn}_max {_prom_num(m['max'])}")
         else:  # histogram: cumulative buckets + +Inf + _sum/_count
             acc = 0
@@ -1224,6 +1304,26 @@ def publish_device_tele(vec) -> None:
 set_default_buckets("serve.latency_s", LATENCY_BUCKETS)
 set_default_buckets("serve.batch_wait_s", LATENCY_BUCKETS)
 _install_env_bucket_specs()
+
+# HELP strings for the cross-subsystem metric families (subsystems may
+# register their own with set_metric_help; unregistered names render a
+# generated fallback)
+for _n, _h in (
+    ("bp.shots", "decoder shots counted (both sectors)"),
+    ("bp.converged", "shots whose BP converged within max_iter"),
+    ("bp.iterations", "BP iterations to convergence (converged shots only)"),
+    ("osd.device_shots", "shots routed to a device-OSD stage"),
+    ("serve.latency_s", "end-to-end request latency, seconds"),
+    ("serve.batch_wait_s", "request wait before batch dispatch, seconds"),
+    ("serve.queue_depth", "batcher queue depth at sample time"),
+    ("timeseries.scrapes", "time-series scraper ticks completed"),
+    ("alerts.fired", "alert-rule pending->firing transitions"),
+    ("alerts.resolved", "alert-rule firing->resolved transitions"),
+    ("fleet.scrapes", "fleet gateway scrape rounds completed"),
+    ("fleet.host_up", "fleet hosts answering their ops endpoint"),
+):
+    set_metric_help(_n, _h)
+del _n, _h
 
 
 def record_bp_aux(aux) -> None:

@@ -110,6 +110,13 @@ GATED_FIELDS = (
     "stream.cycles_per_s",
     "stream.ab_compute_per_cycle_ratio",
     "stream.p99_commit_ms",
+    # fleet observability plane (bench.py BP timeseries A/B, ISSUE 17):
+    # the SCRAPER-ON arm's throughput is the robust regression signal for
+    # the retention+alerting cost (its overhead_pct sits near zero where
+    # percent-change gating is meaningless — same reasoning as the tracing
+    # arm).  Rounds before r17 lack the key, so the checked-in history
+    # gates unchanged.
+    "timeseries_ab.scraper_on_shots_per_s",
 )
 
 # gated fields where a RISE is the regression (latencies, host round-trips)

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Run the fleet federation gateway over N serving hosts' ops endpoints.
+
+    python scripts/fleet_gateway.py --port 9100 \
+        --target a=http://10.0.0.1:9001 --target b=http://10.0.0.2:9001
+
+Serves the merged fleet view (see qldpc_fault_tolerance_tpu.serve.fleet):
+/metrics (counter sums bit-exact, histogram buckets additive, per-host
+labels), /healthz (per-host up/down + aggregate), /alertz (union of host
+alerts + host-down deadman), /varz (the merge inputs + skips).  Bare URLs
+without ``label=`` get host0, host1, ... labels.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+
+def parse_targets(specs) -> dict:
+    targets = {}
+    for i, spec in enumerate(specs):
+        if "=" in spec.split("://", 1)[0]:
+            label, url = spec.split("=", 1)
+        else:
+            label, url = f"host{i}", spec
+        if label in targets:
+            raise SystemExit(f"duplicate target label {label!r}")
+        targets[label] = url
+    return targets
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--target", action="append", default=[],
+                    metavar="LABEL=URL", dest="targets",
+                    help="ops endpoint to federate (repeatable)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0)
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="scrape interval, seconds")
+    ap.add_argument("--down-after", type=float, default=None,
+                    help="host-down deadman window (default 3 intervals)")
+    ap.add_argument("--telemetry-jsonl", default=None,
+                    help="enable telemetry with this JSONL sink (alert "
+                         "transition events land there)")
+    args = ap.parse_args(argv)
+    if not args.targets:
+        ap.error("at least one --target is required")
+
+    from qldpc_fault_tolerance_tpu.serve import fleet
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    if args.telemetry_jsonl:
+        telemetry.enable(args.telemetry_jsonl)
+    gw = fleet.FleetGateway(parse_targets(args.targets),
+                            interval_s=args.interval,
+                            down_after_s=args.down_after)
+    handle = fleet.start_fleet_thread(gw, host=args.host, port=args.port)
+    host, port = handle.address
+    print(f"fleet gateway on http://{host}:{port} "
+          f"({len(gw.targets)} hosts, scrape every {args.interval:g}s) — "
+          "/metrics /healthz /varz /alertz; Ctrl-C to stop")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -158,6 +158,11 @@ def build_grid(records: list[dict], grid: dict | None = None) -> dict:
                     s["compiles"] += 1
         elif kind == "serve_drain":
             serve["drains"] += 1
+        elif kind == "snapshot":
+            # latest registry snapshot wins: gauge last-set stamps
+            # (ISSUE 17) let the render mark frozen values as stale
+            grid["snapshot"] = {"ts": rec.get("ts"),
+                                "metrics": rec.get("metrics", {})}
     # mark anomalous cells
     for a in grid["anomalies"]:
         cell_key = a.get("cell")
@@ -229,6 +234,7 @@ def render_grid(grid: dict, view: str = "wer", title: str = "") -> str:
     if not grid["rows"]:
         if serve.get("sessions"):
             lines.extend(_serve_lines(serve))
+            lines.extend(_stale_gauge_lines(grid))
             return "\n".join(lines)
         lines.append("(no cells yet)")
         return "\n".join(lines)
@@ -281,7 +287,25 @@ def render_grid(grid: dict, view: str = "wer", title: str = "") -> str:
                          .rstrip())
     if serve.get("sessions"):
         lines.extend(_serve_lines(serve))
+    lines.extend(_stale_gauge_lines(grid))
     return "\n".join(lines)
+
+
+def _stale_gauge_lines(grid: dict) -> list[str]:
+    """Mark gauges whose last-set stamp lags the latest snapshot (ISSUE
+    17): a frozen queue depth must read as stale, not as current state."""
+    snap = grid.get("snapshot")
+    if not snap:
+        return []
+    from scripts.telemetry_report import stale_gauges
+
+    stale = stale_gauges(snap.get("metrics", {}), snap.get("ts"))
+    if not stale:
+        return []
+    lines = ["-- stale gauges (frozen values) --"]
+    for name, age in sorted(stale.items()):
+        lines.append(f"  {name:<30}last set {age}s before snapshot")
+    return lines
 
 
 def _serve_lines(serve: dict) -> list[str]:

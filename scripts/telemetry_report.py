@@ -7,6 +7,9 @@ summary table.
     python scripts/telemetry_report.py RUN.jsonl --follow   # live re-render
     python scripts/telemetry_report.py RUN.jsonl --traces   # slow/errored
     python scripts/telemetry_report.py RUN.jsonl --trace ID # one span tree
+    python scripts/telemetry_report.py RUN.jsonl --rates 60 # windowed rates
+    python scripts/telemetry_report.py RUN.jsonl --fleet http://gw:9100
+    python scripts/telemetry_report.py --fleet http://gw:9100  # fleet only
 
 The stream is the one ``telemetry.enable(jsonl_path=...)`` (or
 ``QLDPC_TELEMETRY_JSONL=...``) writes: ``wer_run`` / ``cell_done`` events as
@@ -25,6 +28,18 @@ traces newest-first (``--slow-ms`` / ``--errored`` filter like
 ``/tracez``); ``--trace ID`` renders one request's full span tree —
 queue_wait / batch_assemble / pad / device_decode / slice / respond under
 its serve.request root — from the JSONL alone.
+
+``--rates <window_s>`` (ISSUE 17) rebuilds a utils.timeseries.SeriesStore
+from the stream's ``snapshot`` events (the Scraper's
+``emit_snapshot_events=True`` writes one per tick) and renders counter
+rates and windowed histogram p50/p99 over the trailing window.  With a
+single snapshot there is nothing to difference, so lifetime averages are
+shown and flagged.  ``--fleet <url-or-json>`` appends a fleet block from
+a federation gateway (serve.fleet): per-host up/down, merged counter
+totals, active alerts — pass the gateway base URL or a file holding its
+``/varz`` JSON.  ``--fleet`` alone (no JSONL) renders just that block; a
+gateway ``/healthz`` answering 503 (hosts down) still renders — the
+degraded body is the interesting one.
 """
 from __future__ import annotations
 
@@ -183,6 +198,28 @@ def summarize(events: list[dict]) -> dict:
     return summary_from_state(fold_events(new_fold_state(), events))
 
 
+# a gauge whose last set is this much older than the snapshot it appears
+# in is rendered STALE instead of silently showing its frozen value
+STALE_GAUGE_AFTER_S = 60.0
+
+
+def stale_gauges(snap: dict, snap_ts,
+                 after_s: float = STALE_GAUGE_AFTER_S) -> dict:
+    """{gauge_name: age_s} for gauges whose last-set stamp (``ts``, ISSUE
+    17) lags the snapshot time by more than ``after_s``.  Gauges without a
+    stamp (pre-v7 streams, never-set defaults) are not judged."""
+    out = {}
+    if not isinstance(snap_ts, (int, float)):
+        return out
+    for name, m in snap.items():
+        if m.get("type") != "gauge":
+            continue
+        ts = m.get("ts")
+        if isinstance(ts, (int, float)) and snap_ts - ts > after_s:
+            out[name] = round(snap_ts - ts, 1)
+    return out
+
+
 def summary_from_state(state: dict) -> dict:
     kinds = state["kinds"]
     snapshot_event = state["snapshot"]
@@ -284,6 +321,8 @@ def summary_from_state(state: dict) -> dict:
                 _metric(snap, "jax.backend_compiles.seconds"), 3),
             "retrace_source": compile_stats.get("source"),
         },
+        "stale_gauges": stale_gauges(
+            snap, snapshot_event.get("ts") if snapshot_event else None),
         "spans": {
             name: {"count": m["count"], "total_s": round(m["sum"], 4),
                    "mean_s": (round(m["sum"] / m["count"], 5)
@@ -401,7 +440,9 @@ def render(summary: dict, title: str = "") -> str:
         if p50 is not None:
             L.append(f"  {'latency p50/p99':<22}"
                      f"{1e3 * p50:.1f} / {1e3 * p99:.1f} ms")
-        L.append(f"  {'queue depth (max)':<22}{srv['queue_depth_max']}")
+        q_stale = s.get("stale_gauges", {}).get("serve.queue_depth")
+        L.append(f"  {'queue depth (max)':<22}{srv['queue_depth_max']}"
+                 + (f"  [STALE {q_stale}s]" if q_stale is not None else ""))
         if srv.get("bytes_rx") or srv.get("bytes_tx"):
             codec = srv.get("wire_codec_version")
             L.append(f"  {'wire bytes rx/tx':<22}"
@@ -450,12 +491,172 @@ def render(summary: dict, title: str = "") -> str:
                      f"{m['mean_s']:>12}"
                      f"{m.get('p50_s') if m.get('p50_s') is not None else '-':>12}"
                      f"{m.get('p95_s') if m.get('p95_s') is not None else '-':>12}")
+    if s.get("stale_gauges"):
+        L.append("-- stale gauges (frozen values, not current state) --")
+        for name, age in sorted(s["stale_gauges"].items()):
+            L.append(f"  {name:<30}last set {age}s before snapshot")
+    return "\n".join(L)
+
+
+def build_series_store(events: list[dict]):
+    """Rebuild a utils.timeseries.SeriesStore from the stream's
+    ``snapshot`` events; returns (store, n_snapshots, last_ts).  The same
+    ingest path the live scraper uses, so rate/quantile derivations are
+    identical on- and off-line."""
+    from qldpc_fault_tolerance_tpu.utils import timeseries
+
+    store = timeseries.SeriesStore()
+    n, last_ts = 0, None
+    for e in events:
+        if e.get("kind") != "snapshot":
+            continue
+        ts = e.get("ts")
+        if not isinstance(ts, (int, float)):
+            continue
+        store.ingest(ts, e.get("metrics", {}))
+        n += 1
+        last_ts = ts
+    return store, n, last_ts
+
+
+def render_rates(events: list[dict], window_s: float) -> str:
+    """The --rates view: counter rates and windowed histogram p50/p99 over
+    the trailing window, derived from the rebuilt time-series store."""
+    store, n, last_ts = build_series_store(events)
+    if n == 0:
+        return "(no snapshot events — enable the scraper's " \
+               "emit_snapshot_events or telemetry.session())"
+    L = [f"== windowed rates (window {window_s:g}s, {n} snapshots) =="]
+    if n == 1:
+        # nothing to difference: lifetime averages over the event span
+        state = fold_events(new_fold_state(), events)
+        wall = ((state["ts_max"] - state["ts_min"])
+                if state["ts_min"] is not None else 0.0)
+        L[0] += "  [single snapshot: lifetime averages over "\
+            f"{round(wall, 1)}s]"
+        snap = state["snapshot"].get("metrics", {})
+        for name, m in sorted(snap.items()):
+            if m.get("type") == "counter" and m["value"] and wall > 0:
+                L.append(f"  {name:<34}{m['value'] / wall:>12.2f}/s")
+        return "\n".join(L)
+    rates = []
+    hists = []
+    gauges = []
+    for name in store.names():
+        kind = store.kind(name)
+        if kind == "counter":
+            r = store.rate(name, window_s, now=last_ts)
+            if r:
+                rates.append((name, r))
+        elif kind == "histogram":
+            got = store.window_hist(name, window_s, now=last_ts)
+            if got is None or not got[3]:
+                continue
+            buckets, counts, dsum, dcount = got
+            p50 = store.quantile(name, 0.50, window_s, now=last_ts)
+            p99 = store.quantile(name, 0.99, window_s, now=last_ts)
+            hists.append((name, dcount, dsum, p50, p99))
+        elif kind == "gauge":
+            v = store.last_value(name)
+            set_ts = store.gauge_set_ts(name)
+            age = (last_ts - set_ts
+                   if isinstance(set_ts, (int, float)) else None)
+            gauges.append((name, v, age))
+    if rates:
+        L.append("-- counter rates --")
+        for name, r in sorted(rates, key=lambda kv: -kv[1]):
+            L.append(f"  {name:<34}{r:>12.2f}/s")
+    if hists:
+        L.append("-- windowed histograms --")
+        L.append(f"  {'name':<34}{'count':>9}{'mean':>11}{'p50':>11}"
+                 f"{'p99':>11}")
+        for name, dcount, dsum, p50, p99 in sorted(hists):
+            mean = dsum / dcount if dcount else None
+            fmt = lambda v: f"{v:.4g}" if v is not None else "-"
+            L.append(f"  {name:<34}{dcount:>9}{fmt(mean):>11}"
+                     f"{fmt(p50):>11}{fmt(p99):>11}")
+    if gauges:
+        L.append("-- gauges (last value) --")
+        for name, v, age in sorted(gauges):
+            mark = (f"  [STALE {age:.1f}s]"
+                    if age is not None and age > window_s else "")
+            L.append(f"  {name:<34}{v!s:>12}{mark}")
+    return "\n".join(L)
+
+
+def load_fleet(source: str) -> dict:
+    """Fetch the fleet view from a gateway base URL (GET /varz, /healthz,
+    /alertz) or load a file holding its /varz JSON."""
+    if source.startswith(("http://", "https://")):
+        import urllib.error
+        import urllib.request
+
+        def get(path):
+            try:
+                with urllib.request.urlopen(source.rstrip("/") + path,
+                                            timeout=10.0) as resp:
+                    body = resp.read()
+            except urllib.error.HTTPError as err:
+                # the gateway's /healthz deliberately answers 503 while
+                # hosts are down — that degraded body is exactly the view
+                # the report exists to show
+                body = err.read()
+            return json.loads(body.decode("utf-8"))
+
+        out = {"varz": get("/varz")}
+        for key, path in (("healthz", "/healthz"), ("alertz", "/alertz")):
+            try:
+                out[key] = get(path)
+            except Exception:  # a /varz-only source still renders
+                out[key] = None
+        return out
+    with open(source, encoding="utf-8") as fh:
+        return {"varz": json.load(fh), "healthz": None, "alertz": None}
+
+
+def render_fleet(fleet: dict) -> str:
+    """The --fleet block: per-host up/down, merged counter totals, active
+    alerts (from a serve.fleet gateway's endpoints)."""
+    varz = fleet.get("varz") or {}
+    healthz = fleet.get("healthz")
+    alertz = fleet.get("alertz")
+    L = ["== fleet (federation gateway) =="]
+    targets = varz.get("targets", {})
+    L.append(f"  hosts: {len(targets)}   scrapes: {varz.get('scrapes', 0)}")
+    if healthz:
+        for label, h in sorted(healthz.get("hosts", {}).items()):
+            mark = "up" if h.get("up") else "DOWN"
+            ok = "" if h.get("ok") or not h.get("up") else "  [not ok]"
+            age = h.get("last_ok_age_s")
+            L.append(f"  {label:<20}{mark:<6}"
+                     + (f"last ok {age}s ago" if age is not None
+                        else "never scraped") + ok)
+        if healthz.get("down"):
+            L.append(f"  DOWN: {', '.join(healthz['down'])}")
+    merged = varz.get("merged", {})
+    counters = {k: v for k, v in merged.items()
+                if v.get("type") == "counter" and v.get("value")}
+    if counters:
+        L.append("  -- merged counter totals (bit-exact sums) --")
+        for name, m in sorted(counters.items()):
+            L.append(f"    {name:<32}{m['value']}")
+    if varz.get("merge_skipped"):
+        L.append(f"  merge skipped (boundary mismatch): "
+                 f"{', '.join(varz['merge_skipped'])}")
+    if alertz and alertz.get("active"):
+        L.append("  -- active alerts --")
+        for a in alertz["active"]:
+            L.append(f"    [{a.get('severity', '?'):<8}] "
+                     f"{a.get('host', '?')}/{a.get('alert', '?')} "
+                     f"({a.get('state', 'firing')})")
     return "\n".join(L)
 
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("jsonl", help="telemetry JSONL stream to render")
+    ap.add_argument("jsonl", nargs="?", default=None,
+                    help="telemetry JSONL stream to render (optional when "
+                         "only --fleet is asked for)")
     ap.add_argument("--json", action="store_true",
                     help="emit the summary as json instead of the table")
     ap.add_argument("--prometheus", action="store_true",
@@ -475,7 +676,23 @@ def main(argv=None) -> int:
                          "slow")
     ap.add_argument("--errored", action="store_true",
                     help="--traces: only traces with an errored span")
+    ap.add_argument("--rates", type=float, metavar="WINDOW_S", default=None,
+                    help="render counter rates + windowed histogram "
+                         "quantiles over this trailing window (needs the "
+                         "stream's periodic snapshot events)")
+    ap.add_argument("--fleet", metavar="URL_OR_JSON", default=None,
+                    help="append a fleet block from a federation gateway "
+                         "(base URL, or a file with its /varz JSON)")
     args = ap.parse_args(argv)
+
+    if args.jsonl is None:
+        # fleet-only mode: an operator on a gateway box has no JSONL
+        if not args.fleet or args.follow or args.traces or args.trace \
+                or args.rates is not None or args.prometheus or args.json:
+            ap.error("a telemetry JSONL stream is required "
+                     "(only a bare --fleet URL works without one)")
+        print(render_fleet(load_fleet(args.fleet)))
+        return 0
 
     if args.follow:
         if args.traces or args.trace:
@@ -504,6 +721,11 @@ def main(argv=None) -> int:
                             else args.slow_ms / 1e3),
             errored_only=args.errored))
         return 0
+    if args.rates is not None:
+        print(render_rates(events, args.rates))
+        if args.fleet:
+            print(render_fleet(load_fleet(args.fleet)))
+        return 0
     summary = summarize(events)
     if args.prometheus:
         from qldpc_fault_tolerance_tpu.utils import telemetry
@@ -516,6 +738,8 @@ def main(argv=None) -> int:
         print(json.dumps(out, indent=1, default=str))
         return 0
     print(render(summary, title=os.path.basename(args.jsonl)))
+    if args.fleet:
+        print(render_fleet(load_fleet(args.fleet)))
     return 0
 
 

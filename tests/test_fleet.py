@@ -1,0 +1,456 @@
+"""Alert-rules engine + cross-host federation tests (ISSUE 17 tentpole,
+parts 2-3): declarative rules over the time-series store (a ``for_s``
+threshold rule fires and resolves deterministically on an injectable
+clock, deadman rules page on missing heartbeats), transition-only v7
+events ("alert_fired" / "alert_resolved") that validate against the
+schema registry, the /alertz surface, bit-exact snapshot merging, and the
+FleetGateway end to end over two LIVE ops HTTP servers — including the
+host-kill -> fleet /healthz flip + host-down deadman the ISSUE's
+acceptance demands.  Prometheus exposition conformance (# HELP lines,
+text/plain; version=0.0.4) rides here too."""
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+
+import pytest
+
+from qldpc_fault_tolerance_tpu.serve import ops
+from qldpc_fault_tolerance_tpu.serve.fleet import (
+    FleetGateway,
+    merge_snapshots,
+    start_fleet_thread,
+)
+from qldpc_fault_tolerance_tpu.serve.ops import (
+    AlertEngine,
+    AlertRule,
+    default_alert_rules,
+    start_ops_thread,
+)
+from qldpc_fault_tolerance_tpu.utils import telemetry, timeseries
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPTS = os.path.join(REPO, "scripts")
+if SCRIPTS not in sys.path:
+    sys.path.insert(0, SCRIPTS)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _counter(v):
+    return {"type": "counter", "value": v}
+
+
+# ---------------------------------------------------------------------------
+# AlertRule / AlertEngine
+# ---------------------------------------------------------------------------
+def test_alert_rule_validation():
+    with pytest.raises(ValueError):
+        AlertRule(name="r", metric="m", kind="nope")
+    with pytest.raises(ValueError):
+        AlertRule(name="r", metric="m", mode="median")
+    with pytest.raises(ValueError):
+        AlertRule(name="r", metric="m", op="==")
+    eng = AlertEngine([AlertRule(name="r", metric="m")])
+    with pytest.raises(ValueError):  # duplicate rule names
+        eng.add_rule(AlertRule(name="r", metric="m"))
+
+
+def test_threshold_for_s_fires_and_resolves_deterministically():
+    """The ISSUE's acceptance demo: a rate rule with a ``for_s`` fuse on an
+    injectable clock — pending while the fuse burns, ONE alert_fired on
+    expiry, silent while firing, ONE alert_resolved on the first healthy
+    tick — and both transition events validate against the v7 registry."""
+    store = timeseries.SeriesStore()
+    rule = AlertRule(name="hot_rate", metric="c", mode="rate",
+                     window_s=10.0, op=">", threshold=50.0, for_s=5.0,
+                     severity="critical")
+    eng = AlertEngine([rule], store=store)
+    sink = telemetry.MemorySink()
+    telemetry.enable()
+    telemetry.add_sink(sink)
+    try:
+        # counter climbing 100/s: breach appears once two samples exist
+        v = 0
+        for t in (0.0, 1.0, 2.0):
+            store.ingest(t, {"c": _counter(v)})
+            v += 100
+        assert eng.evaluate(now=2.0) == {"hot_rate": "pending"}
+        store.ingest(4.0, {"c": _counter(v)})
+        assert eng.evaluate(now=4.0) == {"hot_rate": "pending"}  # fuse burns
+        store.ingest(7.5, {"c": _counter(v + 350)})
+        assert eng.evaluate(now=7.5) == {"hot_rate": "firing"}   # 5.5s >= 5
+        assert eng.evaluate(now=8.0) == {"hot_rate": "firing"}   # no re-fire
+        rep = eng.report(now=8.0)
+        assert rep["active"][0]["alert"] == "hot_rate"
+        assert rep["active"][0]["firing_s"] == pytest.approx(0.5)
+        # traffic stops: flat samples age the deltas out of the window
+        for t in (12.0, 16.0, 20.0):
+            store.ingest(t, {"c": _counter(v + 350)})
+        assert eng.evaluate(now=20.0) == {"hot_rate": "inactive"}
+        assert eng.firing() == []
+    finally:
+        telemetry.remove_sink(sink)
+    fired = [r for r in sink.records if r["kind"] == "alert_fired"]
+    resolved = [r for r in sink.records if r["kind"] == "alert_resolved"]
+    assert len(fired) == 1 and len(resolved) == 1  # transitions only
+    assert fired[0]["alert"] == "hot_rate"
+    assert fired[0]["severity"] == "critical"
+    assert fired[0]["value"] > 50.0
+    assert resolved[0]["active_s"] == pytest.approx(12.5)
+    for rec in ("alert_fired", "alert_resolved"):
+        [ev] = [r for r in sink.records if r["kind"] == rec]
+        assert telemetry.validate_event(ev) == []
+    snap = telemetry.snapshot()
+    assert snap["alerts.fired"]["value"] == 1
+    assert snap["alerts.resolved"]["value"] == 1
+
+
+def test_deadman_never_seen_is_a_missing_heartbeat():
+    store = timeseries.SeriesStore()
+    rule = AlertRule(name="dm", metric="hb", kind="deadman", window_s=10.0)
+    eng = AlertEngine([rule], store=store)
+    # the metric was never ingested: that IS the breach (for_s=0 -> fires)
+    assert eng.evaluate(now=0.0) == {"dm": "firing"}
+    # heartbeat appears -> resolves; stops moving past the window -> refires
+    store.ingest(1.0, {"hb": _counter(1)})
+    assert eng.evaluate(now=1.0) == {"dm": "inactive"}
+    store.ingest(5.0, {"hb": _counter(1)})  # scraped but UNCHANGED
+    assert eng.evaluate(now=12.0) == {"dm": "firing"}
+
+
+def test_default_rules_and_scraper_self_watch():
+    names = {r.name for r in default_alert_rules(0.05)}
+    assert names == {"scraper_deadman", "health_probe_deadman",
+                     "stream_commit_deadman"}
+    # the scraper's own tick counter feeds its deadman: attach() rides the
+    # scrape tick, so a live scraper keeps its self-watch quiet
+    telemetry.enable()
+    sc = timeseries.Scraper(interval_s=1.0)
+    eng = AlertEngine([AlertRule(name="scraper_deadman",
+                                 metric="timeseries.scrapes",
+                                 kind="deadman", window_s=4.0)]).attach(sc)
+    assert eng.store is sc.store
+    sc.scrape_once(now=1.0)  # tick 1: scrapes counter ingested NEXT tick
+    sc.scrape_once(now=2.0)
+    assert eng.evaluate(now=2.0) == {"scraper_deadman": "inactive"}
+    assert eng.evaluations == 3  # two hook rides + the explicit call
+    # the scraper dies: nothing moves the counter -> the watch fires
+    assert eng.evaluate(now=30.0) == {"scraper_deadman": "firing"}
+
+
+def test_ops_server_alertz_and_healthz_alerts_block():
+    store = timeseries.SeriesStore()
+    eng = AlertEngine([AlertRule(name="dm", metric="hb", kind="deadman",
+                                 window_s=1.0)], store=store)
+    eng.evaluate(now=0.0)
+    handle = start_ops_thread(alerts=eng)
+    try:
+        base = "http://%s:%s" % handle.address
+        az = json.loads(urllib.request.urlopen(base + "/alertz").read())
+        assert az["states"] == {"dm": "firing"} and az["rules"] == 1
+        assert az["active"][0]["rule_kind"] == "deadman"
+        hz = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert hz["alerts"] == {"firing": ["dm"], "count": 1}
+    finally:
+        handle.stop()
+    # an engine-less plane still answers the same shape (fleet scraping
+    # stays uniform across hosts with and without rules)
+    empty = ops.OpsServer().alertz()
+    assert empty == {"active": [], "resolved": [], "rules": 0,
+                     "states": {}, "evaluations": 0}
+
+
+# ---------------------------------------------------------------------------
+# snapshot merging
+# ---------------------------------------------------------------------------
+def test_merge_snapshots_bit_exact_and_skips():
+    h = {"type": "histogram", "buckets": [1.0, 2.0], "counts": [1, 2, 3],
+         "sum": 4.5, "count": 6}
+    h2 = {"type": "histogram", "buckets": [1.0, 2.0], "counts": [4, 5, 6],
+          "sum": 2.5, "count": 15}
+    bad = {"type": "histogram", "buckets": [9.0], "counts": [1, 1],
+           "sum": 1.0, "count": 2}
+    big_a, big_b = 2**53 + 1, 3  # float addition would round 2**53+1 away
+    out = merge_snapshots({
+        "a": {"c": _counter(big_a), "h": h,
+              "g": {"type": "gauge", "value": 3.0, "ts": 1.0},
+              "mix": _counter(1)},
+        "b": {"c": _counter(big_b), "h": h2, "mix": bad},
+    })
+    assert out["merged"]["c"]["value"] == big_a + big_b  # bit-exact int sum
+    assert out["merged"]["h"]["counts"] == [5, 7, 9]
+    assert out["merged"]["h"]["sum"] == pytest.approx(7.0)
+    assert out["merged"]["h"]["count"] == 21
+    # gauges never sum: per-host only
+    assert out["gauges"]["g"]["a"]["value"] == 3.0 and "g" not in out["merged"]
+    # a counter/histogram type conflict is skipped, never fudged
+    assert out["skipped"] == ["mix"] and "mix" not in out["merged"]
+
+
+def test_merge_skips_boundary_mismatch():
+    h1 = {"type": "histogram", "buckets": [1.0, 2.0], "counts": [1, 1, 1],
+          "sum": 3.0, "count": 3}
+    h3 = {"type": "histogram", "buckets": [1.0, 3.0], "counts": [2, 2, 2],
+          "sum": 6.0, "count": 6}
+    out = merge_snapshots({"a": {"h": h1}, "b": {"h": h3}})
+    assert out["skipped"] == ["h"] and out["merged"] == {}
+
+
+# ---------------------------------------------------------------------------
+# FleetGateway with injectable clock + fetch (deterministic host kill)
+# ---------------------------------------------------------------------------
+class _FakeFleet:
+    """Two synthetic hosts behind a (label, path) -> dict fetch."""
+
+    def __init__(self):
+        self.snaps = {
+            "a": {"bp.shots": _counter(1000)},
+            "b": {"bp.shots": _counter(2000)},
+        }
+        self.dead: set = set()
+
+    def fetch(self, label, path):
+        if label in self.dead:
+            raise ConnectionError(f"{label} is down")
+        if path == "/varz":
+            return {"metrics": self.snaps[label]}
+        if path == "/healthz":
+            return {"ok": True}
+        return {"active": [], "resolved": []}
+
+
+def test_gateway_host_kill_flips_healthz_and_fires_deadman():
+    fake = _FakeFleet()
+    gw = FleetGateway({"a": "http://a:1", "b": "http://b:1"},
+                      interval_s=5.0, down_after_s=12.0,
+                      now=lambda: 0.0, fetch=fake.fetch)
+    assert gw.scrape_once(now=0.0) == {"a": True, "b": True}
+    assert gw.scrape_once(now=5.0) == {"a": True, "b": True}
+    hz = gw.healthz(now=5.0)
+    assert hz["ok"] is True and hz["up"] == 2 and hz["down"] == []
+    assert gw.merged()["merged"]["bp.shots"]["value"] == 3000
+    # kill b: inside the grace window the host is still "up" (one missed
+    # scrape must not page), past down_after_s the deadman fires
+    fake.dead.add("b")
+    assert gw.scrape_once(now=10.0) == {"a": True, "b": False}
+    assert gw.healthz(now=10.0)["ok"] is True
+    gw.scrape_once(now=20.0)  # b's heartbeat age: 15s > 12s
+    assert gw.alerts.firing() == ["host_down:b"]
+    hz = gw.healthz(now=20.0)
+    assert hz["ok"] is False and hz["down"] == ["b"]
+    assert hz["hosts"]["a"]["up"] is True
+    assert hz["hosts"]["b"]["error"].startswith("ConnectionError")
+    az = gw.alertz(now=20.0)
+    assert [(a["alert"], a["host"]) for a in az["active"]] == \
+        [("host_down:b", "fleet")]
+    # the host comes back: heartbeat moves again, the alert resolves
+    fake.dead.discard("b")
+    gw.scrape_once(now=25.0)
+    assert gw.alerts.firing() == []
+    assert gw.healthz(now=25.0)["ok"] is True
+    assert [r["alert"] for r in gw.alertz(now=25.0)["resolved"]] == \
+        ["host_down:b"]
+
+
+# ---------------------------------------------------------------------------
+# live end-to-end federation over two real ops HTTP servers
+# ---------------------------------------------------------------------------
+class _StaticOps(ops.OpsServer):
+    """An ops plane serving a FIXED registry snapshot, so two in-process
+    servers can report DISTINCT per-host metrics (the real registry is
+    process-global)."""
+
+    def __init__(self, snap):
+        super().__init__()
+        self._snap = snap
+
+    def varz(self):
+        return {"metrics": self._snap}
+
+
+def _start_static(snap):
+    server = _StaticOps(snap)
+    loop, thread = ops.spawn_server_loop(server.start, "test-static-ops",
+                                         "static ops")
+    return ops.OpsHandle(server, loop, thread)
+
+
+def test_fleet_federates_two_live_ops_servers():
+    buckets = [0.01, 0.1, 1.0]
+    ca, cb = [90, 8, 2, 0], [10, 60, 25, 5]
+    snap_a = {"bp.shots": _counter(3_000_000_001),
+              "serve.latency_s": {"type": "histogram", "buckets": buckets,
+                                  "counts": ca, "sum": 1.5, "count": 100},
+              "serve.queue_depth": {"type": "gauge", "value": 3.0,
+                                    "max": 5.0, "ts": 1.0}}
+    snap_b = {"bp.shots": _counter(4_000_000_007),
+              "serve.latency_s": {"type": "histogram", "buckets": buckets,
+                                  "counts": cb, "sum": 9.0, "count": 100},
+              "serve.queue_depth": {"type": "gauge", "value": 5.0,
+                                    "max": 7.0, "ts": 2.0}}
+    ha, hb = _start_static(snap_a), _start_static(snap_b)
+    clk = {"t": 0.0}
+    gw = FleetGateway(
+        {"a": "http://%s:%s" % ha.address, "b": "http://%s:%s" % hb.address},
+        interval_s=5.0, down_after_s=12.0, now=lambda: clk["t"])
+    fh = start_fleet_thread(gw, scrape=False)  # the test steps the clock
+    try:
+        base = "http://%s:%s" % fh.address
+        assert gw.scrape_once(now=0.0) == {"a": True, "b": True}
+
+        # merged /varz: counter sum is the exact integer sum of what each
+        # host reported; histogram bucket vectors add element-wise
+        varz = json.loads(urllib.request.urlopen(base + "/varz").read())
+        assert varz["merged"]["bp.shots"]["value"] == 7_000_000_008
+        assert varz["merged"]["serve.latency_s"]["counts"] == \
+            [a + b for a, b in zip(ca, cb)]
+        assert varz["merge_skipped"] == []
+
+        # the merge preserves quantiles: a quantile over the merged bucket
+        # vector equals the quantile over the union of both hosts' data
+        merged_counts = varz["merged"]["serve.latency_s"]["counts"]
+        p99 = timeseries.hist_quantile(buckets, merged_counts, 0.99)
+        assert p99 == timeseries.hist_quantile(
+            buckets, [a + b for a, b in zip(ca, cb)], 0.99)
+        assert p99 > timeseries.hist_quantile(buckets, ca, 0.99)
+
+        # /metrics: exposition-format conformance + per-host labels
+        resp = urllib.request.urlopen(base + "/metrics")
+        assert "version=0.0.4" in resp.headers["Content-Type"]
+        text = resp.read().decode()
+        lines = text.splitlines()
+        assert "qldpc_bp_shots 7000000008" in lines
+        assert 'qldpc_bp_shots{host="a"} 3000000001' in lines
+        assert 'qldpc_bp_shots{host="b"} 4000000007' in lines
+        # gauges are per-host ONLY (a queue depth does not sum)
+        assert 'qldpc_serve_queue_depth{host="a"} 3.0' in lines
+        assert not any(ln.startswith("qldpc_serve_queue_depth ")
+                       for ln in lines)
+        # cumulative histogram over the merged vector, +Inf = total count
+        assert 'qldpc_serve_latency_s_bucket{le="+Inf"} 200' in lines
+        # every # TYPE is introduced by a # HELP for the same family
+        for i, ln in enumerate(lines):
+            if ln.startswith("# TYPE"):
+                fam = ln.split()[2]
+                assert lines[i - 1].startswith(f"# HELP {fam} ")
+
+        hz = json.loads(urllib.request.urlopen(base + "/healthz").read())
+        assert hz["ok"] is True and hz["up"] == 2
+
+        # kill host b for real: its server stops accepting, the fleet
+        # health flips and the host-down deadman fires past the window
+        hb.stop()
+        clk["t"] = 20.0
+        assert gw.scrape_once(now=20.0) == {"a": True, "b": False}
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/healthz")
+        assert exc.value.code == 503
+        body = json.loads(exc.value.read())
+        assert body["ok"] is False and body["down"] == ["b"]
+        assert gw.alerts.firing() == ["host_down:b"]
+        az = json.loads(urllib.request.urlopen(base + "/alertz").read())
+        assert [(a["alert"], a["host"]) for a in az["active"]] == \
+            [("host_down:b", "fleet")]
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(base + "/nope")
+        assert exc.value.code == 404
+    finally:
+        fh.stop()
+        ha.stop()
+
+
+# ---------------------------------------------------------------------------
+# exposition conformance on the LOCAL plane + the v7 frozen chain
+# ---------------------------------------------------------------------------
+def test_local_metrics_exposition_conformance():
+    telemetry.enable()
+    telemetry.count("bp.shots", 7)
+    telemetry.set_gauge("serve.queue_depth", 2)
+    telemetry.observe("serve.latency_s", 0.05)
+    telemetry.set_metric_help("custom.thing", "does a thing\nwith newline")
+    telemetry.count("custom.thing")
+    handle = start_ops_thread()
+    try:
+        base = "http://%s:%s" % handle.address
+        resp = urllib.request.urlopen(base + "/metrics")
+        # the exposition-format version real Prometheus scrapers negotiate
+        assert resp.headers["Content-Type"].startswith(
+            "text/plain; version=0.0.4")
+        lines = resp.read().decode().splitlines()
+    finally:
+        handle.stop()
+        telemetry.set_metric_help("custom.thing", None)
+    for i, ln in enumerate(lines):
+        if ln.startswith("# TYPE"):
+            fam = ln.split()[2]
+            assert lines[i - 1].startswith(f"# HELP {fam} ")
+    # registered help text is served, newline escaped per the format spec
+    assert "# HELP qldpc_custom_thing does a thing\\nwith newline" in lines
+    # unregistered metrics fall back to a generated description
+    assert any(ln.startswith("# HELP qldpc_bp_shots ") for ln in lines)
+    # gauges expose their high-water twin as its own helped family
+    assert "# TYPE qldpc_serve_queue_depth_max gauge" in lines
+
+
+def test_v7_frozen_chain():
+    # the frozen-version chain (append-never): v7 adds exactly the alert
+    # transition kinds, and every frozen set up the chain still validates
+    assert telemetry._V7_EVENT_KINDS == frozenset(
+        {"alert_fired", "alert_resolved"})
+    for ks in (telemetry._V1_EVENT_KINDS, telemetry._V2_EVENT_KINDS,
+               telemetry._V3_EVENT_KINDS, telemetry._V4_EVENT_KINDS,
+               telemetry._V5_EVENT_KINDS, telemetry._V6_EVENT_KINDS,
+               telemetry._V7_EVENT_KINDS):
+        assert ks <= set(telemetry.EVENT_SCHEMAS)
+    assert telemetry.EVENT_SCHEMA_VERSION >= 7
+
+
+# ---------------------------------------------------------------------------
+# the fleet_gateway CLI's target parsing
+# ---------------------------------------------------------------------------
+def test_fleet_gateway_cli_parse_targets():
+    import fleet_gateway as fg
+
+    got = fg.parse_targets(["a=http://h1:9100", "http://h2:9100/"])
+    assert got == {"a": "http://h1:9100", "host1": "http://h2:9100/"}
+    with pytest.raises(SystemExit):
+        fg.parse_targets(["a=http://h1:9100", "a=http://h2:9100"])
+
+
+def test_telemetry_report_fleet_only_renders_degraded_healthz(capsys):
+    """telemetry_report --fleet works standalone (no JSONL — the operator
+    on a gateway box has none) and still renders when the fleet /healthz
+    answers 503: the degraded body is the whole point of looking."""
+    import telemetry_report as tr
+
+    ha = _start_static({"bp.shots": _counter(41)})
+    clk = {"t": 0.0}
+    gw = FleetGateway(
+        # port 9 (discard) has no listener: host b is down from the start
+        {"a": "http://%s:%s" % ha.address, "b": "http://127.0.0.1:9"},
+        interval_s=5.0, down_after_s=12.0, now=lambda: clk["t"])
+    fh = start_fleet_thread(gw, scrape=False)
+    try:
+        gw.scrape_once(now=0.0)
+        clk["t"] = 20.0
+        gw.scrape_once(now=20.0)
+        assert gw.alerts.firing() == ["host_down:b"]
+        assert tr.main(["--fleet", "http://%s:%s" % fh.address]) == 0
+    finally:
+        fh.stop()
+        ha.stop()
+    out = capsys.readouterr().out
+    assert "DOWN: b" in out          # the 503 body was parsed, not dropped
+    assert "host_down:b" in out      # active-alert block rides along
+    assert "bp.shots" in out and "41" in out
+    with pytest.raises(SystemExit):  # no JSONL and no --fleet: usage error
+        tr.main([])

@@ -549,4 +549,4 @@ def test_stream_events_validate_and_v6_chain():
                telemetry._V3_EVENT_KINDS, telemetry._V4_EVENT_KINDS,
                telemetry._V5_EVENT_KINDS, telemetry._V6_EVENT_KINDS):
         assert ks <= set(telemetry.EVENT_SCHEMAS)
-    assert telemetry.EVENT_SCHEMA_VERSION == 6
+    assert telemetry.EVENT_SCHEMA_VERSION >= 6

@@ -1,0 +1,259 @@
+"""Time-series retention core tests (ISSUE 17 tentpole, part 1): windowed
+counter rates with reset handling, gauge last-value series carrying the
+registry's last-set staleness stamp, histogram quantiles from cumulative
+bucket deltas, bucket-boundary inference, deadman ages, and the background
+Scraper (zero-cost when telemetry is disabled, tick hooks isolated from
+hook failures, optional snapshot-event emission for offline --rates
+reconstruction)."""
+import threading
+
+import pytest
+
+from qldpc_fault_tolerance_tpu.utils import telemetry, timeseries
+from qldpc_fault_tolerance_tpu.utils.timeseries import (
+    Scraper,
+    SeriesStore,
+    hist_quantile,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.disable()
+    telemetry.reset()
+    yield
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _counter(v):
+    return {"type": "counter", "value": v}
+
+
+def _gauge(v, ts=None):
+    return {"type": "gauge", "value": v, "max": v, "ts": ts}
+
+
+def _hist(buckets, counts, total, count):
+    return {"type": "histogram", "buckets": list(buckets),
+            "counts": list(counts), "sum": total, "count": count}
+
+
+# ---------------------------------------------------------------------------
+# hist_quantile: the shared interpolation primitive
+# ---------------------------------------------------------------------------
+def test_hist_quantile_interpolation_and_edges():
+    buckets = (1.0, 2.0, 4.0)
+    # 10 observations in (1, 2]: the median interpolates to the bucket
+    # midpoint
+    assert hist_quantile(buckets, [0, 10, 0, 0], 0.5) == pytest.approx(1.5)
+    # all mass in the first bucket: q interpolates from 0
+    assert hist_quantile(buckets, [4, 0, 0, 0], 0.25) == pytest.approx(0.25)
+    # empty window -> None, never 0.0 (no data is not "fast")
+    assert hist_quantile(buckets, [0, 0, 0, 0], 0.99) is None
+    # quantile landing in overflow clamps to the last finite edge
+    assert hist_quantile(buckets, [0, 0, 0, 5], 0.99) == 4.0
+
+
+# ---------------------------------------------------------------------------
+# SeriesStore: ingestion + windowed derivations
+# ---------------------------------------------------------------------------
+def test_counter_rate_windowed():
+    st = SeriesStore()
+    for i in range(10):  # +100 per second for 10 s
+        st.ingest(float(i), {"c": _counter(100 * i)})
+    assert st.rate("c", window_s=None, now=9.0) == pytest.approx(100.0)
+    # trailing window sees only its own samples
+    assert st.rate("c", window_s=3.0, now=9.0) == pytest.approx(100.0)
+    # fewer than two samples in the window -> None (can't form a delta)
+    assert st.rate("c", window_s=0.5, now=9.0) is None
+    assert st.rate("missing", window_s=60.0, now=9.0) is None
+
+
+def test_counter_reset_is_not_negative_traffic():
+    st = SeriesStore()
+    # 0 -> 500, process restart (value drops to 0), 0 -> 300
+    for ts, v in [(0, 0), (1, 500), (2, 0), (3, 300)]:
+        st.ingest(float(ts), {"c": _counter(v)})
+    # positive-delta sum = 500 + 300 over 3 s; the reset contributes zero
+    assert st.rate("c", window_s=None, now=3.0) == pytest.approx(800 / 3)
+
+
+def test_gauge_last_value_and_staleness_stamp():
+    st = SeriesStore()
+    st.ingest(10.0, {"g": _gauge(7.0, ts=9.5)})
+    st.ingest(20.0, {"g": _gauge(7.0, ts=9.5)})  # re-scraped, not re-set
+    assert st.last_value("g") == 7.0
+    # the registry's last-SET stamp survives retention: the gauge froze at
+    # 9.5 even though the newest scrape is at 20.0
+    assert st.gauge_set_ts("g") == 9.5
+    assert st.kind("g") == "gauge"
+
+
+def test_histogram_windowed_quantile_from_bucket_deltas():
+    st = SeriesStore()
+    buckets = (0.01, 0.1, 1.0)
+    telemetry.set_default_buckets("h", buckets)  # pin the boundary spec
+    try:
+        # old traffic: 100 fast observations, then a slow regime moves in
+        st.ingest(0.0, {"h": _hist(buckets, [100, 0, 0, 0], 0.5, 100)})
+        st.ingest(10.0, {"h": _hist(buckets, [100, 0, 20, 0], 10.5, 120)})
+        # window_s=None diffs the retained span's edge samples: the 100
+        # fast observations predate the first sample, so only the 20 slow
+        # ones count and p50 sits inside (0.1, 1.0] — NOT the <0.01 a
+        # whole-lifetime cumulative read would give
+        assert 0.1 < st.quantile("h", 0.5, window_s=None, now=10.0) <= 1.0
+        # an explicit trailing window derives the same bucket delta
+        got = st.window_hist("h", 8.0, now=10.0)
+        assert got is not None
+        wb, wc, wsum, wcount = got
+        assert wb == buckets and wc == [0, 0, 20, 0]
+        assert wcount == 20 and wsum == pytest.approx(10.0)
+        q50 = st.quantile("h", 0.5, window_s=8.0, now=10.0)
+        assert 0.1 < q50 <= 1.0
+    finally:
+        telemetry.set_default_buckets("h", None)
+
+
+def test_histogram_single_sample_window_uses_prior_base():
+    st = SeriesStore()
+    buckets = (1.0, 2.0)
+    st.ingest(0.0, {"h": _hist(buckets, [5, 0, 0], 2.5, 5)})
+    st.ingest(10.0, {"h": _hist(buckets, [5, 3, 0], 7.0, 8)})
+    # only the ts=10 sample is inside the window, but the delta is taken
+    # against the newest sample BEFORE it -> the window still sees traffic
+    _, wc, _, wcount = st.window_hist("h", 2.0, now=10.0)
+    assert wc == [0, 3, 0] and wcount == 3
+    # a mid-window histogram reset (count decreased) falls back to the
+    # lifetime cumulative counts instead of reporting negatives
+    st.ingest(11.0, {"h": _hist(buckets, [1, 0, 0], 0.1, 1)})
+    _, wc, _, wcount = st.window_hist("h", 5.0, now=11.0)
+    assert wc == [1, 0, 0] and wcount == 1
+
+
+def test_bucket_boundary_inference():
+    st = SeriesStore()
+    # a registered default spec with matching arity wins
+    telemetry.set_default_buckets("custom.h", (5.0, 10.0))
+    try:
+        st.ingest(0.0, {"custom.h": _hist((5.0, 10.0), [0, 0, 0], 0.0, 0)})
+        st.ingest(1.0, {"custom.h": _hist((5.0, 10.0), [0, 4, 0], 30.0, 4)})
+        assert st.quantile("custom.h", 0.5, None, now=1.0) == pytest.approx(
+            7.5)
+    finally:
+        telemetry.set_default_buckets("custom.h", None)
+    # unregistered: the shipped ladders are inferred by count arity
+    n = len(telemetry.LATENCY_BUCKETS)
+    st.ingest(0.0, {"lat.h": _hist(telemetry.LATENCY_BUCKETS,
+                                   [0] * (n + 1), 0.0, 0)})
+    got = st.window_hist("lat.h", None)
+    assert got[0] == tuple(telemetry.LATENCY_BUCKETS)
+
+
+def test_age_tracks_last_change_not_last_scrape():
+    st = SeriesStore()
+    assert st.age("c") is None  # never seen: no heartbeat, not a healthy one
+    st.ingest(0.0, {"c": _counter(5)})
+    st.ingest(10.0, {"c": _counter(5)})  # scraped but unchanged
+    assert st.age("c", now=12.0) == pytest.approx(12.0)
+    st.ingest(20.0, {"c": _counter(6)})  # the counter moved: heartbeat
+    assert st.age("c", now=21.0) == pytest.approx(1.0)
+
+
+def test_retention_is_bounded():
+    st = SeriesStore(retention=4)
+    for i in range(10):
+        st.ingest(float(i), {"c": _counter(i)})
+    pts = st.samples("c")
+    assert len(pts) == 4 and pts[0][0] == 6.0 and pts[-1][0] == 9.0
+    # the windowed rate still works off the retained ring
+    assert st.rate("c", window_s=None, now=9.0) == pytest.approx(1.0)
+
+
+def test_type_reregistration_replaces_series():
+    st = SeriesStore()
+    st.ingest(0.0, {"x": _counter(3)})
+    st.ingest(1.0, {"x": _gauge(9.0, ts=1.0)})
+    assert st.kind("x") == "gauge" and st.last_value("x") == 9.0
+    assert len(st.samples("x")) == 1  # the counter history is gone
+
+
+# ---------------------------------------------------------------------------
+# Scraper: the background sampler
+# ---------------------------------------------------------------------------
+def test_scraper_zero_cost_when_disabled():
+    sc = Scraper(interval_s=0.01)
+    assert sc.scrape_once(now=1.0) is False
+    assert sc.store.names() == []  # nothing sampled, nothing retained
+
+
+def test_scraper_tick_ingests_and_counts():
+    telemetry.enable()
+    sc = Scraper(interval_s=0.01, now=lambda: 0.0)
+    telemetry.count("bp.shots", 100)
+    assert sc.scrape_once(now=1.0) is True
+    telemetry.count("bp.shots", 100)
+    assert sc.scrape_once(now=2.0) is True
+    assert sc.store.rate("bp.shots", window_s=None, now=2.0) == \
+        pytest.approx(100.0)
+    # the scraper heartbeats its own tick counter (the deadman rides it)
+    assert telemetry.snapshot()["timeseries.scrapes"]["value"] == 2
+
+
+def test_scraper_hook_errors_counted_not_raised():
+    telemetry.enable()
+    sc = Scraper(interval_s=0.01)
+    seen = []
+
+    def good(store, now):
+        seen.append(now)
+
+    def bad(store, now):
+        raise RuntimeError("broken rule")
+
+    sc.add_tick_hook(bad)
+    sc.add_tick_hook(good)
+    assert sc.scrape_once(now=5.0) is True  # the bad hook did not kill it
+    assert seen == [5.0]
+    assert telemetry.snapshot()["timeseries.hook_errors"]["value"] == 1
+
+
+def test_scraper_snapshot_events_rebuild_the_store_offline():
+    """emit_snapshot_events bridges live retention to the JSONL stream:
+    a store rebuilt from the emitted snapshot events derives the SAME
+    rate as the live one (telemetry_report --rates runs this path)."""
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        sc = Scraper(interval_s=0.01, emit_snapshot_events=True)
+        for i in range(1, 4):
+            telemetry.count("bp.shots", 50)
+            sc.scrape_once(now=float(i))
+        snaps = [r for r in sink.records if r["kind"] == "snapshot"]
+        assert len(snaps) == 3
+        rebuilt = SeriesStore()
+        for i, rec in enumerate(snaps, start=1):
+            rebuilt.ingest(float(i), rec["metrics"])
+        assert rebuilt.rate("bp.shots", window_s=None, now=3.0) == \
+            sc.store.rate("bp.shots", window_s=None, now=3.0)
+    finally:
+        telemetry.remove_sink(sink)
+
+
+def test_scraper_thread_start_stop():
+    telemetry.enable()
+    sc = Scraper(interval_s=0.005)
+    sc.start()
+    try:
+        assert sc.start() is sc  # idempotent while running
+        deadline = threading.Event()
+        for _ in range(200):  # up to ~2 s for a few ticks
+            if telemetry.snapshot().get(
+                    "timeseries.scrapes", {}).get("value", 0) >= 2:
+                break
+            deadline.wait(0.01)
+    finally:
+        sc.stop()
+    assert telemetry.snapshot()["timeseries.scrapes"]["value"] >= 2
+    assert sc._thread is None  # restartable after stop
