@@ -2279,6 +2279,161 @@ def mode_stream():
     }
 
 
+def mode_fleet():
+    """Multi-host serving fabric (ISSUE 18): a 2-host in-process fleet
+    behind the family-sticky router, a closed-loop reconnect storm, and a
+    seeded ``host_kill`` mid-storm — the family's owner dies hard, the
+    gateway's deadman fires, and the router hands the family off to the
+    successor while the storm keeps running.
+
+    Headline: sustained fleet req/s THROUGH the kill.  Also reported:
+    handoff p99 (gate -> flush -> adopt -> reopen wall clock).  Gates:
+    every submitted request answered exactly once (zero client errors,
+    answered == submitted), corrections bit-exact vs offline decode,
+    the handoff actually fired (deadman-driven — nothing in the storm
+    calls failover by hand).  Env knobs: BENCH_FLEET_REQS /
+    BENCH_FLEET_SEED."""
+    import threading
+    from collections import deque
+
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BP_Decoder_Class
+    from qldpc_fault_tolerance_tpu.serve import (
+        DecodeClient,
+        DecodeSession,
+        LocalFleet,
+    )
+    from qldpc_fault_tolerance_tpu.utils import (
+        faultinject,
+        resilience,
+        telemetry,
+    )
+
+    reqs = int(os.environ.get("BENCH_FLEET_REQS", "40"))
+    seed = int(os.environ.get("BENCH_FLEET_SEED", "18"))
+    tenants = 2
+    window = 8
+    p = 0.05
+    code = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+    cls = BP_Decoder_Class(4, "minimum_sum", 0.625)
+    params = {"h": code.hx, "p_data": p}
+    h_t = np.asarray(code.hx, np.uint8).T
+
+    prev_policy = resilience.current_policy()
+    resilience.set_default_policy(resilience.RetryPolicy(
+        max_attempts=2, base_delay=0.05, backoff=1.0, jitter=0.0,
+        reset_caches=False, degrade_after=1))
+    try:
+        with _tele_region():
+            fleet = LocalFleet(
+                lambda: {"hgp_rep3": DecodeSession(
+                    "hgp_rep3", decoder_class=cls, params=params,
+                    buckets=(32, 64, 128))},
+                n_hosts=2, warm=True,
+                batcher_kwargs={"max_batch_shots": 64,
+                                "max_wait_s": 0.002,
+                                "max_dispatch_attempts": 4})
+            host, port = fleet.address
+            # the kill lands mid-storm: the tick site counts one hit per
+            # finished request across all tenants
+            plan = faultinject.FaultPlan([
+                faultinject.Fault(site="fleet_host_tick",
+                                  kind="host_kill", after=reqs)
+            ], seed=seed)
+            results, errors = [], []
+
+            def worker(idx):
+                try:
+                    cli = DecodeClient(host, port, tenant=f"tenant{idx}",
+                                       reconnect=True, timeout=60.0)
+                    rng = np.random.default_rng(1000 * seed + idx)
+                    pending = deque()
+
+                    def finish_one():
+                        synd, fut = pending.popleft()
+                        res = fut.result(timeout=120)
+                        results.append((synd, res.corrections))
+                        fleet.chaos_tick()
+
+                    for _ in range(reqs):
+                        k = int(rng.integers(1, 9))
+                        err = (rng.random((k, code.N)) < p).astype(
+                            np.uint8)
+                        synd = (err @ h_t % 2).astype(np.uint8)
+                        pending.append((synd,
+                                        cli.submit("hgp_rep3", synd)))
+                        if len(pending) >= window:
+                            finish_one()
+                    while pending:
+                        finish_one()
+                    cli.close()
+                except Exception as exc:  # noqa: BLE001 — gated below
+                    errors.append(f"{type(exc).__name__}: {exc}")
+
+            threads = [threading.Thread(target=worker, args=(i,))
+                       for i in range(tenants)]
+            t0 = time.perf_counter()
+            with plan.active():
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+            storm_s = time.perf_counter() - t0
+            snap = telemetry.snapshot()
+            handoff_durs = fleet.router.handoff_durations()
+            handoffs = fleet.router.handoff_report()
+            fleet.stop()
+    finally:
+        resilience.set_default_policy(prev_policy)
+
+    def val(name):
+        return snap.get(name, {}).get("value", 0)
+
+    answered = len(results)
+    submitted = reqs * tenants
+    synd = np.concatenate([s for s, _ in results]) if results else None
+    served = np.concatenate([c for _, c in results]) if results else None
+    offline = (cls.GetDecoder(params).decode_batch(synd)
+               if synd is not None else None)
+    bitexact = bool(results and np.array_equal(served, offline))
+    exactly_once = bool(not errors and answered == submitted)
+    handoff_p99_ms = (round(float(np.percentile(
+        1e3 * np.asarray(handoff_durs), 99)), 2)
+        if handoff_durs else None)
+    req_per_s = round(answered / storm_s, 1) if storm_s else None
+    return {
+        "metric": f"fleet storm through host_kill (seed={seed}, "
+                  f"{submitted} reqs x {tenants} tenants, 2 hosts)",
+        "value": req_per_s,
+        "unit": "req/s",
+        "vs_baseline": None,
+        "seed": seed,
+        "requests": submitted,
+        "answered": answered,
+        "storm_s": round(storm_s, 3),
+        "fleet": {
+            "req_per_s": req_per_s,
+            "handoff_p99_ms": handoff_p99_ms,
+            "handoffs": handoffs,
+        },
+        "host_kills": val("serve.host_kills"),
+        "replication_pushes": val("router.replication_pushes"),
+        "journal_imported": val("serve.journal.imported"),
+        "dedup_replayed": val("serve.dedup.replayed"),
+        "route_stale": val("serve.route_stale"),
+        "reconnects": val("serve.client.reconnects"),
+        "client_errors": errors[:4],
+        "gates": {
+            "exactly_once": exactly_once,
+            "bitexact_vs_offline": bitexact,
+            "handoff_fired": bool(val("router.handoffs") >= 1
+                                  and val("serve.host_kills") >= 1),
+        },
+    }
+
+
 MODES = {
     "bp": mode_bp,
     "bposd": mode_bposd,
@@ -2290,6 +2445,7 @@ MODES = {
     "rare": mode_rare,
     "chaos": mode_chaos,
     "stream": mode_stream,
+    "fleet": mode_fleet,
 }
 
 

@@ -54,6 +54,18 @@ request-driven decoder service:
                 merges counters bit-exactly / histogram buckets
                 additively with per-host labels, re-serves fleet
                 /metrics /healthz /alertz; host-down is a deadman alert.
+  router.py     multi-host serving fabric (ISSUE 18): FleetRouter places
+                sessions on hosts by bucket FAMILY (consistent hash —
+                never per session, which would de-fuse the fused
+                dispatch), forwards client frames in a routing envelope
+                with an epoch fence, incrementally replicates each
+                host's answered journal + stream ledgers to the family
+                successor, and on the gateway's host-down deadman
+                performs the sticky exactly-once handoff (gate, flush
+                to the watermark, adopt at epoch+1).  FleetScaler drives
+                per-host AutoScalers + live family rebalancing;
+                LocalFleet is the in-process N-host harness behind
+                ``bench.py fleet`` and the fleet chaos acceptance.
 
 Per-request observability (ISSUE 11): trace contexts ride an optional
 wire-frame field end to end (utils.tracing) — queue_wait / batch_assemble
@@ -90,6 +102,16 @@ from .ops import (
     start_ops_thread,
 )
 from .fleet import FleetGateway, FleetHandle, FleetServer, start_fleet_thread
+from .router import (
+    FleetRouter,
+    FleetScaler,
+    HashRing,
+    LocalFleet,
+    RouterFleetServer,
+    RouterHandle,
+    start_router_ops_thread,
+    start_router_thread,
+)
 from .server import DecodeServer, ServerHandle, start_server_thread
 from .client import ClientResult, DecodeClient
 
@@ -119,6 +141,14 @@ __all__ = [
     "FleetHandle",
     "FleetServer",
     "start_fleet_thread",
+    "FleetRouter",
+    "FleetScaler",
+    "HashRing",
+    "LocalFleet",
+    "RouterFleetServer",
+    "RouterHandle",
+    "start_router_ops_thread",
+    "start_router_thread",
     "DecodeServer",
     "ServerHandle",
     "start_server_thread",

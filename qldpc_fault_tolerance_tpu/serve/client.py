@@ -64,6 +64,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import os
 import socket
 import threading
 import time
@@ -128,8 +129,9 @@ class _Inflight:
 class DecodeClient:
     def __init__(self, host: str, port: int, *, tenant: str = "default",
                  timeout: float = 60.0, traced: bool = False,
-                 reconnect: bool = False, max_reconnects: int = 8,
-                 reconnect_backoff_s: float = 0.05,
+                 reconnect: bool = False,
+                 max_reconnects: "int | None" = None,
+                 reconnect_backoff_s: "float | None" = None,
                  hedge_s: float | None = None, max_hedges: int = 1,
                  idempotent: bool | None = None,
                  codec: "int | str" = "auto"):
@@ -147,8 +149,24 @@ class DecodeClient:
         self._codec_req = codec
         self.wire_codec = WIRE_CODEC_JSON
         self.reconnect = bool(reconnect)
+        # dial/redial policy (ISSUE 18 satellite): env-tunable defaults
+        # (an operator retunes a fleet's reconnect storm behavior without
+        # touching code), explicit arguments win.  The delay schedule
+        # itself comes from utils.resilience.RetryPolicy — the ONE backoff
+        # implementation — capped at 2 s like the historical inline dial
+        # loop, with no jitter so chaos tests stay deterministic.
+        if max_reconnects is None:
+            max_reconnects = int(os.environ.get(
+                "QLDPC_CLIENT_RETRY_ATTEMPTS", "8"))
+        if reconnect_backoff_s is None:
+            reconnect_backoff_s = float(os.environ.get(
+                "QLDPC_CLIENT_RETRY_BASE_S", "0.05"))
         self.max_reconnects = max(1, int(max_reconnects))
         self.reconnect_backoff_s = float(reconnect_backoff_s)
+        self._dial_policy = resilience.RetryPolicy(
+            max_attempts=self.max_reconnects,
+            base_delay=self.reconnect_backoff_s, backoff=2.0,
+            max_delay=2.0, jitter=0.0, reset_caches=False)
         self.hedge_s = None if hedge_s is None else float(hedge_s)
         self.max_hedges = max(0, int(max_hedges))
         # resubmits and hedges only dedupe server-side when requests carry
@@ -430,15 +448,15 @@ class DecodeClient:
         new connection is live.  ``fast_death`` (the previous connection
         died near-instantly) makes even the first dial back off."""
         # a reconnect dial is transport recovery, not device-work retry:
-        # RetryPolicy's between-attempt reset_device_state and sweep-scale
-        # backoff have no business on a network client; attempts still
-        # sleep via the sanctioned resilience.sleep_for and are counted
+        # the loop shape stays bespoke (swap-under-lock, renegotiate) but
+        # the attempt budget and delay schedule come from the client's
+        # RetryPolicy dial policy (env-tunable), and attempts still sleep
+        # via the sanctioned resilience.sleep_for
         for attempt in range(self.max_reconnects):  # qldpc: ignore[R102]
             if self._closed:
                 return False
             if attempt or fast_death:
-                resilience.sleep_for(
-                    min(2.0, self.reconnect_backoff_s * (2 ** attempt)))
+                resilience.sleep_for(self._dial_policy.delay(attempt))
             try:
                 sock = socket.create_connection(
                     (self.host, self.port), timeout=self.timeout)
@@ -679,8 +697,7 @@ class DecodeClient:
         last: Exception | None = None
         for attempt in range(max(1, int(retries))):  # qldpc: ignore[R102]
             if attempt:
-                resilience.sleep_for(
-                    min(2.0, self.reconnect_backoff_s * (2 ** attempt)))
+                resilience.sleep_for(self._dial_policy.delay(attempt))
             try:
                 return self._submit_raw(msg).result(timeout=self.timeout)
             except ConnectionError as exc:
@@ -726,8 +743,7 @@ class DecodeClient:
         last: Exception | None = None
         for attempt in range(max(1, int(retries))):  # qldpc: ignore[R102]
             if attempt:
-                resilience.sleep_for(
-                    min(2.0, self.reconnect_backoff_s * (2 ** attempt)))
+                resilience.sleep_for(self._dial_policy.delay(attempt))
             try:
                 res = self._submit_raw(msg).result(timeout=self.timeout)
             except ConnectionError as exc:

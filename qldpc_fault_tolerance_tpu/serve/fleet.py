@@ -144,6 +144,15 @@ class FleetGateway:
                 metric=f"fleet.host.{label}.ok_scrapes",
                 kind="deadman", window_s=self.down_after_s,
                 severity="critical"))
+        # merge loss is operator-visible, not just a /varz list (ISSUE 18
+        # satellite): every scrape whose merge skipped metrics (type
+        # conflict / histogram boundary mismatch) bumps a counter, and a
+        # default rate rule pages while skips keep happening
+        self._merge_skips = 0
+        self.alerts.add_rule(ops.AlertRule(
+            name="fleet_merge_skips", metric="fleet.merge_skips",
+            kind="threshold", mode="rate", op=">", threshold=0.0,
+            window_s=self.down_after_s, severity="warning"))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
@@ -187,6 +196,18 @@ class FleetGateway:
                     {"type": "counter",
                      "value": self._hosts[label]["ok_scrapes"]}
                 for label in self.targets}
+            per_host = {label: st["snap"] for label, st in
+                        self._hosts.items() if st["snap"]}
+        # count this round's merge skips (a skipped metric stays skipped
+        # every round it conflicts — the rate rule fires for as long as
+        # the conflict persists, which is exactly the operator signal)
+        skips = len(merge_snapshots(per_host)["skipped"])
+        if skips:
+            telemetry.count("fleet.merge_skips", skips)
+        with self._lock:
+            self._merge_skips += skips
+            heartbeats["fleet.merge_skips"] = {
+                "type": "counter", "value": self._merge_skips}
         self.store.ingest(now, heartbeats)
         self.alerts.evaluate(now=now)
         telemetry.count("fleet.scrapes")
@@ -292,13 +313,25 @@ class FleetGateway:
         return {"active": active, "resolved": resolved,
                 "hosts": sorted(self.targets), "scrapes": int(self.scrapes)}
 
+    def host_loads(self) -> dict:
+        """Per-host load signal for the fleet scaler: each host's last
+        /healthz queue depth (None while a host has never been scraped or
+        its healthz omitted one).  Reads the scrape cache only — never
+        blocks on the network."""
+        with self._lock:
+            return {label: (st["healthz"] or {}).get("queue_depth")
+                    for label, st in self._hosts.items()}
+
     def varz(self) -> dict:
         fleet = self.merged()
+        with self._lock:
+            merge_skips = int(self._merge_skips)
         return {"targets": dict(self.targets),
                 "scrapes": int(self.scrapes),
                 "merged": fleet["merged"],
                 "gauges": fleet["gauges"],
-                "merge_skipped": fleet["skipped"]}
+                "merge_skipped": fleet["skipped"],
+                "merge_skips": merge_skips}
 
     # -- daemon loop (Event.wait, no bare sleep) ------------------------
     def start(self) -> "FleetGateway":

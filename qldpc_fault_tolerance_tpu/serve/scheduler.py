@@ -71,7 +71,7 @@ import dataclasses
 import threading
 import time
 from collections import OrderedDict, deque
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 
 import numpy as np
 
@@ -95,6 +95,23 @@ class DecodeResult:
     converged: np.ndarray | None     # (k,) bool when the decoder reports it
     request_id: str | None
     latency_s: float                 # submit -> completion, scheduler-side
+
+
+def _resolve(fut: Future, result=None,
+             exc: "BaseException | None" = None) -> bool:
+    """Resolve a request future, tolerating one that was already resolved
+    or CANCELLED underneath us: a killed host's response waiters cancel
+    their wrapped futures (ISSUE 18 ``host_kill`` chaos), and the dispatch
+    completing a moment later must count the orphan, not die on it."""
+    try:
+        if exc is not None:
+            fut.set_exception(exc)
+        else:
+            fut.set_result(result)
+        return True
+    except InvalidStateError:
+        telemetry.count("serve.futures_orphaned")
+        return False
 
 
 @dataclasses.dataclass
@@ -291,6 +308,12 @@ class ContinuousBatcher:
         # hedge threading the gap would decode twice.
         self._journal: dict[str, _Request] = {}
         self._answered: "OrderedDict[str, DecodeResult]" = OrderedDict()
+        # replication bookkeeping (ISSUE 18): every answered entry gets a
+        # monotone sequence number so the fleet router's incremental feed
+        # can pull "everything after watermark w" instead of full
+        # snapshots; seqs die with their entries on LRU eviction
+        self._journal_seq = 0
+        self._answered_seqs: dict = {}
         # dispatch-failure incidents for the self-healing probe
         # (serve.ops.HealthProbe.take via take_incidents)
         self._incidents: deque = deque(maxlen=256)
@@ -323,13 +346,13 @@ class ContinuousBatcher:
         dst: Future = Future()
 
         def _copy(f):
-            if dst.done():
+            if dst.done() or f.cancelled():
                 return
             exc = f.exception()
             if exc is not None:
-                dst.set_exception(exc)
+                _resolve(dst, exc=exc)
             else:
-                dst.set_result(f.result())
+                _resolve(dst, f.result())
 
         src.add_done_callback(_copy)
         return dst
@@ -817,14 +840,17 @@ class ContinuousBatcher:
                     request_id=res.request_id, latency_s=res.latency_s)
                 self._answered[r.idem] = cached
                 self._answered_bytes += self._result_nbytes(cached)
+                self._journal_seq += 1
+                self._answered_seqs[r.idem] = self._journal_seq
             while self._answered and (
                     len(self._answered) > self.answered_cache
                     or self._answered_bytes > self.answered_cache_bytes):
-                _, old = self._answered.popitem(last=False)
+                key, old = self._answered.popitem(last=False)
                 self._answered_bytes -= self._result_nbytes(old)
+                self._answered_seqs.pop(key, None)
         for r, res in zip(batch, results):
             lat = res.latency_s
-            r.future.set_result(res)
+            _resolve(r.future, res)
             self.completed += 1
             if self.slo is not None:
                 self.slo.observe_request(r.tenant, lat, ok=True)
@@ -931,7 +957,7 @@ class ContinuousBatcher:
         for r in dead:
             if self.slo is not None:
                 self.slo.observe_request(r.tenant, now - r.t0, ok=False)
-            r.future.set_exception(exc)
+            _resolve(r.future, exc=exc)
 
     # ------------------------------------------------------------------
     # chaos enactments (utils.faultinject action kinds)
@@ -1047,6 +1073,78 @@ class ContinuousBatcher:
             }
 
     # ------------------------------------------------------------------
+    # journal replication (ISSUE 18: exactly-once across a host handoff)
+    # ------------------------------------------------------------------
+    def export_journal(self, since: int = 0) -> dict:
+        """Snapshot the answered-LRU entries sequenced AFTER ``since`` as a
+        JSON-serializable delta: the fleet router pulls these incrementally
+        (per-source watermark) and pushes them to the family's successor
+        host, so a handoff replays every already-answered (tenant, session,
+        idem) instead of re-decoding — the cross-host half of exactly-once.
+        In-flight journal entries are deliberately NOT exported: an
+        unanswered request's client resubmits after the host dies and the
+        successor decodes it fresh (deterministically, so still bit-exact).
+        """
+        entries = []
+        with self._cv:
+            watermark = self._journal_seq
+            for key, seq in self._answered_seqs.items():
+                if seq <= since:
+                    continue
+                res = self._answered.get(key)
+                if res is None:
+                    continue
+                entries.append({
+                    "seq": int(seq),
+                    "key": list(key) if isinstance(key, tuple) else key,
+                    "corrections": res.corrections.tolist(),
+                    "converged": (None if res.converged is None
+                                  else res.converged.tolist()),
+                    "request_id": res.request_id,
+                    "latency_s": float(res.latency_s),
+                })
+        entries.sort(key=lambda e: e["seq"])
+        return {"watermark": int(watermark), "entries": entries}
+
+    def import_journal(self, snapshot: dict) -> int:
+        """Merge one replication delta (an ``export_journal`` payload from
+        another host) into the answered LRU, idempotent by key: an entry
+        already present locally (this host answered or previously imported
+        it) is skipped, everything else becomes a replayable cached answer
+        under the normal count/byte LRU bounds.  Returns the number of
+        entries actually imported."""
+        imported = 0
+        with self._cv:
+            for entry in sorted(snapshot.get("entries", ()),
+                                key=lambda e: e.get("seq", 0)):
+                key = entry["key"]
+                if isinstance(key, list):
+                    key = tuple(key)
+                if key in self._answered:
+                    continue
+                conv = entry.get("converged")
+                cached = DecodeResult(
+                    corrections=np.asarray(entry["corrections"], np.uint8),
+                    converged=(None if conv is None
+                               else np.asarray(conv, bool)),
+                    request_id=entry.get("request_id"),
+                    latency_s=float(entry.get("latency_s", 0.0)))
+                self._answered[key] = cached
+                self._answered_bytes += self._result_nbytes(cached)
+                self._journal_seq += 1
+                self._answered_seqs[key] = self._journal_seq
+                imported += 1
+            while self._answered and (
+                    len(self._answered) > self.answered_cache
+                    or self._answered_bytes > self.answered_cache_bytes):
+                key, old = self._answered.popitem(last=False)
+                self._answered_bytes -= self._result_nbytes(old)
+                self._answered_seqs.pop(key, None)
+        if imported:
+            telemetry.count("serve.journal.imported", imported)
+        return imported
+
+    # ------------------------------------------------------------------
     # shutdown
     # ------------------------------------------------------------------
     def drain(self, timeout: float | None = 60.0) -> None:
@@ -1092,5 +1190,5 @@ class ContinuousBatcher:
             telemetry.set_gauge("serve.queue_depth", 0)
             self._cv.notify_all()
         for r in pending:
-            r.future.set_exception(RuntimeError("scheduler closed"))
+            _resolve(r.future, exc=RuntimeError("scheduler closed"))
         self._thread.join(timeout=10.0)

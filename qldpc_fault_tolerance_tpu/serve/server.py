@@ -55,6 +55,7 @@ import asyncio
 import json
 import threading
 import time
+import uuid
 
 import numpy as np
 
@@ -66,6 +67,7 @@ from .wire import (
     HEADER,
     IDEM_FIELD,
     MAX_FRAME_BYTES,
+    ROUTE_FIELD,
     TRACE_FIELD,
     WIRE_CODEC_JSON,
     WIRE_CODEC_PACKED,
@@ -135,6 +137,18 @@ class DecodeServer:
             stream_profiles or {})
         self._streams: dict[str, StreamSession] = {}
         self._stream_counter = 0
+        # stream ids carry a per-server random prefix (ISSUE 18): a fleet
+        # re-homes streams ACROSS hosts by id, and two hosts both minting
+        # "st-0001" would collide in the successor's ledger on handoff
+        self._stream_prefix = uuid.uuid4().hex[:6]
+        # routing-epoch fence (ISSUE 18): family -> (epoch, own).  Set by
+        # the fleet router's ``family_adopt`` broadcasts; a routed frame
+        # whose (family, epoch) this host does not currently own is
+        # refused with ``route_stale`` so a partitioned router's stale
+        # placement can never cause a double decode on the old owner.
+        # Direct (un-routed) frames bypass the fence entirely — single-
+        # host deployments never see it.
+        self._family_epochs: dict[str, tuple[int, bool]] = {}
 
     async def start(self) -> None:
         self._server = await asyncio.start_server(
@@ -199,6 +213,23 @@ class DecodeServer:
                                  f"{type(msg).__name__}"})
                     continue
                 op = msg.get("op")
+                route = msg.pop(ROUTE_FIELD, None)
+                if route is not None and not self._route_ok(route):
+                    # the epoch fence: this host does not (or no longer)
+                    # own(s) the frame's family at the router's epoch —
+                    # refuse loudly so the router re-resolves placement
+                    # and re-forwards; dispatching here could double-
+                    # decode against the family's real owner
+                    telemetry.count("serve.route_stale")
+                    cur = self._family_epochs.get(str(route.get("family")))
+                    await self._write(writer, wlock, {
+                        "id": msg.get("id"), "ok": False,
+                        "route_stale": True,
+                        "family": route.get("family"),
+                        "epoch": 0 if cur is None else int(cur[0]),
+                        "error": "routed frame fenced: host does not own "
+                                 "this family at that epoch"})
+                    continue
                 if op == "ping":
                     await self._write(writer, wlock, {
                         "ok": True, "pong": True,
@@ -216,6 +247,15 @@ class DecodeServer:
                 elif op == "stream_commit":
                     await self._write(writer, wlock,
                                       self._stream_commit(msg))
+                elif op == "family_adopt":
+                    await self._write(writer, wlock,
+                                      self._family_adopt(msg))
+                elif op == "journal_export":
+                    await self._write(writer, wlock,
+                                      self._journal_export(msg))
+                elif op == "journal_import":
+                    await self._write(writer, wlock,
+                                      self._journal_import(msg))
                 else:
                     await self._write(writer, wlock, {
                         "id": msg.get("id"), "ok": False,
@@ -292,6 +332,140 @@ class DecodeServer:
                 "draining": self._draining}
 
     # ------------------------------------------------------------------
+    # fleet handoff plane (ISSUE 18): epoch fence + journal replication
+    # ------------------------------------------------------------------
+    def _route_ok(self, route) -> bool:
+        """May a routed frame dispatch here?  Only when this host has been
+        told (via ``family_adopt``) that it OWNS the frame's family, at an
+        epoch no newer than the frame's — an un-adopted family or a frame
+        carrying an older epoch than our fence means the router's
+        placement view and ours disagree, and the router must re-resolve."""
+        if not isinstance(route, dict):
+            return False
+        cur = self._family_epochs.get(str(route.get("family")))
+        if cur is None or not cur[1]:
+            return False
+        try:
+            return int(route.get("epoch", -1)) >= int(cur[0])
+        except (TypeError, ValueError):
+            return False
+
+    def _family_adopt(self, msg) -> dict:
+        """The router's placement assertion: ``own=True`` makes this host
+        the family's dispatching owner at ``epoch``; ``own=False`` fences
+        it off (the old owner after a handoff, or every non-owner on a
+        placement broadcast).  Monotone in epoch — an older assertion
+        (a partitioned router's late broadcast) never rolls the fence
+        back.  Idempotent, so the router re-asserts freely."""
+        rid = msg.get("id")
+        family = str(msg.get("family", ""))
+        if not family:
+            return {"id": rid, "ok": False, "error": "family_adopt misses "
+                                                     "its family"}
+        try:
+            epoch = int(msg.get("epoch", 0))
+        except (TypeError, ValueError):
+            return {"id": rid, "ok": False,
+                    "error": f"bad epoch {msg.get('epoch')!r}"}
+        own = bool(msg.get("own", True))
+        cur = self._family_epochs.get(family)
+        if cur is not None and epoch < cur[0]:
+            return {"id": rid, "ok": False, "stale_epoch": True,
+                    "family": family, "epoch": int(cur[0]),
+                    "error": f"adopt epoch {epoch} is behind fence "
+                             f"{cur[0]}"}
+        missing = [s for s in (msg.get("sessions") or ())
+                   if s not in self.batcher.sessions]
+        if own and missing:
+            return {"id": rid, "ok": False, "family": family,
+                    "missing_sessions": missing,
+                    "error": f"cannot adopt {family}: sessions {missing} "
+                             "not resident on this host"}
+        changed = cur != (epoch, own)
+        self._family_epochs[family] = (epoch, own)
+        if changed:
+            # the router re-asserts placement periodically (idempotent
+            # broadcasts) — only a real transition is worth an event
+            telemetry.count("serve.family_adopts")
+            telemetry.event("scale_event", action="family_adopt",
+                            target=family, to_value=epoch,
+                            reason=("own" if own else "fence"))
+        return {"id": rid, "ok": True, "family": family, "epoch": epoch,
+                "own": own}
+
+    def _journal_export(self, msg) -> dict:
+        """One replication pull: the scheduler's answered-LRU delta after
+        the caller's watermark, plus every open stream's committed state
+        (small: a carry plane + the cached replay response per stream).
+        The fleet router feeds these to the family's successor so a
+        handoff replays instead of re-decoding."""
+        rid = msg.get("id")
+        try:
+            since = int(msg.get("since", 0))
+        except (TypeError, ValueError):
+            return {"id": rid, "ok": False,
+                    "error": f"bad since {msg.get('since')!r}"}
+        snap = self.batcher.export_journal(since=since)
+        snap["streams"] = [s.export_state()
+                           for s in list(self._streams.values())]
+        return {"id": rid, "ok": True, **snap}
+
+    def _journal_import(self, msg) -> dict:
+        """One replication push: merge a peer host's ``journal_export``
+        delta.  Answered entries join the local answered-LRU (idempotent
+        by key); stream states rebuild or advance local ``StreamSession``
+        ledgers under their ORIGINAL ids, so after adoption the client's
+        same-seq retries replay or resume exactly-once."""
+        rid = msg.get("id")
+        snap = msg.get("snapshot")
+        if not isinstance(snap, dict):
+            return {"id": rid, "ok": False,
+                    "error": "journal_import misses its snapshot"}
+        imported = self.batcher.import_journal(snap)
+        streams = 0
+        for state in snap.get("streams", ()):
+            sid = state.get("stream")
+            if not sid:
+                continue
+            stream = self._streams.get(sid)
+            if stream is None:
+                stream = self._rebuild_stream(state)
+                if stream is None:
+                    telemetry.count("serve.stream_import_failures")
+                    continue
+                self._streams[sid] = stream
+                telemetry.set_gauge("stream.open_streams",
+                                    len(self._streams))
+            if stream.import_state(state):
+                streams += 1
+        return {"id": rid, "ok": True, "imported": int(imported),
+                "streams": int(streams),
+                "watermark": int(snap.get("watermark", 0))}
+
+    def _rebuild_stream(self, state) -> "StreamSession | None":
+        """Reconstruct a replicated stream's ledger from its exported
+        state: the profile (or bare session, frame mode) must be resident
+        here — the router only pairs hosts serving the same session set."""
+        name = str(state.get("profile") or "")
+        profile = self.stream_profiles.get(name)
+        if profile is None:
+            if name not in self.batcher.sessions:
+                return None
+            profile = StreamProfile(session=name)
+        try:
+            session = self.batcher.sessions.get(profile.session)
+            stream = StreamSession(
+                str(state["stream"]), session,
+                lanes=int(state.get("lanes", 1)),
+                space_cor=profile.space_cor, log_mat=profile.log_mat,
+                cycles_per_window=profile.cycles_per_window,
+                tenant=str(state.get("tenant", "default")))
+        except (KeyError, ValueError, TypeError):
+            return None
+        stream.profile_name = name
+        return stream
+
+    # ------------------------------------------------------------------
     # streaming decode (ISSUE 16)
     # ------------------------------------------------------------------
     def _stream_open(self, msg) -> dict:
@@ -325,7 +499,7 @@ class DecodeServer:
                     "error": f"lanes must be an int, got "
                              f"{msg.get('lanes')!r}"}
         self._stream_counter += 1
-        sid = f"st-{self._stream_counter:04d}"
+        sid = f"st-{self._stream_prefix}-{self._stream_counter:04d}"
         try:
             stream = StreamSession(
                 sid, session, lanes=lanes, space_cor=profile.space_cor,
@@ -333,6 +507,9 @@ class DecodeServer:
                 cycles_per_window=profile.cycles_per_window, tenant=tenant)
         except ValueError as exc:
             return {"id": rid, "ok": False, "error": str(exc)}
+        # the opening profile name travels with the stream's exported
+        # state so a successor host can rebuild the ledger on handoff
+        stream.profile_name = name
         self._streams[sid] = stream
         telemetry.count("stream.opens")
         telemetry.set_gauge("stream.open_streams", len(self._streams))
@@ -700,6 +877,39 @@ class DecodeServer:
             telemetry.event("serve_drain", pending_requests=-1,
                             completed=int(self.batcher.completed))
 
+    async def abort_hard(self) -> None:
+        """Die like a killed host (ISSUE 18 ``host_kill`` chaos): stop
+        accepting, cancel every response/connection task BEFORE the
+        batcher closes — so in-flight requests vanish as TRANSPORT death,
+        never as structured error frames (a real power loss writes
+        nothing) — and only then tear the batcher down.  Clients must
+        recover purely through reconnect + idempotent resubmit against
+        the family's successor host."""
+        if self._server is not None:
+            self._server.close()
+        for task in list(self._tasks):
+            task.cancel()
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)
+        for conn in list(self._conns):
+            conn.cancel()
+        if self._conns:
+            await asyncio.gather(*list(self._conns), return_exceptions=True)
+        # the draining flag only flips AFTER every connection is gone: a
+        # conn task processing its last frame between our cancel and its
+        # next await point must die silently, not answer a structured
+        # "draining" refusal — the client would take that as a permanent
+        # per-request failure instead of resubmitting to the successor
+        self._draining = True
+        await asyncio.get_running_loop().run_in_executor(
+            None, self.batcher.close)
+        # streams die with the host — NO stream_close events: the ledger
+        # state survives only through what replication already exported
+        self._streams.clear()
+        if self._server is not None:
+            await self._server.wait_closed()
+        telemetry.count("serve.host_kills")
+
 
 class ServerHandle:
     """A DecodeServer running on its own event-loop thread (what the bench
@@ -727,6 +937,16 @@ class ServerHandle:
             # even a failed/timed-out drain must tear the loop thread down
             # — leaving it running would leak the thread and keep client
             # connections open with no one serving them
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=timeout)
+
+    def kill(self, timeout: float = 15.0) -> None:
+        """Hard host death (``host_kill`` chaos): no drain, no error
+        frames — connections just die.  See ``DecodeServer.abort_hard``."""
+        try:
+            asyncio.run_coroutine_threadsafe(
+                self.server.abort_hard(), self._loop).result(timeout)
+        finally:
             self._loop.call_soon_threadsafe(self._loop.stop)
             self._thread.join(timeout=timeout)
 

@@ -1033,6 +1033,69 @@ class StreamSession:
         with self._lock:
             return None if self._frame is None else self._frame.copy()
 
+    # ------------------------------------------------------------------
+    # handoff replication (ISSUE 18)
+    # ------------------------------------------------------------------
+    def export_state(self) -> dict:
+        """JSON-serializable snapshot of the COMMITTED state — watermark,
+        boundary carries, the cached replay response — everything a
+        successor host needs to continue this stream exactly-once after a
+        handoff.  In-flight (uncommitted) work is deliberately excluded:
+        the client retries the same seq and the successor decodes it fresh
+        from the replicated carry, bit-exact."""
+        with self._lock:
+            last = None
+            if self._last_response is not None:
+                last = {k: (np.asarray(v, np.uint8).tolist()
+                            if k == "corrections" else v)
+                        for k, v in self._last_response.items()}
+            return {
+                "stream": self.stream_id,
+                "profile": getattr(self, "profile_name", None),
+                "committed": int(self.committed),
+                "closed": bool(self.closed),
+                "lanes": int(self.lanes),
+                "tenant": self.tenant,
+                "carry_space": (None if self._carry_space is None
+                                else self._carry_space.tolist()),
+                "carry_log": (None if self._carry_log is None
+                              else self._carry_log.tolist()),
+                "frame": (None if self._frame is None
+                          else self._frame.tolist()),
+                "last_response": last,
+            }
+
+    def import_state(self, state: dict) -> bool:
+        """Merge one ``export_state`` snapshot, idempotent and monotone:
+        the snapshot only applies when its watermark is AHEAD of ours
+        (replication deltas can arrive duplicated or out of order; an
+        older copy must never roll a commit back).  Returns True when the
+        snapshot advanced this stream."""
+        committed = int(state.get("committed", 0))
+        with self._lock:
+            if committed <= self.committed:
+                return False
+            self.committed = committed
+            self.closed = bool(state.get("closed", False))
+            self._inflight = None
+            cs = state.get("carry_space")
+            if cs is not None and self._carry_space is not None:
+                self._carry_space = np.ascontiguousarray(cs, np.uint8)
+            cl = state.get("carry_log")
+            if cl is not None and self._carry_log is not None:
+                self._carry_log = np.ascontiguousarray(cl, np.uint8)
+            fr = state.get("frame")
+            if fr is not None:
+                self._frame = np.ascontiguousarray(fr, np.uint8)
+            last = state.get("last_response")
+            if last is not None:
+                payload = dict(last)
+                if payload.get("corrections") is not None:
+                    payload["corrections"] = np.atleast_2d(np.asarray(
+                        payload["corrections"], np.uint8))
+                self._last_response = payload
+            return True
+
     def close(self) -> dict:
         with self._lock:
             self.closed = True

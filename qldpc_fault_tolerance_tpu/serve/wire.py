@@ -72,11 +72,12 @@ import numpy as np
 
 from ..ops.gf2_packed import LANE, num_words, pack_shots, unpack_shots
 
-__all__ = ["HEADER", "IDEM_FIELD", "MAX_FRAME_BYTES", "TRACE_FIELD",
-           "WIRE_CODEC_JSON", "WIRE_CODEC_PACKED", "WIRE_CODECS",
-           "WIRE_MAGIC", "WireCodecError", "encode_frame",
+__all__ = ["HEADER", "IDEM_FIELD", "MAX_FRAME_BYTES", "ROUTE_FIELD",
+           "TRACE_FIELD", "WIRE_CODEC_JSON", "WIRE_CODEC_PACKED",
+           "WIRE_CODECS", "WIRE_MAGIC", "WireCodecError", "encode_frame",
            "encode_request_frame", "encode_response_frame",
-           "encode_stream_chunk_frame", "decode_payload", "pack_plane",
+           "encode_routed_payload", "encode_stream_chunk_frame",
+           "decode_payload", "pack_plane", "peek_response_id",
            "unpack_plane"]
 
 HEADER = struct.Struct(">I")
@@ -110,6 +111,17 @@ BIN_KIND_RESPONSE = 2
 # stream — the body is one gf2_packed plane of lane words, exactly like a
 # batch request, plus stream/seq bookkeeping in the header
 BIN_KIND_STREAM = 3
+# routed frame (ISSUE 18): the fleet router wraps a client payload in a
+# one-level envelope naming the bucket family and the router's placement
+# epoch; the body is the ORIGINAL payload verbatim (any codec), so the
+# router never re-encodes bitplanes.  The owning host's epoch fence checks
+# (family, epoch) before dispatch and answers ``route_stale`` on mismatch —
+# a partitioned router can never double-decode through a stale placement.
+BIN_KIND_ROUTED = 4
+
+# the parsed routing envelope, attached by ``decode_payload`` to the inner
+# message as ``msg[ROUTE_FIELD] = {"family": ..., "epoch": ...}``
+ROUTE_FIELD = "_route"
 
 
 class WireCodecError(ValueError):
@@ -338,13 +350,45 @@ def _decode_stream_chunk(header: dict, body: bytes) -> np.ndarray:
     return unpack_plane(body, header["shots"], header["width"])
 
 
+def encode_routed_payload(family: str, epoch: int, inner: bytes) -> bytes:
+    """Wrap one already-encoded payload (any codec, WITHOUT its length
+    prefix) in the fleet router's routing envelope and frame it.  The
+    inner payload ships verbatim as the body — wrapping is O(header), the
+    router never touches the bitplanes."""
+    return _binary_frame({"family": str(family), "epoch": int(epoch)},
+                         inner, BIN_KIND_ROUTED)
+
+
+def peek_response_id(payload: bytes) -> "str | None":
+    """The wire ``"id"`` of one response payload, parsed as cheaply as the
+    codec allows: v2 frames decode only the small JSON header (the packed
+    planes stay packed), v1 falls back to a full JSON parse.  Returns None
+    when the payload is malformed or carries no id — the router pump uses
+    this to match relayed responses to their pending client frames without
+    ever unpacking a correction plane."""
+    try:
+        if payload[:2] == WIRE_MAGIC:
+            _, _, _, hlen = _BIN_HEAD.unpack_from(payload)
+            header = json.loads(
+                payload[_BIN_HEAD.size:_BIN_HEAD.size + hlen]
+                .decode("utf-8"))
+        else:
+            header = json.loads(payload.decode("utf-8"))
+        rid = header.get("id") if isinstance(header, dict) else None
+        return rid if isinstance(rid, str) else None
+    except (struct.error, UnicodeDecodeError, json.JSONDecodeError,
+            IndexError):
+        return None
+
+
 def _decode_binary(payload: bytes) -> dict:
     if len(payload) < _BIN_HEAD.size:
         raise WireCodecError("binary payload shorter than its fixed header")
     magic, version, kind, hlen = _BIN_HEAD.unpack_from(payload)
     if version != WIRE_CODEC_PACKED:
         raise WireCodecError(f"unsupported wire codec version {version}")
-    if kind not in (BIN_KIND_REQUEST, BIN_KIND_RESPONSE, BIN_KIND_STREAM):
+    if kind not in (BIN_KIND_REQUEST, BIN_KIND_RESPONSE, BIN_KIND_STREAM,
+                    BIN_KIND_ROUTED):
         raise WireCodecError(f"unknown binary frame kind {kind}")
     if _BIN_HEAD.size + hlen > len(payload):
         raise WireCodecError(
@@ -359,6 +403,29 @@ def _decode_binary(payload: bytes) -> dict:
             f"binary header must be a JSON object, got "
             f"{type(header).__name__}")
     body = payload[_BIN_HEAD.size + hlen:]
+    if kind == BIN_KIND_ROUTED:
+        # one-level envelope: the body IS the client's original payload.
+        # A nested routed body is refused (a router must never wrap an
+        # already-wrapped frame) so a routing bug cannot recurse.
+        if "family" not in header or "epoch" not in header:
+            raise WireCodecError("routed frame misses family/epoch")
+        if len(body) >= _BIN_HEAD.size and body[:2] == WIRE_MAGIC and \
+                _BIN_HEAD.unpack_from(body)[2] == BIN_KIND_ROUTED:
+            raise WireCodecError("nested routed frame refused")
+        try:
+            inner = decode_payload(body)
+            route = {"family": str(header["family"]),
+                     "epoch": int(header["epoch"])}
+        except (UnicodeDecodeError, json.JSONDecodeError, TypeError,
+                ValueError) as exc:
+            if isinstance(exc, WireCodecError):
+                raise
+            raise WireCodecError(
+                f"unparseable routed body: {exc}") from None
+        if not isinstance(inner, dict):
+            raise WireCodecError("routed body must be a message object")
+        inner[ROUTE_FIELD] = route
+        return inner
     msg = dict(header)
     msg["_codec"] = WIRE_CODEC_PACKED
     rid = header.get("id")

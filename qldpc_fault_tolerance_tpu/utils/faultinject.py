@@ -55,7 +55,23 @@ Fault kinds:
                            in-flight (uncommitted) window is dropped and
                            the connection hard-closes — the client must
                            resume from the last committed cycle via the
-                           ``stream_commit`` watermark, exactly once.
+                           ``stream_commit`` watermark, exactly once;
+      - ``host_kill``      (ISSUE 18) a whole serving host dies hard —
+                           server tasks cancelled before the batcher
+                           closes, so clients see transport death, never
+                           structured errors; the fleet router's deadman-
+                           driven handoff must re-home the host's
+                           families onto their successors exactly-once;
+      - ``journal_lag``    (ISSUE 18) the router's journal-replication
+                           step fails, so the successor's copy of the
+                           (tenant, session, idem) journal falls behind —
+                           a handoff must then BLOCK on watermark
+                           catch-up instead of serving stale answers;
+      - ``router_partition`` (ISSUE 18) the router routes one frame on a
+                           stale placement (a partitioned router's view):
+                           the old owner's epoch fence must refuse it
+                           (``route_stale``) and the router re-resolve +
+                           re-forward, never double-decode.
 
 All literal site names live in the ``SITES`` table below; qldpc-lint rule
 R008 pins that every ``faultinject.site("...")`` literal in the package is
@@ -115,6 +131,9 @@ SITES = {
     "serve_conn_rx": "serve/server.py per-received-frame (network chaos)",
     "serve_respond": "serve/server.py before a response frame is written",
     "serve_stream_step": "serve/server.py stream chunk, before decode/commit",
+    "router_route": "serve/router.py per-forwarded-frame (routing chaos)",
+    "router_replicate": "serve/router.py journal replication pull/push step",
+    "fleet_host_tick": "serve/router.py LocalFleet chaos tick (host_kill)",
 }
 
 
@@ -132,11 +151,13 @@ class Fault:
 
     KINDS = ("raise", "deterministic", "stall", "truncate",
              "conn_drop", "torn_frame", "session_evict", "device_restart",
-             "mesh_device_loss", "stream_kill")
+             "mesh_device_loss", "stream_kill",
+             "host_kill", "journal_lag", "router_partition")
 
     def __init__(self, site: str, kind: str = "raise", after: int = 0,
                  count: int = 1, stall_s: float = 0.25,
-                 truncate_at: float = 0.5, message: str = ""):
+                 truncate_at: float = 0.5, message: str = "",
+                 target: str = ""):
         if kind not in self.KINDS:
             raise ValueError(f"unknown fault kind {kind!r} (one of {self.KINDS})")
         self.site = str(site)
@@ -146,6 +167,10 @@ class Fault:
         self.stall_s = float(stall_s)
         self.truncate_at = float(truncate_at)
         self.message = message or f"injected {kind} at {site}"
+        # optional aim point for site handlers that pick a victim — e.g. a
+        # host_kill handler kills this family's (or label's) host instead
+        # of its default choice; plain data, the site's handler interprets
+        self.target = str(target)
 
     def matches(self, hit: int) -> bool:
         return self.after < hit <= self.after + self.count

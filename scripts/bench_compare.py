@@ -117,13 +117,21 @@ GATED_FIELDS = (
     # arm).  Rounds before r17 lack the key, so the checked-in history
     # gates unchanged.
     "timeseries_ab.scraper_on_shots_per_s",
+    # multi-host serving fabric (bench.py fleet, ISSUE 18): the fleet
+    # storm's through-kill request rate gates as a rate (also the round's
+    # "value" headline); the handoff wall clock (gate -> journal flush ->
+    # adopt -> reopen) gates on INCREASES.  Rounds before r18 lack the
+    # keys, so the checked-in history gates unchanged.
+    "fleet.req_per_s",
+    "fleet.handoff_p99_ms",
 )
 
 # gated fields where a RISE is the regression (latencies, host round-trips)
 LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms",
                                     "bposd.host_round_trips",
                                     "wire_ab.packed_bytes_per_req",
-                                    "stream.p99_commit_ms"})
+                                    "stream.p99_commit_ms",
+                                    "fleet.handoff_p99_ms"})
 
 
 def _dig(d: dict, dotted: str):

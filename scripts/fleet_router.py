@@ -1,0 +1,125 @@
+#!/usr/bin/env python
+"""Run the multi-host serving fabric's fleet router over N decode hosts.
+
+    python scripts/fleet_router.py --port 9200 \
+        --host-addr a=10.0.0.1:9000 --host-addr b=10.0.0.2:9000 \
+        --ops a=http://10.0.0.1:9001 --ops b=http://10.0.0.2:9001 \
+        --family fam0=hgp_rep3,hgp_rep4
+
+Clients connect to the router exactly as they would to one host (same
+wire protocol, same DecodeClient).  Frames route to each bucket family's
+owner host (consistent hash), the answered journal replicates to the
+family successor, and when the federation gateway's host-down deadman
+fires the family hands off exactly-once (see
+qldpc_fault_tolerance_tpu.serve.router).  The router's ops view —
+gateway merge + placement table + last-handoff ages — serves on
+``--ops-port`` (/metrics /healthz /varz /alertz).
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import threading
+
+from fleet_gateway import parse_targets
+
+
+def parse_pairs(specs, what: str) -> dict:
+    out = {}
+    for spec in specs:
+        if "=" not in spec:
+            raise SystemExit(f"bad --{what} {spec!r}: expected LABEL=VALUE")
+        label, value = spec.split("=", 1)
+        if label in out:
+            raise SystemExit(f"duplicate --{what} label {label!r}")
+        out[label] = value
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--host-addr", action="append", default=[],
+                    metavar="LABEL=HOST:PORT", dest="host_addrs",
+                    help="decode-server address of one host (repeatable)")
+    ap.add_argument("--ops", action="append", default=[],
+                    metavar="LABEL=URL", dest="ops_targets",
+                    help="ops endpoint of one host (repeatable; labels "
+                         "must match --host-addr)")
+    ap.add_argument("--family", action="append", default=[],
+                    metavar="KEY=SESSION[,SESSION...]", dest="families",
+                    help="one bucket family's session names (repeatable)")
+    ap.add_argument("--profile", action="append", default=[],
+                    metavar="NAME=SESSION", dest="profiles",
+                    help="stream profile -> session mapping (repeatable)")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=0,
+                    help="client-facing router port")
+    ap.add_argument("--ops-port", type=int, default=0,
+                    help="router ops-view port")
+    ap.add_argument("--interval", type=float, default=5.0,
+                    help="gateway scrape interval, seconds")
+    ap.add_argument("--down-after", type=float, default=None,
+                    help="host-down deadman window (default 3 intervals)")
+    ap.add_argument("--control-interval", type=float, default=0.25,
+                    help="router control-loop tick, seconds")
+    ap.add_argument("--telemetry-jsonl", default=None,
+                    help="enable telemetry with this JSONL sink")
+    args = ap.parse_args(argv)
+    if not args.host_addrs:
+        ap.error("at least one --host-addr is required")
+    if not args.families:
+        ap.error("at least one --family is required")
+
+    from qldpc_fault_tolerance_tpu.serve import fleet, router
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    if args.telemetry_jsonl:
+        telemetry.enable(args.telemetry_jsonl)
+
+    hosts = {}
+    for label, addr in parse_pairs(args.host_addrs, "host-addr").items():
+        hp, _, port = addr.rpartition(":")
+        if not hp or not port.isdigit():
+            raise SystemExit(f"bad --host-addr {addr!r}: expected HOST:PORT")
+        hosts[label] = (hp, int(port))
+    families = {key: [s for s in val.split(",") if s]
+                for key, val in parse_pairs(args.families,
+                                            "family").items()}
+    profiles = parse_pairs(args.profiles, "profile")
+
+    ops_targets = parse_targets(args.ops_targets)
+    missing = sorted(set(ops_targets) - set(hosts))
+    if missing:
+        raise SystemExit(f"--ops labels {missing} have no --host-addr")
+    gw = (fleet.FleetGateway(ops_targets, interval_s=args.interval,
+                             down_after_s=args.down_after)
+          if ops_targets else None)
+    rt = router.FleetRouter(hosts, families, profiles=profiles,
+                            gateway=gw,
+                            control_interval_s=args.control_interval)
+    handle = router.start_router_thread(rt)
+    ops_handle = None
+    if gw is not None:
+        ops_handle = router.start_router_ops_thread(
+            rt, gw, host=args.host, port=args.ops_port, scrape=True)
+    host, port = handle.address
+    fams = ", ".join(f"{k}({len(v)})" for k, v in sorted(families.items()))
+    print(f"fleet router on {host}:{port} — {len(hosts)} hosts, "
+          f"families: {fams}" + (
+              "; ops view on http://{}:{}".format(*ops_handle.address)
+              if ops_handle else
+              " (no --ops targets: handoff deadman DISABLED)")
+          + "; Ctrl-C to stop")
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        handle.stop()
+        if ops_handle is not None:
+            ops_handle.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -643,6 +643,25 @@ def render_fleet(fleet: dict) -> str:
     if varz.get("merge_skipped"):
         L.append(f"  merge skipped (boundary mismatch): "
                  f"{', '.join(varz['merge_skipped'])}")
+    placement = varz.get("placement")
+    if placement:
+        L.append("  -- family placement (router) --")
+        L.append(f"    {'family':<16}{'owner':<12}{'successor':<12}epoch")
+        for fam, p in sorted(placement.items()):
+            L.append(f"    {fam:<16}{p.get('owner', '?'):<12}"
+                     f"{p.get('successor') or '-':<12}{p.get('epoch', '?')}")
+        if varz.get("down_hosts"):
+            L.append(f"    DOWN hosts: {', '.join(varz['down_hosts'])}")
+    handoffs = varz.get("handoffs")
+    if handoffs:
+        L.append("  -- last handoffs --")
+        for fam, h in sorted(handoffs.items()):
+            age = h.get("age_s")
+            age_s = f"{age:.1f}s ago" if isinstance(age, (int, float)) \
+                else "?"
+            L.append(f"    {fam:<16}{h.get('from', '?')} -> "
+                     f"{h.get('to', '?')}  epoch {h.get('epoch', '?')}  "
+                     f"{age_s}  ({h.get('reason', '?')})")
     if alertz and alertz.get("active"):
         L.append("  -- active alerts --")
         for a in alertz["active"]:

@@ -40,11 +40,13 @@ from qldpc_fault_tolerance_tpu.serve import (
     DecodeClient,
     DecodeSession,
     HealthProbe,
+    LocalFleet,
     SLOEngine,
     SLOPolicy,
     start_ops_thread,
     start_server_thread,
 )
+from qldpc_fault_tolerance_tpu.serve.session import family_digest
 from qldpc_fault_tolerance_tpu.utils import (
     faultinject,
     resilience,
@@ -1114,3 +1116,280 @@ def test_slo_burn_sheds_whole_stream_with_structured_error():
     finally:
         handle.stop(drain=True)
         telemetry.remove_sink(sink)
+
+
+# ---------------------------------------------------------------------------
+# Multi-host serving fabric under chaos (ISSUE 18)
+# ---------------------------------------------------------------------------
+def _fam(sess) -> str:
+    return f"fam-{family_digest(sess.family)}"
+
+
+def _fleet_storm(fleet, codes, n_per_tenant, tenants=2, seed=0):
+    """The fleet variant of ``_storm``: clients talk to the ROUTER, and
+    each collected result ticks the fleet's chaos site — a seeded
+    ``host_kill`` plan therefore fires mid-storm, with the remaining
+    requests in flight."""
+    host, port = fleet.address
+    names = sorted(codes)
+    results, errors = [], []
+
+    def worker(idx):
+        try:
+            rng = np.random.default_rng(1000 * seed + idx)
+            with DecodeClient(host, port, tenant=f"t{idx}", reconnect=True,
+                              timeout=60.0) as cli:
+                pending = []
+                for i in range(n_per_tenant):
+                    name = names[(i + idx) % len(names)]
+                    synd = _synd(codes[name], int(rng.integers(1, 8)), rng)
+                    pending.append((name, synd, cli.submit(name, synd)))
+                for name, synd, fut in pending:
+                    res = fut.result(timeout=120)
+                    results.append((name, synd, res.corrections))
+                    fleet.chaos_tick()
+        except Exception as exc:  # noqa: BLE001 — surfaced by the test
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(tenants)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=300)
+    assert not errors, errors
+    return results
+
+
+def _wait_for_handoff(router, fam, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while fam not in router.handoff_report():
+        assert time.monotonic() < deadline, \
+            f"no handoff for {fam} within {timeout}s"
+        resilience.sleep_for(0.02)
+
+
+def test_fleet_host_kill_mid_storm_exactly_once_via_deadman():
+    """ISSUE 18 acceptance: a seeded ``host_kill`` mid-storm against a
+    2-host in-process fleet.  Every accepted request — batch AND stream —
+    is answered exactly once, bit-exact vs the offline decode, and the
+    handoff is driven end to end by the PR 17 gateway deadman: nothing in
+    this test fails a host over manually."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    codes = {"hgp_rep3": CODE3, "hgp_rep4": CODE4}
+
+    def factory():
+        return {"hgp_rep3": _session(CODE3, name="hgp_rep3"),
+                "hgp_rep4": _session(CODE4, name="hgp_rep4",
+                                     buckets=(8, 32, 64)),
+                "st3": _st_stream_session(4)}
+
+    fleet = LocalFleet(factory, n_hosts=2)
+    try:
+        st_fam = _fam(fleet.sessions["h0"]["st3"])
+        b3_fam = _fam(fleet.sessions["h0"]["hgp_rep3"])
+        placement = fleet.router.placement()
+        victim = placement[st_fam]["owner"]
+        survivor = placement[st_fam]["successor"]
+        # the bucket configs above deliberately co-locate the stream and
+        # the rep3 batch family on ONE host, so the kill disrupts both
+        # planes; a family-digest change that splits them must fail HERE,
+        # loudly, instead of silently weakening the schedule
+        assert placement[b3_fam]["owner"] == victim, placement
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="fleet_host_tick", kind="host_kill",
+                               after=5, target=st_fam)], seed=18)
+        host, port = fleet.address
+        offline_st = ST_CLS.GetDecoder(ST_PARAMS)
+        rng = np.random.default_rng(18)
+        with DecodeClient(host, port, reconnect=True,
+                          timeout=60.0) as st_cli:
+            ack = st_cli.stream_open("st3", lanes=4)
+            assert ack.get("ok"), ack
+            sid, width = ack["stream"], ack["width"]
+            chunks = [(rng.random((4, width)) < P).astype(np.uint8)
+                      for _ in range(6)]
+
+            def step(seq):
+                res = st_cli.stream_step(sid, seq, chunks[seq - 1])
+                assert res.get("ok"), res
+                assert res["committed"] == seq
+                ref = offline_st.decode_batch(
+                    chunks[seq - 1].reshape(4, ST_W, -1))
+                assert np.array_equal(
+                    np.asarray(res["corrections"], np.uint8),
+                    np.asarray(ref, np.uint8)), f"seq {seq}"
+
+            # windows 1..3 commit on the original owner (and replicate)
+            for seq in (1, 2, 3):
+                step(seq)
+            with plan.active():
+                results = _fleet_storm(fleet, codes, n_per_tenant=8,
+                                       tenants=2, seed=18)
+                # the stream rides the SAME handoff: the rebuilt ledger on
+                # the successor continues from the replicated watermark,
+                # windows 4..6 commit exactly-once, still bit-exact
+                for seq in (4, 5, 6):
+                    step(seq)
+            wm = st_cli.stream_commit(sid)
+            assert wm["committed"] == 6
+            st_cli.stream_commit(sid, close=True)
+        # --- the handoff was deadman-driven and complete ---------------
+        assert _counter("serve.host_kills") == 1
+        assert _counter("faultinject.host_kill") == 1
+        assert f"host_down:{victim}" in fleet.gateway.alerts.firing()
+        assert fleet.router.down == {victim}
+        place2 = fleet.router.placement()
+        assert place2[st_fam]["owner"] == survivor
+        assert place2[b3_fam]["owner"] == survivor
+        assert place2[st_fam]["epoch"] == 2
+        report = fleet.router.handoff_report()
+        assert report[st_fam]["reason"] == f"host_down:{victim}"
+        assert _counter("router.handoffs") >= 2  # both of the victim's fams
+        assert _counter("router.handoff_drops") == 0
+        # --- every batch request answered exactly once, bit-exact ------
+        assert len(results) == 16
+        for name, code in codes.items():
+            rows = [(s, c) for n, s, c in results if n == name]
+            synd = np.concatenate([s for s, _ in rows])
+            served = np.concatenate([c for _, c in rows])
+            assert np.array_equal(served, _offline(code, synd)), name
+        # --- the stream committed each window exactly once, fleet-wide --
+        assert _counter("stream.commits") == 6
+    finally:
+        fleet.stop()
+
+
+def test_fleet_journal_lag_handoff_blocks_on_watermark_catch_up():
+    """``journal_lag`` chaos: every replication PUSH fails while the lag
+    lasts (the eager fetch still drains the dying host's journal into the
+    router's buffer).  The handoff must BLOCK on the watermark catch-up —
+    the successor owns the family only after every answered entry landed —
+    so a post-handoff duplicate of a pre-kill request replays from the
+    imported journal instead of re-decoding."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    fleet = LocalFleet(lambda: {"hgp_rep3": _session(CODE3)}, n_hosts=2)
+    try:
+        fam = _fam(fleet.sessions["h0"]["hgp_rep3"])
+        victim = fleet.router.placement()[fam]["owner"]
+        host, port = fleet.address
+        rng = np.random.default_rng(19)
+        answered = []
+
+        def ask(cli):
+            synd = _synd(CODE3, int(rng.integers(1, 8)), rng)
+            res = cli.submit("hgp_rep3", synd).result(timeout=120)
+            answered.append((synd, res.corrections))
+
+        with DecodeClient(host, port, reconnect=True,
+                          timeout=60.0) as cli:
+            for _ in range(6):  # replicated at the steady-state cadence
+                ask(cli)
+            plan = faultinject.FaultPlan([
+                faultinject.Fault(site="router_replicate",
+                                  kind="journal_lag", after=0, count=150),
+                faultinject.Fault(site="fleet_host_tick",
+                                  kind="host_kill", after=0, target=fam),
+            ], seed=19)
+            with plan.active():
+                for _ in range(4):  # answered under the lag: fetched, not
+                    ask(cli)        # yet pushed
+                resilience.sleep_for(0.1)  # >= a few fetch ticks
+                fleet.chaos_tick()  # host_kill -> deadman -> handoff
+                _wait_for_handoff(fleet.router, fam)
+            # a fresh request routes to the new owner, bit-exact
+            ask(cli)
+        assert _counter("faultinject.journal_lag") >= 1
+        assert _counter("router.replication_errors") >= 1  # pushes failed
+        assert _counter("router.handoff_drops") == 0       # none dropped
+        report = fleet.router.handoff_report()
+        assert report[fam]["epoch"] == 2
+        new_owner = fleet.router.placement()[fam]["owner"]
+        assert new_owner != victim
+        # the successor's journal holds EVERY pre-kill answered key: the
+        # gate only opened once the flush loop pushed through the lag
+        snap = fleet.batchers[new_owner].export_journal(0)
+        assert len(snap["entries"]) >= 10
+        for synd, corrections in answered:
+            assert np.array_equal(corrections, _offline(CODE3, synd))
+        # exactly-once across the handoff: a duplicate of a pre-kill idem
+        # key REPLAYS the imported answer (no second decode)
+        entry = snap["entries"][0]
+        tenant, sess_name, idem = entry["key"]
+        width = fleet.sessions[new_owner]["hgp_rep3"].syndrome_width
+        before = _counter("serve.dedup.replayed")
+        fut = fleet.batchers[new_owner].submit(
+            sess_name, np.zeros((1, width), np.uint8), tenant=tenant,
+            idem=idem)
+        replay = fut.result(timeout=60)
+        assert np.array_equal(replay.corrections,
+                              np.asarray(entry["corrections"], np.uint8))
+        assert _counter("serve.dedup.replayed") == before + 1
+    finally:
+        fleet.stop()
+
+
+def test_fleet_router_partition_fence_refuses_and_reforwards():
+    """``router_partition`` chaos: one frame forwards with a deliberately
+    stale epoch, as a partitioned router's would.  The owner's fence must
+    refuse it (``route_stale``) — never dispatch — and the router's
+    re-forward path must answer the request anyway, bit-exact, without
+    tripping a spurious handoff."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    fleet = LocalFleet(lambda: {"hgp_rep3": _session(CODE3)}, n_hosts=2)
+    try:
+        host, port = fleet.address
+        rng = np.random.default_rng(20)
+        plan = faultinject.FaultPlan(
+            [faultinject.Fault(site="router_route",
+                               kind="router_partition", after=2, count=1)],
+            seed=20)
+        with plan.active():
+            with DecodeClient(host, port, reconnect=True,
+                              timeout=60.0) as cli:
+                for _ in range(6):
+                    synd = _synd(CODE3, int(rng.integers(1, 8)), rng)
+                    res = cli.submit("hgp_rep3", synd).result(timeout=120)
+                    assert np.array_equal(res.corrections,
+                                          _offline(CODE3, synd))
+        assert _counter("router.partition_injected") == 1
+        assert _counter("serve.route_stale") >= 1     # the fence refused
+        assert _counter("router.stale_reforwards") >= 1
+        assert _counter("router.handoffs") == 0       # fence, not failover
+    finally:
+        fleet.stop()
+
+
+def test_bench_compare_gates_fleet_round(tmp_path):
+    """The fleet storm bench joins the regression ledger: under-chaos
+    req/s regresses DOWN, the handoff wall clock (p99, ms) regresses UP;
+    rounds that lack the keys gate unchanged."""
+    import importlib
+
+    scripts = os.path.join(REPO_ROOT, "scripts")
+    if scripts not in sys.path:
+        sys.path.insert(0, scripts)
+    bench_compare = importlib.import_module("bench_compare")
+
+    def fleet_round(n, rps, p99):
+        obj = {"schema": 2, "round": n,
+               "result": {"metric": "fleet storm sustained req/s",
+                          "value": rps, "unit": "req/s",
+                          "fleet": {"req_per_s": rps,
+                                    "handoff_p99_ms": p99,
+                                    "handoffs": 1}}}
+        p = tmp_path / f"BENCH_F_r{n:02d}.json"
+        p.write_text(json.dumps(obj))
+        return str(p)
+
+    dropped = [fleet_round(1, 200.0, 80.0), fleet_round(2, 100.0, 80.0)]
+    assert bench_compare.main(dropped
+                              + ["--gate", "--tolerance", "10"]) == 1
+    lagged = [fleet_round(3, 200.0, 80.0), fleet_round(4, 200.0, 300.0)]
+    assert bench_compare.main(lagged
+                              + ["--gate", "--tolerance", "10"]) == 1
+    fine = [fleet_round(5, 200.0, 80.0), fleet_round(6, 210.0, 70.0)]
+    assert bench_compare.main(fine + ["--gate", "--tolerance", "10"]) == 0

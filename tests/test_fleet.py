@@ -454,3 +454,30 @@ def test_telemetry_report_fleet_only_renders_degraded_healthz(capsys):
     assert "bp.shots" in out and "41" in out
     with pytest.raises(SystemExit):  # no JSONL and no --fleet: usage error
         tr.main([])
+
+
+def test_telemetry_report_renders_router_placement_and_handoffs():
+    """--fleet against a ROUTER ops view (RouterFleetServer varz): the
+    placement table and the last-handoff ages render alongside the
+    gateway block, and a plain gateway varz (no router keys) still
+    renders without them."""
+    import telemetry_report as tr
+
+    out = tr.render_fleet({"varz": {
+        "targets": {"h0": "http://a", "h1": "http://b"},
+        "scrapes": 4,
+        "placement": {"fam-a1020d": {"owner": "h0", "successor": "h1",
+                                     "epoch": 2}},
+        "handoffs": {"fam-a1020d": {"age_s": 3.2, "epoch": 2,
+                                    "from": "h1", "to": "h0",
+                                    "reason": "host_down:h1"}},
+        "down_hosts": ["h1"],
+    }})
+    assert "family placement (router)" in out
+    assert "fam-a1020d" in out
+    assert "DOWN hosts: h1" in out
+    assert "last handoffs" in out
+    assert "h1 -> h0" in out and "host_down:h1" in out
+    assert "3.2s ago" in out
+    plain = tr.render_fleet({"varz": {"targets": {}, "scrapes": 0}})
+    assert "placement" not in plain and "handoffs" not in plain
