@@ -982,6 +982,83 @@ def _osd_device_host_ab():
     }
 
 
+def _osd_cs_device_host_ab():
+    """Device-vs-host OSD-CS A/B (ISSUE 19): the SAME decode_batch
+    workload (full BP + order-10 combination sweep) through the batched
+    device sweep vs the demoted host combination loop, order-alternating
+    with min-of-4 readings per arm (serve-bench protocol).  Every
+    compared shot is WER/cost-parity checked against the host's
+    enumeration semantics (bit-equal, or a float32/64 cost tie on a
+    syndrome-consistent candidate — the documented boundary), and the
+    device arm is asserted to really run on device (zero host
+    round-trips, zero silent fallbacks)."""
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder
+    from qldpc_fault_tolerance_tpu.decoders.osd import _channel_cost
+    from qldpc_fault_tolerance_tpu.ops.osd_cs_device import cs_sweep_shape
+    from qldpc_fault_tolerance_tpu.utils import telemetry
+
+    code = hgp(rep_code(5), rep_code(5))
+    h = code.hz
+    n = code.N
+    # higher p than the osd_e arm: nearly every shot reaches OSD, so the
+    # block measures the combination sweep itself, not the shared BP stage
+    p = 0.2
+    shots = 512
+    rng = np.random.default_rng(29)
+    errs = (rng.random((shots, n)) < p).astype(np.uint8)
+    synds = (errs @ h.T % 2).astype(np.uint8)
+
+    def make(device):
+        return BPOSD_Decoder(h, np.full(n, p), max_iter=6,
+                             osd_method="osd_cs", osd_order=10,
+                             device_osd=device)
+
+    dev, host = make(True), make(False)
+    out_dev = dev.decode_batch(synds)    # warmup (compiles) + parity data
+    out_host = host.decode_batch(synds)
+    times = {"device": [], "host": []}
+    arms = [("device", dev), ("host", host)]
+    for r in range(4):
+        for name, dec in (arms if r % 2 == 0 else arms[::-1]):
+            t0 = time.perf_counter()
+            dec.decode_batch(synds)
+            times[name].append(time.perf_counter() - t0)
+    rate_dev = shots / min(times["device"])
+    rate_host = shots / min(times["host"])
+    cost = _channel_cost(np.full(n, p))
+    exact = (out_dev == out_host).all(axis=1)
+    synd_ok = ((out_dev @ h.T % 2) == synds).all(axis=1)
+    tie = np.abs((out_dev * cost[None]).sum(1)
+                 - (out_host * cost[None]).sum(1)) < 1e-4
+    parity_ok = bool((exact | (tie & synd_ok)).all())
+    with _tele_region():
+        dev.decode_batch(synds)
+        snap = telemetry.snapshot()
+    rt = snap.get("osd.host_round_trips", {}).get("value", 0)
+    fb = snap.get("osd.host_fallbacks", {}).get("value", 0)
+    st = dev.device_static
+    n_cand, n_chunks = cs_sweep_shape(int(st[2]), int(st[3]), int(st[4]))
+    return {
+        "workload": f"decode_batch BP+OSD(osd_cs,10) {shots} shots "
+                    f"(surface d5, N={n}, p={p})",
+        "device_cs_shots_per_s": round(rate_dev, 1),
+        "host_cs_shots_per_s": round(rate_host, 1),
+        "device_vs_host": round(rate_dev / rate_host, 2),
+        "cost_parity_ok": parity_ok,
+        "exact_match_fraction": round(float(exact.mean()), 4),
+        "cs_candidates": int(n_cand),
+        "cs_chunks": int(n_chunks),
+        "device_host_round_trips": int(rt),
+        "device_host_fallbacks": int(fb),
+        "device_path_ok": bool(rt == 0 and fb == 0),
+        "readings": 4,
+        "protocol": "order-alternating, min-of-4 per arm",
+    }
+
+
 def mode_bposd():
     """Data-noise BP+OSD throughput, the reference Single-Shot cell 4
     workload (BPOSD osd_e-10, N/10 iters): its 16k shots took 449.7 s on the
@@ -1026,6 +1103,7 @@ def mode_bposd():
     with _tele_region():
         sim.WordErrorRate(shots, key=jax.random.fold_in(key, 1))
         tele_block = _tele_counters_block(telemetry_enabled=True)
+    cs_ab = _osd_cs_device_host_ab()
     return {
         "metric": f"BP+OSD(osd_e,10) data-noise shots/sec ({code.name or 'hgp'}, N={code.N}, p=0.05)",
         "value": round(rate, 1),
@@ -1040,9 +1118,13 @@ def mode_bposd():
             else "host",
             "device_shots": tele_block.get("osd_device_shots", 0),
             "host_round_trips": tele_block.get("osd_host_round_trips", 0),
+            # ISSUE 19: the osd_cs path must be as host-free as osd_e —
+            # bench_compare gates this at 0 (lower-is-better)
+            "cs_host_round_trips": cs_ab["device_host_round_trips"],
             "tiers": tele_block.get("osd_tiers"),
         },
         "osd_ab": _osd_device_host_ab(),
+        "cs_ab": cs_ab,
         **_bp_utilization(dec_x, dec_z, code, p, rate,
                           jax.random.fold_in(key, 99)),
     }

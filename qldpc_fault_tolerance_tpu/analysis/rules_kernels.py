@@ -82,6 +82,15 @@ KERNEL_CONTRACTS = (
         "osd_elim_blocked", _OPS + "osd_device.py",
         "_elim_blocked_kernel", "_eliminate_blocked_twin",
         ("_blocked_stepA", "_blocked_phaseB_delta")),
+    # OSD combination sweep (ISSUE 19): the chunked candidate scoring +
+    # first-min/strict-< argmin fold is ONE body — the Pallas sweep and
+    # the XLA twin that serves off-TPU must both keep routing through it,
+    # or the host-parity contract (which pins enumeration-order
+    # tie-breaking) can drift one edit at a time
+    KernelContract(
+        "osd_cs_sweep", _OPS + "osd_cs_device.py",
+        "_cs_sweep_kernel", "_cs_sweep_xla",
+        ("_cs_sweep_chunk",)),
     # packed wire codec (ISSUE 15): the network layout IS the gf2_packed
     # device layout — both directions must keep routing through the
     # shared bodies (num_words pins the lane-word geometry for both;

@@ -88,11 +88,22 @@ def decode_device(static, state, syndromes):
     """
     kind = static[0]
     if kind == "bposd_dev":
-        _, bp_static, n, rank, osd_order, elim = static
+        _, bp_static, n, rank, osd_order, elim = static[:6]
+        # the 7th slot (ISSUE 19, additive) names the reprocessing
+        # method; older 6-tuples mean the OSD-E scorer
+        osd_method = static[6] if len(static) > 6 else "osd_e"
         err, aux = decode_device(bp_static, state, syndromes)
-        from ..ops.osd_device import osd_decode_values
+        if osd_method == "osd_cs":
+            from ..ops.osd_cs_device import cs_pat_chunk
+            from ..ops.osd_cs_device import \
+                osd_cs_decode_values as osd_decode_values
 
-        cfg = (n, rank, osd_order, 256, elim)
+            cfg = (n, rank, osd_order,
+                   cs_pat_chunk(n, rank, osd_order), elim)
+        else:
+            from ..ops.osd_device import osd_decode_values
+
+            cfg = (n, rank, osd_order, 256, elim)
         B = syndromes.shape[0]
         conv = aux["converged"]
         bad = ~conv
@@ -511,14 +522,15 @@ class BPOSD_Decoder(BPDecoder):
     is **device-resident by default on every substrate** (ops/osd_device.py:
     batched bit-packed GF(2) elimination — the blocked Pallas kernel on
     TPU, its bit-exact XLA twin elsewhere — plus MXU-scored OSD-E
-    reprocessing).  That keeps BPOSD pipelines pure device code
-    (mesh-shardable, scan-chunkable, servable, megabatch-foldable with
-    ``osd.host_round_trips == 0``).
+    reprocessing; ops/osd_cs_device.py: the chunked order-w combination
+    sweep for ``osd_method="osd_cs"``).  That keeps BPOSD pipelines pure
+    device code (mesh-shardable, scan-chunkable, servable,
+    megabatch-foldable with ``osd.host_round_trips == 0``).
 
     The host path (native C++ / numpy, _native/osd.cpp) is demoted to a
-    resilience-ladder rung and test oracle: ``decode_batch`` falls back to
-    it when the device OSD program faults, ``device_osd=False`` selects it
-    explicitly, and osd_cs (not implemented on device) still requires it.
+    resilience-ladder rung and test oracle for every method: ``decode_batch``
+    falls back to it when the device OSD program faults and
+    ``device_osd=False`` selects it explicitly.
 
     ``device_osd``: True / False / "auto" (device wherever the method is
     device-implementable; ``QLDPC_DEVICE_OSD=0`` restores the host
@@ -532,15 +544,18 @@ class BPOSD_Decoder(BPDecoder):
                  device_osd="auto"):
         super().__init__(h, channel_probs, max_iter, bp_method, ms_scaling_factor)
         self.osd_method = str(osd_method)
-        self.osd_order = int(osd_order)
-        _DEVICE_METHODS = ("osd_e", "osd0", "osd_0", "exhaustive")
+        from .osd import _METHODS, _check_osd_order
+
+        self.osd_order = (_check_osd_order(osd_order)
+                          if self.osd_method in _METHODS else int(osd_order))
+        _DEVICE_METHODS = ("osd_e", "osd0", "osd_0", "exhaustive", "osd_cs")
         if device_osd == "auto":
             env = os.environ.get("QLDPC_DEVICE_OSD", "1")
             device_osd = (env != "0"
                           and self.osd_method in _DEVICE_METHODS)
         elif device_osd and self.osd_method not in _DEVICE_METHODS:
             raise NotImplementedError(
-                f"device OSD implements OSD-0/OSD-E only, not "
+                f"device OSD implements OSD-0/OSD-E/OSD-CS only, not "
                 f"{self.osd_method!r}; use device_osd=False"
             )
         self.device_osd = bool(device_osd)
@@ -564,8 +579,11 @@ class BPOSD_Decoder(BPDecoder):
         # and travels in the static config, so it participates in every jit
         # cache key — a mid-process env change affects new decoders only
         elim = os.environ.get("QLDPC_OSD_ELIM", "pallas")
+        # slot 7 (additive, ISSUE 19): which reprocessing program runs —
+        # "osd_cs" routes decode_device to the combination-sweep scorer
+        method = "osd_cs" if self.osd_method == "osd_cs" else "osd_e"
         return ("bposd_dev", bp_static, self._osd_plan.n,
-                self._osd_plan.rank, order, elim)
+                self._osd_plan.rank, order, elim, method)
 
     @property
     def device_state(self):

@@ -15,9 +15,30 @@ import numpy as np
 from .._native import load_native
 from ..codes import gf2
 
-__all__ = ["osd_decode_batch", "osd_postprocess"]
+__all__ = ["osd_decode_batch", "osd_postprocess", "OSD_CS_MAX_ORDER"]
 
 _METHODS = {"osd_0": 0, "osd0": 0, "osd_e": 1, "osd_cs": 2, "exhaustive": 1}
+
+#: Shared order cap for the reprocessing stages — OSD-E's candidate count
+#: is 2^order and OSD-CS's pair block is order^2/2, so an uncapped order
+#: is a resource bug, not a knob.  ONE constant used by the host paths
+#: here, the device OSD-E scorer (ops/osd_device.py) and the device CS
+#: sweep (ops/osd_cs_device.py); entry points raise a loud ValueError
+#: above it instead of silently clamping (the C++ keeps its own internal
+#: 2^20 safety bound).
+OSD_CS_MAX_ORDER = 20
+
+
+def _check_osd_order(osd_order: int) -> int:
+    order = int(osd_order)
+    if order > OSD_CS_MAX_ORDER:
+        raise ValueError(
+            f"osd_order={order} exceeds OSD_CS_MAX_ORDER="
+            f"{OSD_CS_MAX_ORDER} — candidate counts grow as 2^order "
+            f"(OSD-E) / order^2 (OSD-CS); raise decoders.osd."
+            f"OSD_CS_MAX_ORDER deliberately rather than relying on a "
+            f"silent clamp")
+    return order
 
 
 def _channel_cost(channel_probs: np.ndarray) -> np.ndarray:
@@ -55,6 +76,7 @@ def osd_decode_batch(
     if cost.ndim == 0:
         cost = np.full(n, float(cost))
     method = _METHODS[osd_method]
+    osd_order = _check_osd_order(osd_order)
 
     lib = load_native()
     if lib is not None:
@@ -120,12 +142,12 @@ def _osd_numpy(h, syndromes, llrs, cost, method, osd_order):
         best_t: list[int] = []
         cands: list[list[int]] = []
         if method == 1:
-            w = min(osd_order, len(free), 20)
+            w = min(osd_order, len(free), OSD_CS_MAX_ORDER)
             for pat in range(1, 1 << w):
                 cands.append([b for b in range(w) if (pat >> b) & 1])
         elif method == 2:
             cands.extend([[b] for b in range(len(free))])
-            w = min(osd_order, len(free))
+            w = min(osd_order, len(free), OSD_CS_MAX_ORDER)
             cands.extend([[a, b] for a in range(w) for b in range(a + 1, w)])
         for t in cands:
             e_s, c = solve(t)

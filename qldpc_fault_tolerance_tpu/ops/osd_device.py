@@ -548,7 +548,7 @@ def _elim_blocked_kernel(packed_ref, synd_ref,
                          synd_out_ref, pr_ref, pc_ref, fword_ref, fpos_ref,
                          work_ref, used_ref, rank_ref, fcnt_ref,
                          *, W: int, m: int, n: int, r_star: int, fcap: int,
-                         bt: int):
+                         bt: int, full: bool = False):
     i32 = jnp.int32
 
     work_ref[:] = packed_ref[:]
@@ -603,29 +603,57 @@ def _elim_blocked_kernel(packed_ref, synd_ref,
         # kernel's outputs — synd/pr/pc/fword/fpos — are all tracked
         # incrementally), and the current word is equally dead after its
         # phase A, so the update starts at t_word+1; the skip halves the
-        # kernel's dominant cost on average.
+        # kernel's dominant cost on average.  ``full`` (the OSD-CS
+        # variant) disables the skip: every word is maintained — each
+        # ``row`` is still a block-START value (phase A never writes
+        # work_ref, and stepB reads before writing), so the delta applied
+        # at word t_word reproduces phase A exactly and the scratch ends
+        # as the true fully-reduced matrix.
         def stepB(w_i, _):
             row = work_ref[pl.ds(w_i, 1)][0]                   # (m, bt)
             acc = _blocked_phaseB_delta(row, pivword, aug)
             work_ref[pl.ds(w_i, 1)] = (row ^ acc)[None]
             return 0
 
-        jax.lax.fori_loop(t_word + 1, W, stepB, 0)
+        jax.lax.fori_loop(0 if full else t_word + 1, W, stepB, 0)
         return t_word + 1
 
     jax.lax.while_loop(cond, body, jnp.int32(0))
 
 
-def _elim_blocked_pallas_ok(W, m, n, r_star, bt):
-    words = (2 * W * m + 5 * m + 2 * r_star + 2 * 32 + 16) * bt
+def _elim_blocked_pallas_ok(W, m, n, r_star, bt, full: bool = False):
+    # the full variant adds one (W, m, bt) output block for the reduced
+    # matrix on top of the shared scratch
+    words = ((3 if full else 2) * W * m + 5 * m + 2 * r_star + 2 * 32
+             + 16) * bt
     return words * 4 <= _ELIM_VMEM_LIMIT
 
 
+def _elim_blocked_full_kernel(packed_ref, synd_ref,
+                              synd_out_ref, pr_ref, pc_ref, fword_ref,
+                              fpos_ref, packed_out_ref,
+                              work_ref, used_ref, rank_ref, fcnt_ref,
+                              *, W: int, m: int, n: int, r_star: int,
+                              fcap: int, bt: int):
+    """Full-maintenance variant (OSD-CS): the same blocked loop with the
+    dead-word skip disabled, plus the fully-reduced matrix as an output —
+    routes through ``_elim_blocked_kernel`` (and thus the SAME shared
+    phase-A/phase-B bodies the R007 "osd_elim_blocked" contract pins)."""
+    _elim_blocked_kernel(
+        packed_ref, synd_ref, synd_out_ref, pr_ref, pc_ref, fword_ref,
+        fpos_ref, work_ref, used_ref, rank_ref, fcnt_ref,
+        W=W, m=m, n=n, r_star=r_star, fcap=fcap, bt=bt, full=True)
+    packed_out_ref[:] = work_ref[:]
+
+
 def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
-                              bt: int = 128, interpret: bool = False):
+                              bt: int = 128, interpret: bool = False,
+                              full: bool = False):
     """VMEM-resident blocked RREF.  Returns (synd (m, B) fully reduced,
     pivot_rows (r*, B), pivot_cols_perm (r*, B), fword (m, B) free-panel
-    words, fpos (32, B) permuted free-column positions)."""
+    words, fpos (32, B) permuted free-column positions); with
+    ``full=True`` (the OSD-CS route) additionally the fully-maintained
+    reduced matrix (W, m, B) as a sixth output."""
     B = perm.shape[0]
     m, n, r_star = plan.m, plan.n, plan.rank
     W = (n + 31) // 32
@@ -633,15 +661,38 @@ def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
     packed0 = _permute_and_pack(h01, perm).astype(jnp.int32)   # (W, m, B)
     synd0 = syndromes.astype(jnp.int32).T                      # (m, B)
 
-    kernel = functools.partial(
-        _elim_blocked_kernel, W=W, m=m, n=n, r_star=r_star,
-        fcap=int(fcap), bt=bt)
+    if full:
+        kernel = functools.partial(
+            _elim_blocked_full_kernel, W=W, m=m, n=n, r_star=r_star,
+            fcap=int(fcap), bt=bt)
+    else:
+        kernel = functools.partial(
+            _elim_blocked_kernel, W=W, m=m, n=n, r_star=r_star,
+            fcap=int(fcap), bt=bt)
     grid = (B // bt,)
     # unique deterministic name per instantiation (see bp_pallas: mosaic's
     # same-name uniquing is process-history-dependent and breaks the
     # persistent compilation cache)
-    kname = f"osd_elim_{m}x{n}_r{r_star}_f{int(fcap)}_B{B}x{bt}"
-    synd, pr, pc, fword, fpos = pl.pallas_call(
+    kname = (f"osd_elim_{'full_' if full else ''}{m}x{n}_r{r_star}"
+             f"_f{int(fcap)}_B{B}x{bt}")
+    out_specs = [
+        pl.BlockSpec((m, bt), lambda t: (0, t)),
+        pl.BlockSpec((r_star, bt), lambda t: (0, t)),
+        pl.BlockSpec((r_star, bt), lambda t: (0, t)),
+        pl.BlockSpec((m, bt), lambda t: (0, t)),
+        pl.BlockSpec((32, bt), lambda t: (0, t)),
+    ]
+    out_shape = [
+        jax.ShapeDtypeStruct((m, B), jnp.int32),
+        jax.ShapeDtypeStruct((r_star, B), jnp.int32),
+        jax.ShapeDtypeStruct((r_star, B), jnp.int32),
+        jax.ShapeDtypeStruct((m, B), jnp.int32),
+        jax.ShapeDtypeStruct((32, B), jnp.int32),
+    ]
+    if full:
+        out_specs.append(pl.BlockSpec((W, m, bt), lambda t: (0, 0, t)))
+        out_shape.append(jax.ShapeDtypeStruct((W, m, B), jnp.int32))
+    outs = pl.pallas_call(
         kernel,
         name=kname,
         grid=grid,
@@ -649,20 +700,8 @@ def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
             pl.BlockSpec((W, m, bt), lambda t: (0, 0, t)),
             pl.BlockSpec((m, bt), lambda t: (0, t)),
         ],
-        out_specs=[
-            pl.BlockSpec((m, bt), lambda t: (0, t)),
-            pl.BlockSpec((r_star, bt), lambda t: (0, t)),
-            pl.BlockSpec((r_star, bt), lambda t: (0, t)),
-            pl.BlockSpec((m, bt), lambda t: (0, t)),
-            pl.BlockSpec((32, bt), lambda t: (0, t)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((m, B), jnp.int32),
-            jax.ShapeDtypeStruct((r_star, B), jnp.int32),
-            jax.ShapeDtypeStruct((r_star, B), jnp.int32),
-            jax.ShapeDtypeStruct((m, B), jnp.int32),
-            jax.ShapeDtypeStruct((32, B), jnp.int32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((W, m, bt), jnp.int32),
             pltpu.VMEM((m, bt), jnp.int32),
@@ -674,10 +713,11 @@ def _eliminate_pallas_blocked(plan, perm, syndromes, fcap: int,
         ),
         interpret=interpret,
     )(packed0, synd0)
-    return synd, pr, pc, fword, fpos
+    return tuple(outs)
 
 
-def _eliminate_blocked_twin(plan, perm, syndromes, fcap: int):
+def _eliminate_blocked_twin(plan, perm, syndromes, fcap: int,
+                            full: bool = False):
     """XLA twin of the blocked VMEM kernel, built from the SAME phase-A /
     phase-B bodies (``_blocked_stepA`` / ``_blocked_phaseB_delta``) — the
     structural contract is registered in analysis/rules_kernels.py
@@ -691,7 +731,12 @@ def _eliminate_blocked_twin(plan, perm, syndromes, fcap: int):
     Phase B applies the fused block update only to words strictly RIGHT of
     the current block — the same dead-word skip the kernel's ``stepB``
     range encodes — so every word the loop later reads holds exactly the
-    value the kernel's VMEM scratch would."""
+    value the kernel's VMEM scratch would.  ``full=True`` (the OSD-CS
+    route, mirroring the kernel's ``full`` flag) disables the skip and
+    returns the fully-maintained reduced matrix (W, m, B) as a sixth
+    output: each delta is computed on block-start values, so applying it
+    to EVERY word — including the current one, whose delta reproduces
+    phase A exactly — yields the true full RREF."""
     B = perm.shape[0]
     m, n, r_star = plan.m, plan.n, plan.rank
     W = (n + 31) // 32
@@ -721,8 +766,11 @@ def _eliminate_blocked_twin(plan, perm, syndromes, fcap: int):
             init)
         delta = jax.vmap(
             lambda row: _blocked_phaseB_delta(row, pivword, aug))(packed)
-        live = 0 - (words > t_word).astype(i32)    # all-ones mask, w > t
-        packed = packed ^ (delta & live)
+        if full:
+            packed = packed ^ delta
+        else:
+            live = 0 - (words > t_word).astype(i32)  # all-ones mask, w > t
+            packed = packed ^ (delta & live)
         return (t_word + 1, packed, synd, used, fword, rank, fcnt, pr, pc,
                 fpos)
 
@@ -731,8 +779,10 @@ def _eliminate_blocked_twin(plan, perm, syndromes, fcap: int):
              jnp.zeros((B,), i32), jnp.zeros((B,), i32),
              jnp.zeros((r_star, B), i32), jnp.zeros((r_star, B), i32),
              jnp.zeros((32, B), i32))
-    (_t, _packed, synd, _used, fword, _rank, _fcnt, pr, pc,
+    (_t, packed, synd, _used, fword, _rank, _fcnt, pr, pc,
      fpos) = jax.lax.while_loop(cond, body, state)
+    if full:
+        return synd, pr, pc, fword, fpos, packed
     return synd, pr, pc, fword, fpos
 
 
@@ -767,10 +817,12 @@ def osd_decode_values(cfg, h_packed, cost, syndromes, posterior_llrs):
     plan.n, plan.rank = n, r_star
     plan.packed, plan.cost = h_packed, cost
 
+    from ..decoders.osd import OSD_CS_MAX_ORDER, _check_osd_order
+
     perm = jnp.argsort(posterior_llrs, axis=1, stable=True).astype(jnp.int32)
     W = (n + 31) // 32
     bt = 128
-    w = min(int(osd_order), n - r_star, 20)
+    w = min(_check_osd_order(osd_order), n - r_star, OSD_CS_MAX_ORDER)
     # elimination strategy (QLDPC_OSD_ELIM): "pallas" (default) = the
     # VMEM-resident blocked kernel; off-TPU (or at shapes the kernel's
     # gates reject) it routes to "twin" — the XLA twin built from the SAME

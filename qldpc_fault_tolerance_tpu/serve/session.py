@@ -175,8 +175,14 @@ class DecodeSession:
         static, state = self._rebuild()
         width = device_syndrome_width(static, state)
         telemetry.count("serve.session.builds")
+        if static[0] != "bposd_dev":
+            backend = "none"
+        elif len(static) > 6 and static[6] == "osd_cs":
+            backend = "device_cs"  # combination-sweep program (ISSUE 19)
+        else:
+            backend = "device"
         return (static, state, width, kernel_variant(static, state),
-                "device" if static[0] == "bposd_dev" else "none")
+                backend)
 
     def _resolve_state(self) -> None:
         # which BP kernel the AOT programs will route to (the decode

@@ -777,14 +777,18 @@ def joint_kernel_variant(*decoders, batch_size: int | None = None) -> str:
 def joint_osd_backend(*decoders) -> str:
     """Where a simulator's OSD stages run (the ``wer_run`` ``osd_backend``
     field): "device" when every OSD-bearing decoder keeps its OSD inside
-    the device program, "host" when every one still round-trips,
-    "mixed" on disagreement, "none" when no decoder has an OSD stage."""
+    the device program ("device_cs" when they all run the combination
+    sweep, ISSUE 19), "host" when every one still round-trips, "mixed"
+    on disagreement, "none" when no decoder has an OSD stage."""
     backends = set()
     for dec in decoders:
-        if getattr(dec, "osd_method", None) is None:
+        method = getattr(dec, "osd_method", None)
+        if method is None:
             continue
-        backends.add("host" if getattr(dec, "needs_host_postprocess", False)
-                     else "device")
+        if getattr(dec, "needs_host_postprocess", False):
+            backends.add("host")
+        else:
+            backends.add("device_cs" if method == "osd_cs" else "device")
     if not backends:
         return "none"
     return backends.pop() if len(backends) == 1 else "mixed"

@@ -544,7 +544,9 @@ EVENT_SCHEMAS: dict[str, dict] = {
         # ops.bp_pallas.KERNEL_VARIANTS, or "mixed") — silent routing to
         # the XLA twin now leaves a named trace (ISSUE 9 satellite).
         # osd_backend (ISSUE 13, additive): where the run's OSD stage ran —
-        # "device" / "host" / "mixed" / "none" (no OSD decoder)
+        # "device" / "host" / "mixed" / "none" (no OSD decoder); ISSUE 19
+        # adds the value "device_cs" (device combination sweep) — an
+        # additive VALUE only, the field set is unchanged
         "optional": {"dispatches": int, "kernel_variant": str,
                      "osd_backend": str,
                      **_CI_FIELDS, **_WEIGHTED_FIELDS},
@@ -629,7 +631,9 @@ EVENT_SCHEMAS: dict[str, dict] = {
         "required": {"session": str, "event": str},
         # osd_backend (ISSUE 13, additive): "device" for bposd_dev
         # programs, "none" otherwise — host-OSD configs are rejected at
-        # session construction, so "host" never appears here.
+        # session construction, so "host" never appears here; ISSUE 19
+        # adds "device_cs" for combination-sweep programs (additive
+        # VALUE only, the field set is unchanged).
         # reason/programs (ISSUE 14, additive): the self-healing
         # event="heal" names why the probe fired and how many warm
         # buckets were recompiled in the background.
@@ -1199,7 +1203,13 @@ TELE_ITER_HIST0 = 4      # + len(ITER_BUCKETS)+1 histogram slots
 TELE_OSD_TIER_NONE = TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1  # all converged
 TELE_OSD_TIER_COMPACT = TELE_OSD_TIER_NONE + 1  # a compaction tier engaged
 TELE_OSD_TIER_FULL = TELE_OSD_TIER_NONE + 2     # full-batch elimination
-TELE_LEN = TELE_OSD_TIER_FULL + 1
+# device combination-sweep occupancy (ISSUE 19, additive slots): candidates
+# scored (sweep width x OSD-routed shots) and chunk sweeps run by osd_cs
+# decode stages — the widths come from ops.osd_cs_device.cs_sweep_shape,
+# the same definition the decode program sizes its sweep by
+TELE_CS_CANDIDATES = TELE_OSD_TIER_FULL + 1
+TELE_CS_CHUNKS = TELE_CS_CANDIDATES + 1
+TELE_LEN = TELE_CS_CHUNKS + 1
 
 
 def device_tele_vec(aux_by_static) -> "object":
@@ -1223,6 +1233,8 @@ def device_tele_vec(aux_by_static) -> "object":
     tier_none = jnp.zeros((), jnp.int32)
     tier_compact = jnp.zeros((), jnp.int32)
     tier_full = jnp.zeros((), jnp.int32)
+    cs_cand = jnp.zeros((), jnp.int32)
+    cs_chunks = jnp.zeros((), jnp.int32)
     for static, aux in aux_by_static:
         c = aux.get("converged")
         if c is None:
@@ -1246,6 +1258,17 @@ def device_tele_vec(aux_by_static) -> "object":
             tier_none = tier_none + none_b
             tier_compact = tier_compact + compact_b
             tier_full = tier_full + (1 - none_b - compact_b)
+            # combination-sweep occupancy: static slots 2..4 are (n,
+            # rank, osd_order) — python ints, so the sweep widths fold
+            # as traced constants through the megabatch carry
+            if len(static) > 6 and static[6] == "osd_cs":
+                from ..ops.osd_cs_device import cs_sweep_shape
+
+                n_cand, n_chunks = cs_sweep_shape(
+                    int(static[2]), int(static[3]), int(static[4]))
+                cs_cand = cs_cand + jnp.int32(n_cand) * n_bad
+                cs_chunks = cs_chunks + (
+                    jnp.int32(n_chunks) * (n_bad > 0).astype(jnp.int32))
         it = aux.get("iterations")
         if it is not None:
             cmask = c.astype(jnp.int32)
@@ -1255,6 +1278,7 @@ def device_tele_vec(aux_by_static) -> "object":
     return jnp.concatenate([
         shots[None], conv[None], osd[None], it_sum[None], hist,
         tier_none[None], tier_compact[None], tier_full[None],
+        cs_cand[None], cs_chunks[None],
     ]).astype(jnp.int32)
 
 
@@ -1289,6 +1313,11 @@ def publish_device_tele(vec) -> None:
                            (TELE_OSD_TIER_FULL, "osd.tier_full")):
             if int(v[slot]):
                 _REGISTRY.counter(name).inc(int(v[slot]))
+    if len(v) > TELE_CS_CHUNKS:  # pre-ISSUE-19 carries lack the CS slots
+        for slot, name in ((TELE_CS_CANDIDATES, "osd.cs_candidates"),
+                           (TELE_CS_CHUNKS, "osd.cs_chunks")):
+            if int(v[slot]):
+                _REGISTRY.counter(name).inc(int(v[slot]))
     hist = _REGISTRY.histogram("bp.iterations", ITER_BUCKETS)
     counts = v[TELE_ITER_HIST0:TELE_ITER_HIST0 + len(ITER_BUCKETS) + 1]
     it_sum = int(v[TELE_ITER_SUM])
@@ -1313,6 +1342,8 @@ for _n, _h in (
     ("bp.converged", "shots whose BP converged within max_iter"),
     ("bp.iterations", "BP iterations to convergence (converged shots only)"),
     ("osd.device_shots", "shots routed to a device-OSD stage"),
+    ("osd.cs_candidates", "combination-sweep candidates scored on device"),
+    ("osd.cs_chunks", "combination-sweep pattern-chunk passes run"),
     ("serve.latency_s", "end-to-end request latency, seconds"),
     ("serve.batch_wait_s", "request wait before batch dispatch, seconds"),
     ("serve.queue_depth", "batcher queue depth at sample time"),

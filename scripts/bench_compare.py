@@ -92,6 +92,11 @@ GATED_FIELDS = (
     "osd_ab.device_shots_per_s",
     "osd_ab.host_shots_per_s",
     "bposd.host_round_trips",
+    # device OSD-CS (ISSUE 19): the batched combination-sweep arm gates as
+    # a rate; cs_host_round_trips gates on 0 -> nonzero like the osd_e
+    # counter (a reappearing host round-trip IS the regression)
+    "cs_ab.device_cs_shots_per_s",
+    "bposd.cs_host_round_trips",
     # serving scaling half (bench.py serve, ISSUE 15): the packed wire's
     # bytes/request gates on INCREASES (a layout/header regression shows
     # up as more bytes on the wire), the cross-session fused dispatch
@@ -129,6 +134,7 @@ GATED_FIELDS = (
 # gated fields where a RISE is the regression (latencies, host round-trips)
 LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms",
                                     "bposd.host_round_trips",
+                                    "bposd.cs_host_round_trips",
                                     "wire_ab.packed_bytes_per_req",
                                     "stream.p99_commit_ms",
                                     "fleet.handoff_p99_ms"})

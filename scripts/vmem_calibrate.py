@@ -1,9 +1,9 @@
 """Generate the VMEM calibration table (calibration/vmem_table.json).
 
 For every shipped code shape (codes_lib_tpu/*.npz plus small HGP shapes)
-and every VMEM-gated Pallas kernel — the v1/v2 BP heads (ops/bp_pallas)
-and the fused GF(2) sample/residual/whole-pipeline kernels
-(ops/gf2_pallas) — the harness:
+and every VMEM-gated Pallas kernel — the v1/v2 BP heads (ops/bp_pallas),
+the fused GF(2) sample/residual/whole-pipeline kernels (ops/gf2_pallas)
+and the OSD-CS combination sweep (ops/osd_cs_device) — the harness:
 
   1. records the ANALYTIC per-shot / per-block VMEM estimate (the numbers
      the gates used through round 5, known to undercount mosaic
@@ -318,6 +318,67 @@ def _gf2_probe(name, hx, hz, lx, lz, on_tpu: bool, batch: int):
     return entries
 
 
+def _osd_cs_probe(name, hx, on_tpu: bool, batch: int):
+    """Calibration entry for the OSD-CS combination sweep (ISSUE 19): the
+    pattern-chunk chooser and residency gate restated at this code's
+    (n, rank) with osd_order=10, plus a probe of the sweep at candidate
+    chunk sizes — real compiles on TPU, interpret execution on CPU with
+    feasibility falling back to the analytic residency budget (entries
+    stay ``"measured": false`` off-TPU, same contract as the BP probes)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from qldpc_fault_tolerance_tpu.ops import osd_cs_device as cs
+    from qldpc_fault_tolerance_tpu.ops.osd_device import build_osd_plan
+    from qldpc_fault_tolerance_tpu.utils import profiling
+
+    order = 10
+    bt = 128
+    plan = build_osd_plan(hx, np.full(hx.shape[1], 0.01))
+    n, rank = plan.n, plan.rank
+    f, w, _ = cs._cs_counts(n, rank, order)
+    n_cand, n_chunks = cs.cs_sweep_shape(n, rank, order)
+    chosen = cs.cs_pat_chunk(n, rank, order, bt)
+    wsq = max(w * w, 1)
+    fcols = max(f, 1)
+    limit = cs._gate("osd_cs_sweep_limit_bytes", cs._CS_SWEEP_VMEM_LIMIT)
+
+    def sweep_bytes(chunk: int) -> int:
+        n_pad = -(-n_cand // chunk) * chunk
+        return 4 * (n_pad * fcols + n_pad * wsq + (fcols + wsq + 8) * bt
+                    + chunk * bt + 2 * 8 * bt)
+
+    def try_compile(chunk: int) -> bool:
+        e1t, e2t, _j1, _j2, _nc, _np_ = cs._cs_plane(f, w, chunk)
+        dplane = jnp.zeros((fcols, bt), jnp.float32)
+        xflat = jnp.zeros((wsq, bt), jnp.float32)
+        base = jnp.zeros((bt,), jnp.float32)
+        cs._cs_sweep_pallas(jnp.asarray(e1t), jnp.asarray(e2t), dplane,
+                            xflat, base, chunk, bt=bt,
+                            interpret=not on_tpu)
+        if not on_tpu:
+            # no mosaic on CPU: interpret execution validates lowering,
+            # feasibility falls back to the analytic residency budget
+            return sweep_bytes(chunk) <= limit
+        return True
+
+    candidates = [c for c in (512, 256, 128, 64) if c <= max(n_cand, 64)]
+    best, attempts = profiling.probe_max_block(try_compile, candidates)
+    entry = {
+        "kernel": "osd_cs_sweep", "n": n, "rank": rank, "f": f, "w": w,
+        "osd_order": order, "n_candidates": n_cand, "n_chunks": n_chunks,
+        "chosen_pat_chunk": chosen,
+        "analytic_sweep_bytes": sweep_bytes(chosen),
+        "feasible": cs.cs_sweep_feasible(n, rank, order, bt),
+        "probe_bt": bt,
+        "max_pat_chunk": best,
+        "measured": bool(on_tpu),
+        "attempts": [{"block": b, "ok": ok, **({"error": e} if e else {})}
+                     for b, ok, e in attempts],
+    }
+    return entry
+
+
 def build_table(code_names, quick: bool = False) -> dict:
     on_tpu = _on_tpu()
     batch = 1024 if quick else 4096
@@ -327,6 +388,7 @@ def build_table(code_names, quick: bool = False) -> dict:
         for e in (_bp_head_probe(hx, on_tpu, batch),
                   _bp_head_v2_probe(hx, on_tpu, batch),
                   _fused_decode_probe(name, hx, hz, lx, lz, on_tpu, batch),
+                  _osd_cs_probe(name, hx, on_tpu, batch),
                   *_gf2_probe(name, hx, hz, lx, lz, on_tpu, batch)):
             e["code"] = name
             entries.append(e)
@@ -335,7 +397,7 @@ def build_table(code_names, quick: bool = False) -> dict:
     # (README "Known frontiers") and stands until a TPU run replaces it
     ratios = {}
     for kernel in ("bp_head", "bp_head_v2", "fused_decode",
-                   "gf2_sample_synd", "gf2_residual"):
+                   "gf2_sample_synd", "gf2_residual", "osd_cs_sweep"):
         rs = [e["ratio_vs_analytic"] for e in entries
               if e["kernel"] == kernel and e.get("measured")
               and e.get("ratio_vs_analytic")]
@@ -352,9 +414,15 @@ def build_table(code_names, quick: bool = False) -> dict:
     # fallback constants silently; a CPU run records the conservative
     # defaults (gates_measured=false), a TPU run may raise them with
     # try-compile evidence
+    from qldpc_fault_tolerance_tpu.ops import osd_cs_device
+
     gates = {
         "bp_head_scat_limit_bytes": 8 * 1024 * 1024,
         "bp_head_v2_fixed_limit_bytes": bp_pallas._V2_FIXED_LIMIT,
+        # OSD-CS sweep (ISSUE 19): conservative shipped defaults — a TPU
+        # calibration run may raise them with try-compile evidence
+        "osd_cs_sweep_limit_bytes": osd_cs_device._CS_SWEEP_VMEM_LIMIT,
+        "osd_cs_chunk_limit_bytes": osd_cs_device._CS_CHUNK_LIMIT,
     }
 
     return {

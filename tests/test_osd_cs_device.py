@@ -1,0 +1,271 @@
+"""Device OSD-CS (ISSUE 19): the batched order-w combination sweep.
+
+Covers the tentpole contracts: host-oracle parity at osd_order 0/4/10 on
+tall, rank-deficient, and random H (bit-equal or the documented
+float32-tie on a syndrome-consistent candidate), sweep kernel == XLA
+twin bit-exactness on irregular shapes, the full-maintenance blocked
+elimination twin vs the per-column blocked oracle, the loud
+OSD_CS_MAX_ORDER cap, warm-sweep zero retraces + zero host round-trips
+with the osd.cs_* device-tele counters, the device_cs serve backend, and
+the n1225 mesh-sharded BPOSD bucket smoke (CPU mesh)."""
+import os
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from qldpc_fault_tolerance_tpu.codes import hgp, load_code, rep_code
+from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder
+from qldpc_fault_tolerance_tpu.decoders.osd import (
+    OSD_CS_MAX_ORDER,
+    _channel_cost,
+    osd_decode_batch,
+)
+from qldpc_fault_tolerance_tpu.ops import osd_cs_device as cs
+from qldpc_fault_tolerance_tpu.ops import osd_device as od
+from qldpc_fault_tolerance_tpu.utils import telemetry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture_h(kind, rng):
+    if kind == "tall":
+        # more checks than columns — typically full column rank, so the
+        # sweep degenerates to f == 0 free columns (the OSD-0 edge)
+        h = (rng.random((48, 40)) < 0.2).astype(np.uint8)
+    elif kind == "rank_deficient":
+        h = (rng.random((24, 60)) < 0.18).astype(np.uint8)
+        h[-1] = h[0]  # duplicated check: rank < m
+    else:
+        h = (rng.random((20, 48)) < 0.22).astype(np.uint8)
+    h[:, h.sum(0) == 0] = 1
+    return h
+
+
+# ---------------------------------------------------------------------------
+# host-oracle parity (the PR-13 float32-tie contract, now for osd_cs)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("order", [0, 4, 10])
+@pytest.mark.parametrize("kind", ["tall", "rank_deficient", "random"])
+def test_osd_cs_device_matches_host_oracle(kind, order):
+    """Every compared shot must be bit-equal with the demoted host
+    combination loop, or a float32/64 cost tie on a syndrome-consistent
+    candidate — the same parity contract device OSD-E ships under."""
+    rng = np.random.default_rng(5)
+    h = _fixture_h(kind, rng)
+    n = h.shape[1]
+    probs = rng.uniform(0.01, 0.2, n)
+    B = 96
+    errs = (rng.random((B, n)) < 0.06).astype(np.uint8)
+    synds = (errs @ h.T % 2).astype(np.uint8)
+    dev = BPOSD_Decoder(h, probs, max_iter=8, osd_method="osd_cs",
+                        osd_order=order)
+    host = BPOSD_Decoder(h, probs, max_iter=8, osd_method="osd_cs",
+                         osd_order=order, device_osd=False)
+    assert dev.device_osd and not dev.needs_host_postprocess
+    assert not host.device_osd and host.needs_host_postprocess
+    a = np.asarray(dev.decode_batch(synds))
+    b = np.asarray(host.decode_batch(synds))
+    cost = _channel_cost(probs)
+    exact = (a == b).all(axis=1)
+    synd_ok = ((a @ h.T % 2) == synds).all(axis=1)
+    tie = np.abs((a * cost[None]).sum(1) - (b * cost[None]).sum(1)) < 1e-4
+    assert (exact | (tie & synd_ok)).all(), (
+        f"{kind}/order={order}: "
+        f"{int((~(exact | (tie & synd_ok))).sum())} shots outside the "
+        f"parity contract")
+
+
+def test_osd_cs_order_cap_is_loud():
+    """Satellite (a): osd_order above the shared OSD_CS_MAX_ORDER raises
+    a ValueError on BOTH the device decoder and the host batch entry —
+    never a silent clamp."""
+    h = np.eye(6, dtype=np.uint8)
+    probs = np.full(6, 0.05)
+    with pytest.raises(ValueError, match="OSD_CS_MAX_ORDER"):
+        BPOSD_Decoder(h, probs, max_iter=4, osd_method="osd_cs",
+                      osd_order=OSD_CS_MAX_ORDER + 1)
+    with pytest.raises(ValueError, match="OSD_CS_MAX_ORDER"):
+        osd_decode_batch(h, np.zeros((2, 6), np.uint8),
+                         np.zeros((2, 6), np.float32), probs,
+                         osd_method="osd_cs",
+                         osd_order=OSD_CS_MAX_ORDER + 1)
+
+
+# ---------------------------------------------------------------------------
+# kernel == twin (R007 "osd_cs_sweep") and the full-maintenance elimination
+# ---------------------------------------------------------------------------
+def test_cs_sweep_kernel_matches_twin_bit_exact():
+    """The Pallas sweep (interpret mode off-TPU) and its XLA twin share
+    one chunk body — cost AND winner index must match bit for bit on an
+    irregular shape (f=14, w=5, chunk=8: 25 candidates pad to 32, a
+    ragged final chunk of pad rows)."""
+    rng = np.random.default_rng(3)
+    f, w, chunk, B, bt = 14, 5, 8, 256, 128
+    e1t, e2t, _j1, _j2, n_cand, n_pad = cs._cs_plane(f, w, chunk)
+    assert n_pad % chunk == 0 and n_pad > n_cand  # ragged final chunk
+    dplane = jnp.asarray(rng.normal(size=(f, B)).astype(np.float32))
+    xflat = jnp.asarray(rng.normal(size=(w * w, B)).astype(np.float32))
+    base = jnp.asarray(rng.normal(size=(B,)).astype(np.float32))
+    tc, ti = cs._cs_sweep_xla(jnp.asarray(e1t), jnp.asarray(e2t),
+                              dplane, xflat, base, chunk)
+    kc, ki = cs._cs_sweep_pallas(jnp.asarray(e1t), jnp.asarray(e2t),
+                                 dplane, xflat, base, chunk, bt=bt,
+                                 interpret=True)
+    np.testing.assert_array_equal(np.asarray(tc), np.asarray(kc))
+    np.testing.assert_array_equal(np.asarray(ti), np.asarray(ki))
+
+
+def test_full_elimination_twin_matches_blocked_oracle():
+    """CS needs every word of the reduced PIVOT rows maintained (weight-1
+    spans ALL free columns): the ``full=True`` blocked twin must
+    reproduce the per-column blocked oracle's pivots and the full-width
+    bitplanes of every pivot row — the rows the sweep's dplane/X
+    decomposition gathers.  (Rows that never pivot are dead to the
+    decode and outside the contract.)"""
+    rng = np.random.default_rng(12)
+    m, n, B = 14, 40, 16
+    h = (rng.random((m, n)) < 0.25).astype(np.uint8)
+    h[:, h.sum(0) == 0] = 1
+    plan = od.build_osd_plan(h, rng.uniform(0.01, 0.3, n))
+    perm = jnp.argsort(
+        jnp.asarray(rng.normal(size=(B, n)).astype(np.float32)),
+        axis=1, stable=True).astype(jnp.int32)
+    synds = ((rng.random((B, n)) < 0.1).astype(np.uint8) @ h.T % 2).astype(
+        np.uint8)
+    _u_a, pr_a, pc_a, _ip_a, packed_a = od._eliminate_blocked(
+        plan, perm, jnp.asarray(synds))
+    _synd_b, pr_b, pc_b, _fw, _fp, packed_b = od._eliminate_blocked_twin(
+        plan, perm, jnp.asarray(synds), fcap=0, full=True)
+    np.testing.assert_array_equal(np.asarray(pr_a), np.asarray(pr_b))
+    np.testing.assert_array_equal(np.asarray(pc_a), np.asarray(pc_b))
+    # bit-compare as uint32: the oracle packs uint32, the twin rides the
+    # kernel's int32 lanes — same bits, different sign interpretation
+    rows_a = np.take_along_axis(np.asarray(packed_a).view(np.uint32),
+                                np.asarray(pr_a)[None, :, :], axis=1)
+    rows_b = np.take_along_axis(np.asarray(packed_b).view(np.uint32),
+                                np.asarray(pr_b)[None, :, :], axis=1)
+    np.testing.assert_array_equal(rows_a, rows_b)
+
+
+# ---------------------------------------------------------------------------
+# warm-path retraces, host round-trips, device-tele counters
+# ---------------------------------------------------------------------------
+def test_osd_cs_warm_sweep_zero_retraces_zero_host_round_trips():
+    """Acceptance: a warm osd_cs BPOSD sweep at NEW p-values adds zero
+    retraces (the index plane and pat_chunk are static per (H, w)),
+    completes with ``osd.host_round_trips == 0`` through the megabatch
+    carry, and the satellite ``osd.cs_candidates`` / ``osd.cs_chunks``
+    device-tele counters surface the sweep's real shape."""
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    code = hgp(rep_code(3), rep_code(3))
+
+    def run(p):
+        def mk(h):
+            return BPOSD_Decoder(h, np.full(code.N, p), max_iter=4,
+                                 osd_method="osd_cs", osd_order=4)
+
+        sim = CodeSimulator_DataError(
+            code=code, decoder_x=mk(code.hz), decoder_z=mk(code.hx),
+            pauli_error_probs=[p / 3] * 3, batch_size=128, seed=0,
+            scan_chunk=2)
+        sim.WordErrorRate(256)
+
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        for p in (0.06, 0.1):
+            run(p)
+        before = telemetry.compile_stats().get("jax.retraces", 0)
+        for p in (0.08, 0.12):
+            run(p)
+        after = telemetry.compile_stats().get("jax.retraces", 0)
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    assert after - before == 0, (
+        f"{after - before} retraces on a warm osd_cs p-sweep")
+    assert snap.get("osd.host_round_trips", {}).get("value", 0) == 0
+    assert snap.get("osd.host_fallbacks", {}).get("value", 0) == 0
+    rank = BPOSD_Decoder(code.hz, np.full(code.N, 0.06), max_iter=4,
+                         osd_method="osd_cs",
+                         osd_order=4).device_static[3]
+    n_cand, _n_chunks = cs.cs_sweep_shape(code.N, int(rank), 4)
+    cands = snap.get("osd.cs_candidates", {}).get("value", 0)
+    chunks = snap.get("osd.cs_chunks", {}).get("value", 0)
+    assert cands > 0 and chunks > 0
+    # counters are multiples of the sweep's real shape (per bad shot /
+    # per engaged batch)
+    assert cands % n_cand == 0
+
+
+# ---------------------------------------------------------------------------
+# serving: the osd_cs bucket names its backend and stays bit-exact
+# ---------------------------------------------------------------------------
+def test_bposd_cs_session_serves_device_cs_bit_exact():
+    """Satellite (b)+(tentpole wiring): an osd_cs BPOSD factory serves
+    through DecodeSession on this CPU substrate (no host demotion), the
+    session names ``osd_backend == "device_cs"``, and served corrections
+    match offline decode_batch bit for bit."""
+    from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
+    from qldpc_fault_tolerance_tpu.serve import DecodeSession
+
+    code = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+    params = {"h": code.hx, "p_data": 0.05}
+    cls = BPOSD_Decoder_Class(8, "minimum_sum", 0.625, "osd_cs", 6)
+    sess = DecodeSession("bposd_cs", decoder_class=cls, params=params,
+                         buckets=(32, 64))
+    assert sess.osd_backend == "device_cs"
+    assert sess.static[0] == "bposd_dev" and sess.static[6] == "osd_cs"
+    rng = np.random.default_rng(2)
+    errs = (rng.random((40, code.N)) < 0.1).astype(np.uint8)
+    synd = (errs @ np.asarray(code.hx, np.uint8).T % 2).astype(np.uint8)
+    out = sess.decode(synd)
+    off = cls.GetDecoder(params).decode_batch(synd)
+    np.testing.assert_array_equal(out.corrections, np.asarray(off))
+
+
+# ---------------------------------------------------------------------------
+# mesh-sharded n1225 bucket smoke (tentpole acceptance, CPU mesh)
+# ---------------------------------------------------------------------------
+def test_bposd_cs_mesh_sharded_n1225_bucket_smoke():
+    """An hgp_34_n1225 osd_cs BPOSD cell runs through the cell-fused
+    driver on the 8-device virtual CPU mesh: shots shard across the mesh,
+    counts come back sane, and the whole decode stays host-free."""
+    from qldpc_fault_tolerance_tpu.parallel import shot_mesh
+    from qldpc_fault_tolerance_tpu.sim import common as simc
+    from qldpc_fault_tolerance_tpu.sim.data_error import (
+        CodeSimulator_DataError,
+    )
+
+    code = load_code(os.path.join(REPO, "codes_lib_tpu",
+                                  "hgp_34_n1225.npz"))
+    p = 0.01
+
+    def mk(h):
+        return BPOSD_Decoder(h, np.full(code.N, p), max_iter=4,
+                             osd_method="osd_cs", osd_order=10)
+
+    sim = CodeSimulator_DataError(
+        code=code, decoder_x=mk(code.hz), decoder_z=mk(code.hx),
+        pauli_error_probs=[p / 3] * 3, batch_size=16, seed=0,
+        scan_chunk=1)
+    mesh = shot_mesh()
+    n_dev = mesh.devices.size
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        prog = CodeSimulator_DataError.fused_cells_program(
+            [sim], 16, mesh=mesh)
+        f, sh, _ = simc.fused_cell_finish(simc.fused_cell_launch(prog)[0])
+        snap = telemetry.snapshot()
+    finally:
+        telemetry.disable()
+    assert (sh == prog.n_batches * 16 * n_dev).all()
+    assert (f >= 0).all() and (f <= sh).all()
+    assert snap.get("osd.host_round_trips", {}).get("value", 0) == 0
+    assert snap.get("osd.host_fallbacks", {}).get("value", 0) == 0

@@ -122,10 +122,13 @@ def test_bposd_device_default_engages_off_tpu():
     assert dec.device_osd
     assert not dec.needs_host_postprocess
     assert dec.device_static[0] == "bposd_dev"
-    # osd_cs has no device implementation: it stays on the host oracle
+    # ISSUE 19: osd_cs is device-resident too — the combination sweep
+    # decodes on device (static names the method; host demoted to oracle)
     cs = BPOSD_Decoder(h, np.full(h.shape[1], 0.1), max_iter=4,
                        osd_method="osd_cs")
-    assert not cs.device_osd and cs.needs_host_postprocess
+    assert cs.device_osd and not cs.needs_host_postprocess
+    assert cs.device_static[0] == "bposd_dev"
+    assert cs.device_static[6] == "osd_cs"
 
 
 def _host_oracle_wer(code, p, max_iter, shots, seed, K):

@@ -454,7 +454,8 @@ def test_checked_in_calibration_table_is_consistent():
     assert table["entries"], "table has no entries"
     for e in table["entries"]:
         assert e["kernel"] in ("bp_head", "bp_head_v2", "fused_decode",
-                               "gf2_sample_synd", "gf2_residual")
+                               "gf2_sample_synd", "gf2_residual",
+                               "osd_cs_sweep")
         assert "measured" in e and "attempts" in e
         if not e["measured"]:
             assert "per_shot_bytes" not in e

@@ -98,14 +98,15 @@ def test_session_rejects_bad_input():
         DecodeSession("x")  # neither decoder nor factory
 
 
-def test_session_factory_path_rejects_host_osd_config():
+def test_session_factory_path_rejects_host_osd_config(monkeypatch):
     """The factory path must apply the same pure-device guard as the
-    decoder path: an osd_cs BPOSD factory (no device implementation)
-    resolves to host OSD, whose device_static silently degrades to plain
-    BP — serving it would break the bit-exact-vs-offline guarantee
-    instead of failing loudly."""
+    decoder path: a BPOSD factory forced onto host OSD (the env demotion
+    knob — osd_cs itself is device-resident since ISSUE 19) has a
+    device_static that silently degrades to plain BP — serving it would
+    break the bit-exact-vs-offline guarantee instead of failing loudly."""
     from qldpc_fault_tolerance_tpu.decoders import BPOSD_Decoder_Class
 
+    monkeypatch.setenv("QLDPC_DEVICE_OSD", "0")
     cls = BPOSD_Decoder_Class(10, "minimum_sum", 0.625, "osd_cs", 10)
     with pytest.raises(ValueError, match="host"):
         DecodeSession("x", decoder_class=cls, params=_params(CODE3))
