@@ -107,6 +107,14 @@ def reset_device_state():
         FrameSampler._CACHE.clear()
     except Exception:
         pass
+    try:
+        # in-process AOT programs may hold dead device handles; the DISK
+        # artifacts stay valid — the next request re-loads, not recompiles
+        from .utils import progcache as _progcache
+
+        _progcache.clear_memory()
+    except Exception:
+        pass
     jax.clear_caches()
     # bump the device-reset epoch LAST: the serve-side self-healing probe
     # (serve/ops.py) watches it, and healing against half-cleared caches

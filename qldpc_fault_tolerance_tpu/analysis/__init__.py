@@ -27,6 +27,7 @@ R005 event-kind / frozen-schema drift
 R006 unlocked write to module-level mutable state
 R007 kernel/twin contract drift
 R008 faultinject site not registered in SITES / not unique
+R009 inline AOT lower/compile bypasses the program cache
 R101 bare print() in library code (migrated PR-2 grep guard)
 R102 bare sleep / ad-hoc retry loop (migrated PR-7 grep guard)
 ==== =====================================================================
@@ -49,8 +50,8 @@ from .core import (
     repo_root,
     run_analysis,
 )
-from .rules_jax import (DonationRule, HostSyncRule, PRNGKeyRule,
-                        TracerSafetyRule)
+from .rules_jax import (CompileSiteRule, DonationRule, HostSyncRule,
+                        PRNGKeyRule, TracerSafetyRule)
 from .rules_kernels import KERNEL_CONTRACTS, KernelContractRule
 from .rules_runtime import (FaultSiteRule, LockDisciplineRule,
                             SchemaDriftRule)
@@ -61,6 +62,7 @@ __all__ = [
     "Finding", "Rule", "SourceModule", "UNUSED_SUPPRESSION_RULE_ID",
     "collect_modules", "run_analysis", "package_root", "repo_root",
     "HostSyncRule", "PRNGKeyRule", "TracerSafetyRule", "DonationRule",
+    "CompileSiteRule",
     "SchemaDriftRule", "LockDisciplineRule", "FaultSiteRule",
     "KernelContractRule",
     "KERNEL_CONTRACTS", "BarePrintRule", "BareSleepRule",
@@ -80,6 +82,7 @@ def default_rules() -> list:
         LockDisciplineRule(),
         KernelContractRule(),
         FaultSiteRule(),
+        CompileSiteRule(),
         BarePrintRule(),
         BareSleepRule(),
     ]

@@ -19,7 +19,7 @@ from .callgraph import ModuleImports, dotted
 from .core import Finding, Rule, SourceModule
 
 __all__ = ["HostSyncRule", "PRNGKeyRule", "TracerSafetyRule",
-           "DonationRule"]
+           "DonationRule", "CompileSiteRule"]
 
 
 def module_imports(module: SourceModule, ctx) -> ModuleImports:
@@ -808,3 +808,90 @@ class DonationRule(Rule):
                     return
             if rebound:
                 return
+
+
+class CompileSiteRule(Rule):
+    """Every AOT ``lower(...)`` / ``lower(...).compile()`` belongs to ONE
+    blessed site — ``utils/progcache.compile_cached`` — so the persistent
+    program cache sees every compile (ISSUE 20).  An inline compile works,
+    silently: it just re-pays compile time on every cold start and never
+    populates the cache, which is exactly the drift this rule pins.  The
+    probe harnesses that measure compiles on purpose
+    (``utils/profiling.py`` cost capture, ``scripts/vmem_calibrate.py``)
+    are exempt; one-time backend capability probes carry an inline
+    suppression.
+
+    Heuristics (a linter, not a type checker): a ``.compile()`` whose
+    receiver is a ``.lower(...)`` call — or a name assigned from one in
+    the same scope — fires; a bare ``.lower(...)`` WITH arguments fires
+    too (``jit.lower`` always takes the example args; ``str.lower`` never
+    takes any, so string-casing chains stay silent)."""
+
+    id = "R009"
+    title = "inline AOT lower/compile bypasses the program cache"
+
+    DEFAULT_EXEMPT = (
+        "qldpc_fault_tolerance_tpu/utils/progcache.py",
+        "qldpc_fault_tolerance_tpu/utils/profiling.py",
+        "scripts/vmem_calibrate.py",
+    )
+
+    def __init__(self, exempt: tuple = DEFAULT_EXEMPT,
+                 package_prefix: str = "qldpc_fault_tolerance_tpu/"):
+        self.exempt = exempt
+        self.package_prefix = package_prefix
+
+    def applies(self, rel: str) -> bool:
+        if rel in self.exempt:
+            return False
+        return rel.startswith(self.package_prefix) or \
+            rel.startswith("scripts/")
+
+    @staticmethod
+    def _is_lower_call(node) -> bool:
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "lower"
+                and bool(node.args or node.keywords))
+
+    def check(self, module: SourceModule, ctx) -> Iterable[Finding]:
+        for scope in _scopes(module.tree):
+            # names bound from a bare `x = f.lower(...)` in this scope
+            lowered_names: set[str] = set()
+            for node in ast.walk(scope):
+                if isinstance(node, ast.Assign) and \
+                        self._is_lower_call(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            lowered_names.add(t.id)
+            chained_lowers = set()
+            compile_findings = []
+            for node in ast.walk(scope):
+                if not (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "compile"):
+                    continue
+                recv = node.func.value
+                if self._is_lower_call(recv):
+                    # one finding per chain: the compile reports, the
+                    # receiver lower is marked consumed
+                    chained_lowers.add(id(recv))
+                    compile_findings.append(node)
+                elif isinstance(recv, ast.Name) and \
+                        recv.id in lowered_names:
+                    compile_findings.append(node)
+            for node in compile_findings:
+                yield Finding(
+                    module.rel, node.lineno, self.id,
+                    "inline lower(...).compile() bypasses the persistent "
+                    "program cache — route AOT compiles through "
+                    "utils.progcache.compile_cached", node.col_offset)
+            for node in ast.walk(scope):
+                if self._is_lower_call(node) and \
+                        id(node) not in chained_lowers:
+                    yield Finding(
+                        module.rel, node.lineno, self.id,
+                        "AOT .lower(...) outside utils/progcache — the "
+                        "lowered program's compile cannot populate the "
+                        "persistent cache; use progcache.compile_cached",
+                        node.col_offset)

@@ -23,6 +23,7 @@ import numpy as np
 
 from ..codes import gf2
 from ..ops import bp
+from ..utils import profiling
 from .osd import osd_postprocess
 
 __all__ = [
@@ -441,6 +442,9 @@ class BPDecoder:
         self._pallas_head, self._head_tag = _maybe_pallas_head(
             self.bp_method, self._graph_host, quantize=self.quantize,
             kernel=bp_kernel)
+        # surface calibration gates the table marks unmeasured — one-shot
+        # telemetry, not a warning per decoder
+        profiling.note_unmeasured_gates()
 
     needs_host_postprocess = False
 

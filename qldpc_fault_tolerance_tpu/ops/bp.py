@@ -159,6 +159,13 @@ class _LruCache:
         with self._lock:
             return key in self._d
 
+    def pop(self, key) -> bool:
+        """Drop one entry (no-op when absent).  An in-flight build of the
+        same key still lands afterwards — callers evicting for STALENESS
+        (not device death) must also bump whatever keyed the build."""
+        with self._lock:
+            return self._d.pop(key, None) is not None
+
     def clear(self):
         with self._lock:
             self._d.clear()

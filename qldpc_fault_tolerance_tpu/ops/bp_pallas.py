@@ -932,7 +932,7 @@ def v2_mosaic_supported(quantize: str | None = None) -> bool:
             h[:, 12] = 1
             sg = build_sparse_head(build_tanner_graph_host(h))
             synd = jnp.zeros((128, 6), jnp.uint8)
-            _bp_head_sparse_pallas.lower(
+            _bp_head_sparse_pallas.lower(  # qldpc: ignore[R009] — capability probe, result never cached
                 sg, synd, llr_from_probs(np.full(13, 0.01)),
                 head_iters=2, ms_scaling_factor=0.625, block_b=128,
                 interpret=False, early_stop=False, quantize=quantize,

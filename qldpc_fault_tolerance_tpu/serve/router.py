@@ -188,7 +188,7 @@ def _peek_header(payload: bytes) -> "dict | None":
 
 
 def _new_bucket() -> dict:
-    return {"entries": [], "streams": {}, "watermark": 0}
+    return {"entries": [], "streams": {}, "programs": {}, "watermark": 0}
 
 
 class _BackendLink:
@@ -673,17 +673,36 @@ class FleetRouter:
             bucket = st["pending"].setdefault(target, _new_bucket())
             # full state each export: the newest snapshot wins
             bucket["streams"][str(sid)] = state
+        # warm-program manifests (ISSUE 20): forward each session's warm
+        # (bucket, sharded) set to the family's successor so it pre-loads
+        # the programs from the persistent cache BEFORE any handoff.
+        # Deduped per (target, session, manifest) — the steady-state loop
+        # re-exports every tick, but an unchanged manifest is not news.
+        pushed = st.setdefault("prog_pushed", {})
+        for name, keys in (rep.get("programs") or {}).items():
+            fam = self._session_family.get(str(name))
+            target = (placement.get(fam, {}).get("successor")
+                      if fam else None)
+            if target is None or target in self._down:
+                continue
+            sig = repr(keys)
+            if pushed.get((target, str(name))) == sig:
+                continue
+            bucket = st["pending"].setdefault(target, _new_bucket())
+            bucket["programs"][str(name)] = list(keys)
         return True
 
     def _push_pending(self, label: str) -> None:
         st = self._repl[label]
         for target in sorted(st["pending"]):
             bucket = st["pending"][target]
-            if not bucket["entries"] and not bucket["streams"]:
+            if (not bucket["entries"] and not bucket["streams"]
+                    and not bucket.get("programs")):
                 continue
             if target in self._down:
                 bucket["entries"].clear()
                 bucket["streams"].clear()
+                bucket.get("programs", {}).clear()
                 continue
             try:
                 self._push_delta(label, target, bucket)
@@ -696,10 +715,13 @@ class FleetRouter:
         here — the fetched delta stays buffered and the successor's
         watermark lags, which a handoff must then catch up on."""
         faultinject.site("router_replicate")
+        programs = {n: list(k)
+                    for n, k in bucket.get("programs", {}).items()}
         snapshot = {"watermark": int(bucket["watermark"]),
                     "entries": list(bucket["entries"]),
                     "streams": [dict(s)
-                                for s in bucket["streams"].values()]}
+                                for s in bucket["streams"].values()],
+                    "programs": programs}
         rep = self._control(target).call({
             "op": "journal_import",
             "id": f"imp-{source}-{target}-{bucket['watermark']}",
@@ -709,6 +731,14 @@ class FleetRouter:
                 f"journal_import on {target!r} refused: {rep.get('error')}")
         bucket["entries"].clear()
         bucket["streams"].clear()
+        bucket.get("programs", {}).clear()
+        if programs:
+            # remember what landed so the steady-state re-export doesn't
+            # re-push an unchanged manifest every tick
+            pushed = self._repl[source].setdefault("prog_pushed", {})
+            for n, k in programs.items():
+                pushed[(target, n)] = repr(k)
+            telemetry.count("router.program_pushes")
         telemetry.count("router.replication_pushes")
 
     # ------------------------------------------------------------------
@@ -748,9 +778,11 @@ class FleetRouter:
             if target in self._down:
                 bucket["entries"].clear()
                 bucket["streams"].clear()
+                bucket.get("programs", {}).clear()
                 continue
             attempts = 0
-            while bucket["entries"] or bucket["streams"]:
+            while (bucket["entries"] or bucket["streams"]
+                   or bucket.get("programs")):
                 try:
                     self._push_delta(label, target, bucket)
                 except Exception:  # noqa: BLE001
@@ -764,6 +796,7 @@ class FleetRouter:
                         telemetry.count("router.handoff_drops")
                         bucket["entries"].clear()
                         bucket["streams"].clear()
+                        bucket.get("programs", {}).clear()
                         break
                     # blocking here IS the contract: the handoff must not
                     # open the successor past a lagging journal

@@ -408,6 +408,23 @@ class DecodeServer:
         snap = self.batcher.export_journal(since=since)
         snap["streams"] = [s.export_state()
                            for s in list(self._streams.values())]
+        # warm-program manifest (ISSUE 20): which (bucket, sharded)
+        # programs each resident session is serving warm.  The router
+        # forwards it to the family's ring successor, which pre-LOADS the
+        # same programs from the persistent cache — adoption then answers
+        # its first frame without a compile stall.
+        programs = {}
+        for name in self.batcher.sessions.names():
+            try:
+                sess = self.batcher.sessions.get(name)
+                keys = getattr(sess, "warm_keys", None)
+                if callable(keys):
+                    warm = keys()
+                    if warm:
+                        programs[name] = warm
+            except Exception:  # noqa: BLE001 — eviction race: skip
+                continue
+        snap["programs"] = programs
         return {"id": rid, "ok": True, **snap}
 
     def _journal_import(self, msg) -> dict:
@@ -438,8 +455,32 @@ class DecodeServer:
                                     len(self._streams))
             if stream.import_state(state):
                 streams += 1
+        # warm-start pre-load (ISSUE 20): LOAD the pushed manifest's
+        # programs from the persistent cache — strictly load-only
+        # (``adopt_program`` never compiles; a miss is a no-op), because
+        # this runs on the control plane of a host that is still serving
+        # its own families and a compile here would stall live traffic.
+        loaded = 0
+        for name, keys in (snap.get("programs") or {}).items():
+            try:
+                sess = self.batcher.sessions.get(str(name))
+            except KeyError:
+                continue
+            adopt = getattr(sess, "adopt_program", None)
+            if not callable(adopt):
+                continue
+            for entry in keys or ():
+                try:
+                    bucket, sharded = entry
+                    if adopt(int(bucket), bool(sharded)):
+                        loaded += 1
+                        telemetry.count("serve.progcache_warm_loaded")
+                    else:
+                        telemetry.count("serve.progcache_warm_skipped")
+                except Exception:  # noqa: BLE001 — warm-start best effort
+                    telemetry.count("serve.progcache_warm_skipped")
         return {"id": rid, "ok": True, "imported": int(imported),
-                "streams": int(streams),
+                "streams": int(streams), "programs_loaded": int(loaded),
                 "watermark": int(snap.get("watermark", 0))}
 
     def _rebuild_stream(self, state) -> "StreamSession | None":

@@ -40,7 +40,7 @@ from ..decoders.bp_decoders import (
     device_syndrome_width,
     kernel_variant,
 )
-from ..utils import resilience, telemetry
+from ..utils import progcache, resilience, telemetry
 
 __all__ = ["DEFAULT_BUCKETS", "DecodeOutput", "DecodeSession",
            "FusedDecodeGroup", "SessionCache", "StreamProfile",
@@ -160,6 +160,10 @@ class DecodeSession:
         self._programs: dict = {}
         self._family = None  # (generation, bucket_family) lazy cache
         self.compiles = 0
+        # programs resolved from the persistent cache instead of compiled
+        # (utils.progcache) — cold-start benches gate compiles==0 on the
+        # warm arm via these two counters
+        self.loads = 0
         # bumped by every state swap (invalidate / heal): lets the health
         # probe and tests tell "already healed" from "still serving the
         # pre-incident programs"
@@ -207,20 +211,56 @@ class DecodeSession:
                 return b
         return self.buckets[-1]
 
+    def _prog_parts(self, static, state, width, bucket: int,
+                    sharded: bool) -> dict:
+        """The content half of this program's persistent cache key: the
+        static decoder tuple, bucket shape, and the state pytree's
+        structure + leaf shapes/dtypes (``bucket_family`` discipline —
+        values are traced arguments, shapes pin the program), plus the
+        donation/sharding spec."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        shapes = tuple(
+            (tuple(np.shape(x)) if hasattr(x, "shape") else None,
+             str(getattr(x, "dtype", type(x).__name__)))
+            for x in leaves)
+        parts = {"static": static, "width": int(width),
+                 "bucket": int(bucket), "state_tree": str(treedef),
+                 "state_shapes": shapes, "donate": (),
+                 "sharded": bool(sharded)}
+        if sharded and self._mesh is not None:
+            from ..parallel.shots import SHOT_AXIS
+
+            parts["mesh"] = (tuple(self._mesh.devices.shape),
+                             tuple(self._mesh.axis_names))
+            parts["in_specs"] = ((), (SHOT_AXIS,))
+        return parts
+
     def _compile_program(self, static, state, width, bucket: int,
                          sharded: bool):
-        """One AOT compile: the plain per-bucket program, or its
+        """One AOT program: the plain per-bucket program, or its
         mesh-sharded twin (shot axis split over the session's mesh — the
         state is replicated, the syndrome/correction planes shard, and
         decode's per-shot independence makes the two bit-exact).  The
         compiled executable takes ``(state, syndromes)`` by VALUE either
-        way, so heals/restacks swap state without recompiling."""
+        way, so heals/restacks swap state without recompiling.
+
+        Routed through the persistent program cache (utils.progcache):
+        with a cache dir configured a previously-compiled artifact LOADS
+        instead of compiling — the ladder's cold start stops paying
+        compile time.  Returns ``(compiled, source)`` with source one of
+        ``"mem"`` / ``"disk"`` / ``"compile"``."""
         import jax
         import jax.numpy as jnp
 
+        parts = self._prog_parts(static, state, width, bucket, sharded)
         shape = jax.ShapeDtypeStruct((int(bucket), width), jnp.uint8)
         if not sharded:
-            return _decode_device_jit.lower(static, state, shape).compile()
+            return progcache.compile_cached(
+                _decode_device_jit, (static, state, shape),
+                kind="serve.session", parts=parts,
+                label=f"{self.name}:b{int(bucket)}")
         from jax.sharding import PartitionSpec as P
 
         from ..parallel.shots import SHOT_AXIS, _shard_map
@@ -238,7 +278,9 @@ class DecodeSession:
         run = _shard_map(local, mesh=self._mesh,
                          in_specs=(P(), P(SHOT_AXIS)),
                          out_specs=out_specs, check_vma=False)
-        return jax.jit(run).lower(state, shape).compile()
+        return progcache.compile_cached(
+            jax.jit(run), (state, shape), kind="serve.session",
+            parts=parts, label=f"{self.name}:b{int(bucket)}:sharded")
 
     def _route_sharded(self, bucket: int) -> bool:
         """Whether this bucket's decode runs the mesh-sharded program
@@ -272,26 +314,34 @@ class DecodeSession:
             if prog is not None:
                 return prog
             t0 = time.perf_counter()
-            prog = self._compile_program(self.static, self.state,
-                                         self.syndrome_width, bucket,
-                                         sharded)
+            prog, source = self._compile_program(self.static, self.state,
+                                                 self.syndrome_width,
+                                                 bucket, sharded)
             dt = time.perf_counter() - t0
             self._programs[key] = prog
-            self.compiles += 1
-            telemetry.count("serve.session.compiles")
-            telemetry.observe("serve.session.compile_s", dt)
-            telemetry.event("serve_session", session=self.name,
-                            event="compile", bucket=int(bucket),
-                            compile_s=round(dt, 4),
-                            syndrome_width=self.syndrome_width,
-                            sharded=bool(sharded),
-                            # per-BUCKET resolution: small buckets can
-                            # disengage the head path (batch gates), so
-                            # the compiled program's variant may differ
-                            # from the session-level one
-                            kernel_variant=kernel_variant(
-                                self.static, self.state, int(bucket)),
-                            osd_backend=self.osd_backend)
+            if source == "compile":
+                self.compiles += 1
+                telemetry.count("serve.session.compiles")
+                telemetry.observe("serve.session.compile_s", dt)
+                telemetry.event("serve_session", session=self.name,
+                                event="compile", bucket=int(bucket),
+                                compile_s=round(dt, 4),
+                                syndrome_width=self.syndrome_width,
+                                sharded=bool(sharded),
+                                # per-BUCKET resolution: small buckets can
+                                # disengage the head path (batch gates), so
+                                # the compiled program's variant may differ
+                                # from the session-level one
+                                kernel_variant=kernel_variant(
+                                    self.static, self.state, int(bucket)),
+                                osd_backend=self.osd_backend)
+            else:
+                # persistent-cache load: the rung skipped its compile (no
+                # new event KIND — the schema is frozen; loads show up as
+                # counters + the progcache.* stats)
+                self.loads += 1
+                telemetry.count("serve.session.loads")
+                telemetry.observe("serve.session.load_s", dt)
             return prog
 
     def warm(self, max_shots: int | None = None) -> list[int]:
@@ -308,14 +358,32 @@ class DecodeSession:
             done.append(b)
         return done
 
-    def invalidate(self) -> None:
+    def invalidate(self, stale_artifact: bool = False) -> None:
         """Drop compiled programs and re-resolve the decoder state — the
         recovery rung a serving dispatch steps after repeated transient
         faults (a worker restart kills the uploaded graph buffers; the
         retry's ``reset_device_state`` cleared the per-H memos, so the
         re-resolve re-uploads and the next ``program()`` recompiles against
-        live buffers)."""
+        live buffers).
+
+        ``stale_artifact`` separates the two invalidation causes: the
+        default (dead DEVICE buffers after a worker restart) keeps the
+        persistent on-disk artifacts — they describe the program, not the
+        buffers, so the recovery path re-LOADS instead of recompiling.
+        ``stale_artifact=True`` (the program itself is suspect — e.g. a
+        config hot-swap changed semantics behind an unchanged key) also
+        evicts the warm keys' disk entries so the next ``program()``
+        recompiles from scratch."""
         with self._lock:
+            if stale_artifact:
+                for (bucket, sharded) in list(self._programs):
+                    parts = self._prog_parts(self.static, self.state,
+                                             self.syndrome_width, bucket,
+                                             sharded)
+                    progcache.evict(
+                        progcache.cache_key("serve.session", parts))
+                telemetry.count("serve.session.artifact_evictions",
+                                len(self._programs))
             self._programs.clear()
             self._resolve_state()
             self.generation += 1
@@ -325,6 +393,45 @@ class DecodeSession:
                             syndrome_width=self.syndrome_width,
                             kernel_variant=self.kernel_variant,
                             osd_backend=self.osd_backend)
+
+    def warm_keys(self) -> list:
+        """The currently-warm program map keys as ``[bucket, sharded]``
+        pairs — the fleet handoff's warm-push manifest (the ring successor
+        pre-loads exactly these from the persistent cache before
+        adopting)."""
+        with self._lock:
+            return sorted([int(b), bool(s)] for (b, s) in self._programs)
+
+    def adopt_program(self, bucket: int, sharded: bool = False) -> bool:
+        """LOAD one program from the persistent cache — never compiles.
+
+        The fleet warm-start path (``router._push_delta`` →
+        ``server._journal_import``) runs on the successor's control plane
+        while it is still serving its own families; a compile there would
+        stall live traffic, so a cache miss is a no-op (False) and the
+        first adopted request pays the compile inline as before."""
+        if sharded is None:
+            sharded = self._route_sharded(bucket)
+        key = (int(bucket), bool(sharded))
+        with self._lock:
+            if key in self._programs:
+                # already resident (e.g. this host pre-warmed the family
+                # itself) — available, but not a cache load
+                telemetry.count("serve.session.warm_already")
+                return True
+            t0 = time.perf_counter()
+            parts = self._prog_parts(self.static, self.state,
+                                     self.syndrome_width, key[0], key[1])
+            prog = progcache.load_cached("serve.session", parts)
+            if prog is None:
+                telemetry.count("serve.session.warm_load_misses")
+                return False
+            self._programs[key] = prog
+            self.loads += 1
+            telemetry.count("serve.session.warm_loads")
+            telemetry.observe("serve.session.load_s",
+                              time.perf_counter() - t0)
+            return True
 
     def heal(self, reason: str = "probe") -> int:
         """Self-healing warm recompile (ISSUE 14): rebuild the decoder
@@ -344,20 +451,25 @@ class DecodeSession:
         with self._lock:
             warm = sorted(self._programs)
         static, state, width, kvariant, osd = self._resolved()
-        programs = {
+        built = {
             key: self._compile_program(static, state, width, key[0], key[1])
             for key in warm}
+        programs = {key: prog for key, (prog, _src) in built.items()}
+        compiled = sum(1 for _p, src in built.values() if src == "compile")
+        loaded = len(built) - compiled
         dt = time.perf_counter() - t0
         with self._lock:
             self.static, self.state = static, state
             self.syndrome_width = width
             self.kernel_variant, self.osd_backend = kvariant, osd
             self._programs = programs
-            self.compiles += len(programs)
+            self.compiles += compiled
+            self.loads += loaded
             self.generation += 1
             self.heals += 1
         telemetry.count("serve.session.heals")
-        telemetry.count("serve.session.compiles", len(programs))
+        telemetry.count("serve.session.compiles", compiled)
+        telemetry.count("serve.session.loads", loaded)
         telemetry.observe("serve.session.heal_s", dt)
         telemetry.event("serve_session", session=self.name, event="heal",
                         reason=str(reason), programs=len(programs),
@@ -395,20 +507,24 @@ class DecodeSession:
         t0 = time.perf_counter()
         with self._lock:
             warm = sorted({b for (b, _s) in self._programs})
-        progs = {
+        built = {
             (b, True): self._compile_program(
                 self.static, self.state, self.syndrome_width, b, True)
             for b in warm
             if b % self._mesh_devices == 0 and
             (b, True) not in self._programs}
+        compiled = sum(1 for _p, src in built.values() if src == "compile")
         with self._lock:
-            self._programs.update(progs)
-            self.compiles += len(progs)
+            self._programs.update(
+                {key: prog for key, (prog, _src) in built.items()})
+            self.compiles += compiled
+            self.loads += len(built) - compiled
             self._sharded = True
         telemetry.count("serve.session.shards")
-        telemetry.count("serve.session.compiles", len(progs))
+        telemetry.count("serve.session.compiles", compiled)
+        telemetry.count("serve.session.loads", len(built) - compiled)
         telemetry.event("serve_session", session=self.name, event="shard",
-                        reason=str(reason), programs=len(progs),
+                        reason=str(reason), programs=len(built),
                         compile_s=round(time.perf_counter() - t0, 4),
                         sharded=True, syndrome_width=self.syndrome_width)
         return True
@@ -568,6 +684,7 @@ class FusedDecodeGroup:
         self._lock = threading.RLock()
         self._programs: dict = {}
         self.compiles = 0
+        self.loads = 0
         self.restacks = 0
         self.generation = 0
         self._axes = None
@@ -691,22 +808,36 @@ class FusedDecodeGroup:
             synd = jax.ShapeDtypeStruct(
                 (key[0], key[1], self.syndrome_width), jnp.uint8)
             cells = jax.ShapeDtypeStruct((key[0],), jnp.int32)
-            prog = jax.jit(self._fused_fn()).lower(
-                self._stacked, cells, synd).compile()
+            # the stacked state is a traced ARGUMENT, so the persistent
+            # key needs only the family (shape identity), lane layout and
+            # the fused dispatch shape — a member heal restacks values
+            # without touching the key
+            parts = {"family": self.family, "n_sessions":
+                     len(self.sessions), "axes": self._axes,
+                     "n_lanes": key[0], "bucket": key[1]}
+            prog, source = progcache.compile_cached(
+                jax.jit(self._fused_fn()), (self._stacked, cells, synd),
+                kind="serve.fused", parts=parts,
+                label=f"{self.family_label()}:l{key[0]}b{key[1]}")
             dt = time.perf_counter() - t0
             self._programs[key] = prog
-            self.compiles += 1
-            telemetry.count("serve.fused.compiles")
-            telemetry.observe("serve.session.compile_s", dt)
-            telemetry.event("serve_session", session=self.name,
-                            event="fused_compile", bucket=key[1],
-                            lanes=key[0], family=self.family_label(),
-                            compile_s=round(dt, 4),
-                            syndrome_width=self.syndrome_width,
-                            kernel_variant=kernel_variant(
-                                self.static, self.sessions[0].state,
-                                key[1]),
-                            osd_backend=self.osd_backend)
+            if source == "compile":
+                self.compiles += 1
+                telemetry.count("serve.fused.compiles")
+                telemetry.observe("serve.session.compile_s", dt)
+                telemetry.event("serve_session", session=self.name,
+                                event="fused_compile", bucket=key[1],
+                                lanes=key[0], family=self.family_label(),
+                                compile_s=round(dt, 4),
+                                syndrome_width=self.syndrome_width,
+                                kernel_variant=kernel_variant(
+                                    self.static, self.sessions[0].state,
+                                    key[1]),
+                                osd_backend=self.osd_backend)
+            else:
+                self.loads += 1
+                telemetry.count("serve.fused.loads")
+                telemetry.observe("serve.session.load_s", dt)
             return prog
 
     def family_label(self) -> str:

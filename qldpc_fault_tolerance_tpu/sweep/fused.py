@@ -29,6 +29,8 @@ per-cell loop, per bucket.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 
 __all__ = ["FusedUnsupported", "eval_cells_fused", "build_data_bucket"]
@@ -184,6 +186,7 @@ def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
     def build(bucket):
         """(bucket, program) or None when the bucket must run serially
         (plugin decoders the fused engines cannot take apart)."""
+        t0 = time.perf_counter()
         try:
             prog = bucket_builder(bucket)
         except ValueError as e:
@@ -193,6 +196,11 @@ def eval_cells_fused(cells, bucket_builder, cell_key_fn, *,
             leftovers.extend(bucket)
             return None
         telemetry.count("sweep.fused_buckets")
+        # build wall clock per bucket: with the persistent program cache
+        # active, reruns show this collapsing toward pure state-stacking
+        # time (the driver's first dispatch loads instead of compiling)
+        telemetry.observe("sweep.fused_build_s",
+                          time.perf_counter() - t0)
         # full cell identity for the diagnostics layer's live publishing
         # (cell_progress events name (code, p, type), not just p tags)
         prog.cell_keys = [cell_key_fn(*it) for it in bucket]

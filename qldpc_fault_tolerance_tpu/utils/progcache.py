@@ -1,0 +1,482 @@
+"""Persistent, content-addressed AOT program cache (ISSUE 20).
+
+Every cold path used to pay ``lower(...).compile()`` from scratch —
+``DecodeSession`` bucket ladders, fused sweep buckets, a fleet handoff
+adopting a dead host's families, every bench warmup.  The programs are
+identical across processes, hosts, and runs; only the first compile is
+work, everything after is a cache problem.  This module is that cache:
+
+  * **Key anatomy** — ``cache_key(kind, parts)`` hashes the process
+    fingerprint (jax/jaxlib versions, backend, device kind + count, an
+    optional ``QLDPC_PROGCACHE_SALT``) together with the caller's content
+    parts (static decoder tuple, bucket shape, donation/sharding spec)
+    through the same canonicalization discipline as
+    ``diagnostics.config_signature`` — floats rounded, keys sorted, so a
+    key is stable across processes but never survives a toolchain bump.
+  * **Store** — one ``<key>.qpc`` pickle per program under the cache
+    root (``QLDPC_PROGCACHE_DIR`` or ``configure()``), written atomically
+    (tmp + rename).  The primary format serializes the loaded executable
+    via ``jax.experimental.serialize_executable`` (deserialization in a
+    fresh process yields a callable ``Compiled``, bit-exact, zero
+    retraces).  Where the backend's PjRt refuses executable serialization
+    the entry falls back to persisting the lowered StableHLO text +
+    compile options — inspectable provenance that re-arms the exec format
+    on the next toolchain that supports it; its load path counts a miss
+    and recompiles.
+  * **Single-flight** — in-memory population rides the shared
+    ``ops.bp._LruCache`` (per-key single-flight, generation-counted
+    clears), so a concurrent cold start compiles/loads each program
+    exactly once per process.
+  * **Corruption tolerance** — a truncated/garbled/foreign artifact is
+    counted (``progcache.load_errors``), deleted, recompiled, and
+    REPLACED; a fingerprint mismatch inside an artifact (a toolchain bump
+    landing on a hash collision, a copied cache dir) is a miss, never a
+    crash.
+
+Disabled by default: without ``QLDPC_PROGCACHE_DIR`` (or an explicit
+``configure(root)``) every call degrades to plain compile — zero behavior
+change for code that never opts in.
+
+Telemetry (mirrored into module-local ``stats()`` so tests and bench
+gates don't depend on the telemetry switch): ``progcache.mem_hits`` /
+``disk_hits`` / ``misses`` / ``stores`` / ``store_errors`` /
+``load_errors`` / ``fingerprint_rejects`` / ``serialize_unsupported``
+counters and ``progcache.load_s`` / ``compile_s`` / ``compile_s_saved``
+histograms (the saved series replays each disk hit's recorded fresh
+compile time — the headline "compile seconds not paid").
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+
+__all__ = [
+    "ARTIFACT_SUFFIX",
+    "active",
+    "cache_dir",
+    "cache_key",
+    "clear_memory",
+    "compile_cached",
+    "configure",
+    "evict",
+    "exec_roundtrip_supported",
+    "fingerprint",
+    "has_artifact",
+    "load_cached",
+    "memory_generation",
+    "reset",
+    "stats",
+]
+
+ARTIFACT_SUFFIX = ".qpc"
+_SCHEMA = 1
+_MEM_SIZE = 256
+
+_lock = threading.RLock()
+_root: str | None = None          # resolved cache root (None = disabled)
+_configured = False               # configure() called (overrides env)
+_mem = None                       # shared single-flight _LruCache
+_mem_gen = 0                      # bumped by clear_memory()
+_fingerprint_cache: dict | None = None
+# whether this backend round-trips serialized executables.  None =
+# unknown (probed on first store); False = serialize OR deserialize
+# failed once (e.g. XLA:CPU's thunk runtime emits payloads whose JIT
+# symbols don't survive deserialization) — later stores skip straight to
+# the stablehlo fallback instead of re-paying a doomed serialize+verify.
+_exec_supported: bool | None = None
+
+_STATS_KEYS = ("mem_hits", "disk_hits", "misses", "stores", "store_errors",
+               "load_errors", "fingerprint_rejects", "serialize_unsupported")
+_stats = {k: 0 for k in _STATS_KEYS}
+
+
+def _count(name: str, n: int = 1) -> None:
+    from . import telemetry
+
+    with _lock:
+        _stats[name] = _stats.get(name, 0) + n
+    telemetry.count(f"progcache.{name}", n)
+
+
+def stats() -> dict:
+    """Counter snapshot (independent of the telemetry switch)."""
+    with _lock:
+        return dict(_stats)
+
+
+def exec_roundtrip_supported() -> bool | None:
+    """Whether this backend round-trips serialized executables: True /
+    False once a store probed it, None before any store.  Benches report
+    it so a CPU container's stablehlo-fallback numbers aren't mistaken
+    for the accelerator story."""
+    return _exec_supported
+
+
+def hit_rate() -> float:
+    """hits / (hits + misses) over this process's lifetime (0.0 when the
+    cache never fielded a request)."""
+    s = stats()
+    hits = s["mem_hits"] + s["disk_hits"]
+    total = hits + s["misses"]
+    return hits / total if total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# configuration
+# ---------------------------------------------------------------------------
+def configure(root: str | None) -> None:
+    """Point the cache at ``root`` (created on demand); ``None`` disables.
+    Overrides ``QLDPC_PROGCACHE_DIR`` until ``reset()``."""
+    global _root, _configured
+    with _lock:
+        _root = os.path.abspath(root) if root else None
+        _configured = True
+    clear_memory()
+
+
+def reset(purge_stats: bool = False) -> None:
+    """Back to env-driven configuration (tests)."""
+    global _root, _configured, _fingerprint_cache, _exec_supported
+    with _lock:
+        _root = None
+        _configured = False
+        _fingerprint_cache = None
+        _exec_supported = None
+        if purge_stats:
+            for k in _STATS_KEYS:
+                _stats[k] = 0
+    clear_memory()
+
+
+def cache_dir() -> str | None:
+    """The active on-disk root, or None when the cache is disabled."""
+    with _lock:
+        if _configured:
+            return _root
+    env = os.environ.get("QLDPC_PROGCACHE_DIR")
+    return os.path.abspath(env) if env else None
+
+
+def active() -> bool:
+    return cache_dir() is not None
+
+
+def _memcache():
+    """The shared single-flight memo (ops.bp._LruCache), built lazily so
+    importing this module never imports jax."""
+    global _mem
+    with _lock:
+        if _mem is None:
+            from ..ops.bp import _LruCache
+
+            _mem = _LruCache(maxsize=_MEM_SIZE)
+        return _mem
+
+
+def clear_memory() -> None:
+    """Drop every in-process program (worker restart: their device
+    handles may be dead — the DISK artifacts stay valid, the next request
+    re-loads).  Bumps the generation so long-lived holders (megabatch
+    drivers) know to re-resolve."""
+    global _mem_gen
+    with _lock:
+        _mem_gen += 1
+        mem = _mem
+    if mem is not None:
+        mem.clear()
+
+
+def memory_generation() -> int:
+    with _lock:
+        return _mem_gen
+
+
+# ---------------------------------------------------------------------------
+# key anatomy
+# ---------------------------------------------------------------------------
+def fingerprint(refresh: bool = False) -> dict:
+    """The toolchain/topology half of every key: jax + jaxlib versions,
+    backend, device kind and count (from ``telemetry.process_info``,
+    which never imports jax itself), plus ``QLDPC_PROGCACHE_SALT`` (the
+    manual bust for dirty-tree development, where the git SHA can't see
+    an edit).  An artifact whose recorded fingerprint differs from the
+    loader's is a MISS — a jaxlib bump invalidates the whole cache by
+    construction."""
+    global _fingerprint_cache
+    with _lock:
+        if _fingerprint_cache is not None and not refresh:
+            return dict(_fingerprint_cache)
+    from . import telemetry
+
+    info = telemetry.process_info(refresh=refresh)
+    fp = {
+        "schema": _SCHEMA,
+        "jax": info.get("jax"),
+        "jaxlib": info.get("jaxlib"),
+        "backend": info.get("backend"),
+        "salt": os.environ.get("QLDPC_PROGCACHE_SALT", ""),
+    }
+    try:  # device kind + count: the topology half of the fingerprint
+        import jax
+
+        devs = jax.devices()
+        fp["device_kind"] = devs[0].device_kind if devs else None
+        fp["device_count"] = len(devs)
+        if fp["backend"] is None:
+            fp["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend yet: versions still pin
+        fp["device_kind"] = None
+        fp["device_count"] = None
+    with _lock:
+        _fingerprint_cache = dict(fp)
+    return fp
+
+
+def cache_key(kind: str, parts: dict) -> str:
+    """Content address for one program: sha over the canonicalized
+    ``{fingerprint, kind, parts}`` document, reusing the
+    ``config_signature`` canonicalization (floats rounded, keys sorted)
+    so equal content hashes equal across processes.  ``parts`` values may
+    be any repr-stable objects (static tuples, shape tuples, spec
+    strings) — they are stringified before hashing."""
+    from .diagnostics import config_signature
+
+    doc = {"fingerprint": fingerprint(), "kind": str(kind),
+           "parts": {str(k): repr(v) for k, v in dict(parts).items()}}
+    return config_signature(doc)
+
+
+def _artifact_path(key: str) -> str | None:
+    root = cache_dir()
+    if root is None:
+        return None
+    return os.path.join(root, key[:2], key + ARTIFACT_SUFFIX)
+
+
+def has_artifact(key: str) -> bool:
+    """Whether ``key`` is resident in THIS process or on disk (no load).
+    The fleet warm-push uses this to load-only-what-exists instead of
+    compiling on the control plane."""
+    mem = _memcache()
+    try:
+        mem.peek(key)
+        return True
+    except KeyError:
+        pass
+    path = _artifact_path(key)
+    return path is not None and os.path.exists(path)
+
+
+def evict(key: str) -> bool:
+    """Drop one entry from memory AND disk (a session invalidating a
+    STALE artifact — config changed under the same key material — as
+    opposed to dead device buffers, which only need ``clear_memory``)."""
+    _memcache().pop(key)
+    path = _artifact_path(key)
+    removed = False
+    if path is not None:
+        try:
+            os.remove(path)
+            removed = True
+        except OSError:
+            pass
+    return removed
+
+
+# ---------------------------------------------------------------------------
+# disk formats
+# ---------------------------------------------------------------------------
+def _store(key: str, compiled, lowered, compile_s: float,
+           label: str) -> None:
+    """Persist one freshly-compiled program.  Primary format: the
+    serialized loaded executable, VERIFIED at store time — the payload is
+    deserialized right back before it is trusted, because some backends
+    (XLA:CPU's thunk runtime) serialize without error yet refuse the
+    round trip, and a store-time probe turns that into a clean fallback
+    instead of a load error in every later process.  Fallback: the
+    lowered StableHLO text + compile options — provenance that documents
+    the program without a loadable payload."""
+    global _exec_supported
+    path = _artifact_path(key)
+    if path is None:
+        return
+    meta = {"fingerprint": fingerprint(), "label": str(label),
+            "compile_s": float(compile_s), "created": time.time()}
+    doc = None
+    if _exec_supported is not False:
+        try:
+            from jax.experimental import serialize_executable
+
+            payload, in_tree, out_tree = serialize_executable.serialize(
+                compiled)
+            # verify the round trip before trusting the payload
+            serialize_executable.deserialize_and_load(
+                payload, in_tree, out_tree)
+            _exec_supported = True
+            doc = {"schema": _SCHEMA, "format": "exec", "key": key,
+                   "meta": meta, "payload": payload, "in_tree": in_tree,
+                   "out_tree": out_tree}
+        except Exception:  # noqa: BLE001 — unsupported backend/executable
+            _exec_supported = False
+            _count("serialize_unsupported")
+    if doc is None:
+        try:
+            hlo = lowered.as_text() if lowered is not None else ""
+            opts = repr(getattr(lowered, "compile_args", None))
+        except Exception:  # noqa: BLE001
+            hlo, opts = "", ""
+        doc = {"schema": _SCHEMA, "format": "stablehlo", "key": key,
+               "meta": meta, "payload": hlo.encode("utf-8"),
+               "compile_options": opts}
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "wb") as fh:
+            pickle.dump(doc, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        os.replace(tmp, path)  # atomic: readers never see a torn entry
+        _count("stores")
+    except Exception:  # noqa: BLE001 — a full disk must not fail decodes
+        _count("store_errors")
+
+
+def _load(key: str):
+    """One disk probe: the loaded executable, or None (miss).  Any
+    defect — truncated pickle, wrong schema, foreign key, fingerprint
+    drift, a payload the runtime refuses — deletes the entry so the
+    caller's recompile REPLACES it."""
+    path = _artifact_path(key)
+    if path is None or not os.path.exists(path):
+        return None
+    t0 = time.perf_counter()
+    try:
+        with open(path, "rb") as fh:
+            doc = pickle.load(fh)
+        if not isinstance(doc, dict) or doc.get("schema") != _SCHEMA \
+                or doc.get("key") != key:
+            raise ValueError("artifact header mismatch")
+    except Exception:  # noqa: BLE001 — corrupt entry: replace, never crash
+        _count("load_errors")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    meta = doc.get("meta") or {}
+    if meta.get("fingerprint") != fingerprint():
+        # a toolchain bump whose key happened to collide, or a cache dir
+        # copied across machines: never deserialize a foreign executable
+        _count("fingerprint_rejects")
+        return None
+    if doc.get("format") != "exec":
+        return None  # stablehlo fallback entries document, never load
+    try:
+        from jax.experimental import serialize_executable
+
+        compiled = serialize_executable.deserialize_and_load(
+            doc["payload"], doc["in_tree"], doc["out_tree"])
+    except Exception:  # noqa: BLE001 — stale/undeserializable payload
+        _count("load_errors")
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+    from . import telemetry
+
+    load_s = time.perf_counter() - t0
+    telemetry.observe("progcache.load_s", load_s)
+    saved = meta.get("compile_s")
+    if isinstance(saved, (int, float)) and saved > 0:
+        telemetry.observe("progcache.compile_s_saved", float(saved))
+    return compiled
+
+
+# ---------------------------------------------------------------------------
+# the one blessed compile site
+# ---------------------------------------------------------------------------
+def compile_cached(jitted, args=(), kwargs=None, *, kind: str,
+                   parts: dict, label: str = ""):
+    """The cache-or-compile front door — the ONE place in the library
+    allowed to call ``.lower(...).compile()`` (qldpc-lint R009 pins every
+    other call site).  Returns ``(compiled, source)`` with source one of
+    ``"mem"`` / ``"disk"`` / ``"compile"``.
+
+    With the cache inactive this is exactly the old inline compile.  With
+    it active, population is single-flight per key: concurrent cold
+    starts for one program block on one loader/compiler; different keys
+    overlap."""
+    kwargs = kwargs or {}
+
+    def fresh():
+        t0 = time.perf_counter()
+        lowered = jitted.lower(*args, **kwargs)
+        compiled = lowered.compile()
+        dt = time.perf_counter() - t0
+        from . import telemetry
+
+        telemetry.observe("progcache.compile_s", dt)
+        return lowered, compiled, dt
+
+    if not active():
+        _lowered, compiled, _dt = fresh()
+        return compiled, "compile"
+
+    key = cache_key(kind, parts)
+    source = []  # whether THIS call populated (single-flight losers hit)
+
+    def make():
+        compiled = _load(key)
+        if compiled is not None:
+            _count("disk_hits")
+            source.append("disk")
+            return compiled
+        _count("misses")
+        lowered, compiled, dt = fresh()
+        _store(key, compiled, lowered, dt, label)
+        source.append("compile")
+        return compiled
+
+    compiled = _memcache().get(key, make)
+    if not source:
+        _count("mem_hits")
+        return compiled, "mem"
+    return compiled, source[0]
+
+
+def load_cached(kind: str, parts: dict):
+    """Load-only probe: the program for ``(kind, parts)`` from memory or
+    disk, or None — NEVER compiles.  The fleet warm-push runs on the
+    serving event loop, where a compile stall is exactly the failure this
+    cache removes."""
+    if not active():
+        return None
+    key = cache_key(kind, parts)
+    mem = _memcache()
+    try:
+        prog = mem.peek(key)
+        _count("mem_hits")
+        return prog
+    except KeyError:
+        pass
+    path = _artifact_path(key)
+    if path is None or not os.path.exists(path):
+        return None
+    hit = []
+
+    def make():
+        prog = _load(key)
+        if prog is None:
+            raise KeyError(key)  # corrupt/foreign: leave the memo empty
+        _count("disk_hits")
+        hit.append(True)
+        return prog
+
+    try:
+        prog = mem.get(key, make)
+    except KeyError:
+        return None
+    if not hit:
+        _count("mem_hits")
+    return prog

@@ -748,6 +748,13 @@ EVENT_SCHEMAS: dict[str, dict] = {
                      "value": _OPT_NUM, "threshold": _OPT_NUM,
                      "active_s": _NUM, "host": str},
     },
+    # one-shot surfacing of calibration gates the table ships without
+    # probe evidence (gates_measured=false) — emitted at first decoder
+    # construction (utils.profiling.note_unmeasured_gates, ISSUE 20)
+    "unmeasured_gates": {
+        "required": {"gates": list},
+        "optional": {"backend": _OPT_STR, "table_generated_at": _OPT_STR},
+    },
     # environment provenance, once per telemetry enable (and embedded in
     # every RunLedger record): lets sweep_dashboard --drift and
     # bench_compare attribute cross-round drift to environment changes

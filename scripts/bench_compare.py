@@ -129,6 +129,14 @@ GATED_FIELDS = (
     # keys, so the checked-in history gates unchanged.
     "fleet.req_per_s",
     "fleet.handoff_p99_ms",
+    # persistent AOT program cache (bench.py coldstart, ISSUE 20): the
+    # warm time-to-first-decode and warm handoff tail gate on INCREASES
+    # (a cache regression shows up as the warm path re-compiling); the
+    # cache hit rate gates as a rate.  Rounds before r20 lack the keys,
+    # so the checked-in history gates unchanged.
+    "coldstart.ttfd_s",
+    "coldstart.progcache_hit_rate",
+    "coldstart.handoff_warm_p99_ms",
 )
 
 # gated fields where a RISE is the regression (latencies, host round-trips)
@@ -137,7 +145,9 @@ LOWER_IS_BETTER_FIELDS = frozenset({"p99_ms", "tracing_ab.traced_p99_ms",
                                     "bposd.cs_host_round_trips",
                                     "wire_ab.packed_bytes_per_req",
                                     "stream.p99_commit_ms",
-                                    "fleet.handoff_p99_ms"})
+                                    "fleet.handoff_p99_ms",
+                                    "coldstart.ttfd_s",
+                                    "coldstart.handoff_warm_p99_ms"})
 
 
 def _dig(d: dict, dotted: str):

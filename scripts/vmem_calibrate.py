@@ -22,6 +22,14 @@ and the OSD-CS combination sweep (ops/osd_cs_device) — the harness:
 Usage:
     python scripts/vmem_calibrate.py [--out calibration/vmem_table.json]
                                      [--codes hgp_34_n625 ...] [--quick]
+                                     [--incremental]
+
+``--incremental`` reads the existing table at ``--out`` and re-probes only
+the (kernel, code) pairs whose fingerprint — jaxlib version, backend,
+probe batch and hx shape — changed since that table was generated;
+unchanged entries are carried over verbatim.  Upgrading jaxlib, switching
+backend, or editing a code's check matrix each invalidate exactly the
+entries they affect.
 """
 from __future__ import annotations
 
@@ -69,6 +77,26 @@ def _code_shapes(names):
             continue
         print(f"warning: unknown code {name!r}, skipped", file=sys.stderr)
     return out
+
+
+def entry_fingerprint(kernel: str, code: str, hx, backend: str,
+                      batch: int) -> str:
+    """Identity of one calibration probe: anything that can change its
+    outcome.  jaxlib carries the mosaic compiler version; the hx shape
+    stands in for the code's check matrix (codes_lib_tpu codes are
+    immutable per name+shape)."""
+    import jaxlib.version
+
+    from qldpc_fault_tolerance_tpu.utils.diagnostics import config_signature
+
+    return config_signature({
+        "kernel": kernel,
+        "code": code,
+        "jaxlib": jaxlib.version.__version__,
+        "backend": backend,
+        "probe_batch": batch,
+        "hx_shape": list(getattr(hx, "shape", ())),
+    })
 
 
 def _bp_head_probe(hx, on_tpu: bool, batch: int):
@@ -379,19 +407,58 @@ def _osd_cs_probe(name, hx, on_tpu: bool, batch: int):
     return entry
 
 
-def build_table(code_names, quick: bool = False) -> dict:
+def build_table(code_names, quick: bool = False, prev: dict | None = None,
+                ) -> dict:
+    import jax
+
     on_tpu = _on_tpu()
+    backend = jax.default_backend()
     batch = 1024 if quick else 4096
+    prev_entries = {}
+    if prev and prev.get("schema") == TABLE_SCHEMA:
+        prev_entries = {(e.get("kernel"), e.get("code")): e
+                        for e in prev.get("entries", [])
+                        if e.get("fingerprint")}
     entries = []
+    reused = probed = 0
+    # each probe group re-runs as a unit; _gf2_probe emits two kernels
+    groups = (
+        (("bp_head",),
+         lambda name, hx, hz, lx, lz: [_bp_head_probe(hx, on_tpu, batch)]),
+        (("bp_head_v2",),
+         lambda name, hx, hz, lx, lz: [_bp_head_v2_probe(hx, on_tpu,
+                                                         batch)]),
+        (("fused_decode",),
+         lambda name, hx, hz, lx, lz: [_fused_decode_probe(
+             name, hx, hz, lx, lz, on_tpu, batch)]),
+        (("osd_cs_sweep",),
+         lambda name, hx, hz, lx, lz: [_osd_cs_probe(name, hx, on_tpu,
+                                                     batch)]),
+        (("gf2_sample_synd", "gf2_residual"),
+         lambda name, hx, hz, lx, lz: _gf2_probe(name, hx, hz, lx, lz,
+                                                 on_tpu, batch)),
+    )
     for name, hx, hz, lx, lz in _code_shapes(code_names):
-        print(f"probing {name} (hx {hx.shape})...", file=sys.stderr)
-        for e in (_bp_head_probe(hx, on_tpu, batch),
-                  _bp_head_v2_probe(hx, on_tpu, batch),
-                  _fused_decode_probe(name, hx, hz, lx, lz, on_tpu, batch),
-                  _osd_cs_probe(name, hx, on_tpu, batch),
-                  *_gf2_probe(name, hx, hz, lx, lz, on_tpu, batch)):
-            e["code"] = name
-            entries.append(e)
+        for kernels, probe in groups:
+            fps = {k: entry_fingerprint(k, name, hx, backend, batch)
+                   for k in kernels}
+            carried = [prev_entries[(k, name)] for k in kernels
+                       if (k, name) in prev_entries
+                       and prev_entries[(k, name)]["fingerprint"] == fps[k]]
+            if len(carried) == len(kernels):
+                entries.extend(dict(e) for e in carried)
+                reused += len(carried)
+                continue
+            print(f"probing {name} (hx {hx.shape}): "
+                  f"{'/'.join(kernels)}...", file=sys.stderr)
+            for e in probe(name, hx, hz, lx, lz):
+                e["code"] = name
+                e["fingerprint"] = fps[e["kernel"]]
+                entries.append(e)
+                probed += 1
+    if prev_entries:
+        print(f"incremental: {reused} entries reused, {probed} re-probed",
+              file=sys.stderr)
     # kernel-wide measured/analytic ratios: only TPU probes are evidence;
     # the 1.8x bp_head prior comes from the round-4 n1225 measurement
     # (README "Known frontiers") and stands until a TPU run replaces it
@@ -405,7 +472,6 @@ def build_table(code_names, quick: bool = False) -> dict:
             ratios[kernel] = round(max(rs), 3)
     if "bp_head" not in ratios:
         ratios["bp_head_prior"] = 1.8
-    import jax
 
     from qldpc_fault_tolerance_tpu.ops import bp_pallas
 
@@ -448,9 +514,22 @@ def main(argv=None) -> int:
         "hgp_34_n1225", "hgp_34_n1600"])
     ap.add_argument("--quick", action="store_true",
                     help="smaller probe batch (faster, coarser)")
+    ap.add_argument("--incremental", action="store_true",
+                    help="reuse entries from the existing --out table "
+                         "whose fingerprint (jaxlib/backend/batch/shape) "
+                         "is unchanged; re-probe only the rest")
     args = ap.parse_args(argv)
 
-    table = build_table(args.codes, quick=args.quick)
+    prev = None
+    if args.incremental and os.path.exists(args.out):
+        try:
+            with open(args.out, encoding="utf-8") as fh:
+                prev = json.load(fh)
+        except (OSError, ValueError) as e:
+            print(f"warning: could not read previous table ({e}); "
+                  f"full re-probe", file=sys.stderr)
+
+    table = build_table(args.codes, quick=args.quick, prev=prev)
     os.makedirs(os.path.dirname(os.path.abspath(args.out)), exist_ok=True)
     with open(args.out, "w", encoding="utf-8") as fh:
         json.dump(table, fh, indent=1, sort_keys=False)

@@ -23,6 +23,7 @@ from qldpc_fault_tolerance_tpu.analysis import (  # noqa: E402
     Baseline,
     BarePrintRule,
     BareSleepRule,
+    CompileSiteRule,
     DonationRule,
     FaultSiteRule,
     HostSyncRule,
@@ -696,6 +697,72 @@ def test_r008_quiet_on_registered_unique_and_dynamic_sites():
 
 
 # ---------------------------------------------------------------------------
+# R009 program-cache compile-site discipline (ISSUE 20)
+# ---------------------------------------------------------------------------
+def test_r009_fires_on_chained_lower_compile():
+    found = findings_of(CompileSiteRule(), """
+        import jax
+
+        def f(fn, x):
+            prog = jax.jit(fn).lower(x).compile()
+            return prog(x)
+    """)
+    assert len(found) == 1
+    assert "progcache.compile_cached" in found[0].message
+
+
+def test_r009_fires_on_lower_then_compile_via_name():
+    found = findings_of(CompileSiteRule(), """
+        import jax
+
+        def f(fn, x):
+            lowered = jax.jit(fn).lower(x)
+            return lowered.compile()
+    """)
+    # the bare lower fires once, the .compile() on its name fires once
+    assert len(found) == 2
+
+
+def test_r009_fires_on_bare_lower_with_args():
+    found = findings_of(CompileSiteRule(), """
+        def f(jitted, x):
+            return jitted.lower(x).as_text()
+    """)
+    assert len(found) == 1
+    assert ".lower(" in found[0].message
+
+
+def test_r009_quiet_on_str_lower_and_exempt_modules():
+    # argless .lower() is string casing, never an AOT lowering
+    assert findings_of(CompileSiteRule(), """
+        def f(name):
+            return name.lower().strip()
+    """) == []
+    # the blessed compile site and the probe harnesses are exempt
+    for rel in ("qldpc_fault_tolerance_tpu/utils/progcache.py",
+                "qldpc_fault_tolerance_tpu/utils/profiling.py",
+                "scripts/vmem_calibrate.py"):
+        res = run_src(CompileSiteRule(), """
+            import jax
+
+            def f(fn, x):
+                return jax.jit(fn).lower(x).compile()
+        """, rel=rel)
+        assert [f for f in res.findings if f.rule == "R009"] == []
+
+
+def test_r009_suppressible_inline():
+    res = run_src(CompileSiteRule(), """
+        import jax
+
+        def probe(fn, x):
+            jax.jit(fn).lower(  # qldpc: ignore[R009]
+                x).compile()
+    """)
+    assert [f for f in res.findings if f.rule == "R009"] == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline
 # ---------------------------------------------------------------------------
 def test_baseline_roundtrip(tmp_path):
@@ -750,7 +817,8 @@ def test_full_package_has_no_unbaselined_findings():
         + ", ".join(f"{e.file} [{e.rule}]" for e in res.stale_baseline)
     assert res.files > 100  # the walk really covered the codebase
     assert set(res.rules) == {"R001", "R002", "R003", "R004", "R005",
-                              "R006", "R007", "R008", "R101", "R102"}
+                              "R006", "R007", "R008", "R009", "R101",
+                              "R102"}
 
 
 def test_nonexistent_lint_target_is_an_error():

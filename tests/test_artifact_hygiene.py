@@ -23,6 +23,10 @@ def test_gitignore_covers_bytecode_everywhere():
     assert "__pycache__/" in patterns
     assert "*.pyc" in patterns
     assert "*.so" in patterns
+    # program-cache artifacts (ISSUE 20): serialized executables are
+    # machine/toolchain-local — never commit a cache dir
+    assert ".qldpc_progcache/" in patterns
+    assert "*.qpc" in patterns
 
 
 def _tracked_files():
@@ -42,8 +46,9 @@ def test_no_tracked_bytecode_or_native_artifacts():
     native_prefix = "qldpc_fault_tolerance_tpu/_native/"
     bad = [
         p for p in _tracked_files()
-        if (p.endswith((".pyc", ".pyo"))
+        if (p.endswith((".pyc", ".pyo", ".qpc"))
             or "__pycache__" in p.split("/")
+            or ".qldpc_progcache" in p.split("/")
             or (p.endswith(".so") and not p.startswith(native_prefix)))
     ]
     assert not bad, f"build artifacts tracked by git: {bad}"

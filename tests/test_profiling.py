@@ -464,6 +464,96 @@ def test_checked_in_calibration_table_is_consistent():
     assert {1225, 1600} <= probed_n
 
 
+def test_note_unmeasured_gates_one_shot(tmp_path, monkeypatch):
+    """ISSUE 20 satellite: a table shipping gates without probe evidence
+    (gates_measured=false) surfaces ONCE at decoder construction — a
+    counter sized by the gate count, a schema-valid ``unmeasured_gates``
+    event — and re-arms only with the table cache."""
+    path = tmp_path / "t.json"
+    path.write_text(json.dumps({
+        "schema": 1, "backend": "cpu", "generated_at": "2026-01-01",
+        "entries": [], "ratios": {},
+        "gates": {"a_limit": 1, "b_limit": 2}, "gates_measured": False}))
+    monkeypatch.setenv("QLDPC_VMEM_TABLE", str(path))
+    profiling.reset_vmem_table_cache()
+    telemetry.enable()
+    sink = telemetry.MemorySink()
+    telemetry.add_sink(sink)
+    try:
+        assert profiling.note_unmeasured_gates() is True
+        assert profiling.note_unmeasured_gates() is False  # one-shot
+        snap = telemetry.snapshot()
+        assert snap["calibration.unmeasured_gates"]["value"] == 2
+        [ev] = [r for r in sink.records
+                if r["kind"] == "unmeasured_gates"]
+        assert telemetry.validate_event(ev) == []
+        assert ev["gates"] == ["a_limit", "b_limit"]
+    finally:
+        telemetry.remove_sink(sink)
+    # a measured table never notes
+    path.write_text(json.dumps({
+        "schema": 1, "entries": [], "gates": {"a_limit": 1},
+        "gates_measured": True}))
+    profiling.reset_vmem_table_cache()  # also re-arms the one-shot
+    assert profiling.note_unmeasured_gates() is False
+
+
+def test_vmem_calibrate_incremental_reuses_unchanged_entries(monkeypatch):
+    """ISSUE 20 satellite: ``--incremental`` re-probes only (kernel, code)
+    pairs whose fingerprint (jaxlib/backend/batch/shape) changed; carried
+    entries are byte-identical."""
+    import vmem_calibrate
+
+    calls = []
+
+    def fake(kernel):
+        def probe(*a, **k):
+            calls.append(kernel)
+            return {"kernel": kernel, "measured": False, "attempts": []}
+        return probe
+
+    monkeypatch.setattr(vmem_calibrate, "_bp_head_probe",
+                        lambda hx, t, b: fake("bp_head")())
+    monkeypatch.setattr(vmem_calibrate, "_bp_head_v2_probe",
+                        lambda hx, t, b: fake("bp_head_v2")())
+    monkeypatch.setattr(
+        vmem_calibrate, "_fused_decode_probe",
+        lambda n, hx, hz, lx, lz, t, b: fake("fused_decode")())
+    monkeypatch.setattr(vmem_calibrate, "_osd_cs_probe",
+                        lambda n, hx, t, b: fake("osd_cs_sweep")())
+    monkeypatch.setattr(
+        vmem_calibrate, "_gf2_probe",
+        lambda n, hx, hz, lx, lz, t, b: [fake("gf2_sample_synd")(),
+                                         fake("gf2_residual")()])
+
+    t1 = vmem_calibrate.build_table(["hgp_rep3"], quick=True)
+    assert len(t1["entries"]) == 6
+    assert len(calls) == 6
+    assert all(e.get("fingerprint") for e in t1["entries"])
+
+    # unchanged fingerprints: everything carries over, nothing re-probes
+    calls.clear()
+    t2 = vmem_calibrate.build_table(["hgp_rep3"], quick=True, prev=t1)
+    assert calls == []
+    assert t2["entries"] == t1["entries"]
+
+    # the probe batch is part of the fingerprint: full re-probe
+    calls.clear()
+    t3 = vmem_calibrate.build_table(["hgp_rep3"], quick=False, prev=t1)
+    assert len(calls) == 6
+    assert all(e["fingerprint"] != o["fingerprint"]
+               for e, o in zip(t3["entries"], t1["entries"]))
+
+    # a legacy table without fingerprints is never trusted for reuse
+    legacy = dict(t1)
+    legacy["entries"] = [
+        {k: v for k, v in e.items() if k != "fingerprint"}
+        for e in t1["entries"]]
+    calls.clear()
+    vmem_calibrate.build_table(["hgp_rep3"], quick=True, prev=legacy)
+    assert len(calls) == 6
+
+
 # ---------------------------------------------------------------------------
 # bench_compare regression gate
 # ---------------------------------------------------------------------------

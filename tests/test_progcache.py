@@ -1,0 +1,308 @@
+"""Persistent AOT program cache (ISSUE 20 tentpole): key anatomy and
+fingerprinting, cache-or-compile round trips that stay bit-exact per
+decoder substrate, corruption tolerance (garbled artifact -> recompile
+and REPLACE; tampered fingerprint -> miss, never a crash), single-flight
+population under a concurrent cold start, session-ladder warm restarts
+resolving from the cache with zero compiles, stale-artifact
+invalidation, and the fleet warm-start push end to end under a seeded
+``host_kill``."""
+import os
+import pickle
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from qldpc_fault_tolerance_tpu.codes import hgp, rep_code
+from qldpc_fault_tolerance_tpu.decoders import (
+    BP_Decoder_Class,
+    BPOSD_Decoder_Class,
+)
+from qldpc_fault_tolerance_tpu.serve import (
+    DecodeClient,
+    DecodeSession,
+    LocalFleet,
+)
+from qldpc_fault_tolerance_tpu.utils import (
+    faultinject,
+    progcache,
+    resilience,
+    telemetry,
+)
+
+pytestmark = pytest.mark.faults
+
+CODE3 = hgp(rep_code(3), rep_code(3), name="hgp_rep3")
+P = 0.05
+BP_CLS = BP_Decoder_Class(4, "minimum_sum", 0.625)
+BPOSD_CLS = BPOSD_Decoder_Class(8, "minimum_sum", 0.625, "osd_e", 6)
+
+FAST_POLICY = resilience.RetryPolicy(
+    max_attempts=2, base_delay=0.01, backoff=1.0, jitter=0.0,
+    reset_caches=False, degrade_after=1)
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path):
+    telemetry.disable()
+    telemetry.reset()
+    faultinject.deactivate()
+    prev_policy = resilience.current_policy()
+    progcache.reset(purge_stats=True)
+    yield
+    resilience.set_default_policy(prev_policy)
+    faultinject.deactivate()
+    progcache.reset(purge_stats=True)
+    telemetry.disable()
+    telemetry.reset()
+
+
+def _params(code=CODE3):
+    return {"h": code.hx, "p_data": P}
+
+
+def _session(cls=BP_CLS, buckets=(8, 32), name="hgp_rep3"):
+    return DecodeSession(name, decoder_class=cls, params=_params(),
+                         buckets=buckets)
+
+
+def _synd(k, rng, code=CODE3):
+    err = (rng.random((k, code.N)) < P).astype(np.uint8)
+    return (err @ np.asarray(code.hx, np.uint8).T % 2).astype(np.uint8)
+
+
+def _counter(name):
+    return telemetry.snapshot().get(name, {}).get("value", 0)
+
+
+# ---------------------------------------------------------------------------
+# key anatomy / activation
+# ---------------------------------------------------------------------------
+def test_inactive_by_default_compiles_inline():
+    assert not progcache.active()
+    compiled, source = progcache.compile_cached(
+        jax.jit(lambda x: x + 1), (jnp.zeros(4),), kind="t", parts={})
+    assert source == "compile"
+    assert np.array_equal(np.asarray(compiled(jnp.zeros(4))), np.ones(4))
+    assert progcache.stats()["misses"] == 0  # inactive: not even counted
+
+
+def test_cache_key_stable_and_salted(tmp_path, monkeypatch):
+    parts = {"static": ("a", 1, 2.0), "bucket": 32}
+    k1 = progcache.cache_key("serve.session", parts)
+    k2 = progcache.cache_key("serve.session", dict(parts))
+    assert k1 == k2
+    assert progcache.cache_key("sweep.fused", parts) != k1
+    assert progcache.cache_key("serve.session",
+                               {**parts, "bucket": 64}) != k1
+    monkeypatch.setenv("QLDPC_PROGCACHE_SALT", "bump")
+    assert progcache.fingerprint(refresh=True)["salt"] == "bump"
+    assert progcache.cache_key("serve.session", parts) != k1
+
+
+# ---------------------------------------------------------------------------
+# cache-or-compile round trip, bit-exact per substrate
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("cls", [BP_CLS, BPOSD_CLS],
+                         ids=["bp", "bposd_dev"])
+def test_warm_restart_is_loads_only_and_bitexact(cls, tmp_path):
+    """The tentpole acceptance at unit scale: cold ladder compiles and
+    stores, a simulated restart (cleared jit caches, NEW session) resolves
+    every rung from the cache — zero compiles — and the served
+    corrections are bit-exact vs the fresh-compile arm."""
+    progcache.configure(str(tmp_path))
+    rng = np.random.default_rng(0)
+    synd = _synd(8, rng)
+
+    cold = _session(cls)
+    cold.warm()
+    out_cold = cold.decode(synd)
+    assert cold.compiles == len(cold.buckets)
+    assert progcache.stats()["misses"] == len(cold.buckets)
+    assert progcache.stats()["stores"] == len(cold.buckets)
+
+    jax.clear_caches()  # restart: every jit/trace cache gone
+    warm = _session(cls)
+    warm.warm()
+    out_warm = warm.decode(synd)
+    assert warm.compiles == 0
+    assert warm.loads == len(warm.buckets)
+    assert np.array_equal(out_warm.corrections, out_cold.corrections)
+    assert progcache.hit_rate() >= 0.5
+
+
+def test_disk_artifacts_written_and_format_honest(tmp_path):
+    """Every store lands one ``.qpc`` artifact; the format matches what
+    the backend supports (exec only where serialized executables verify a
+    same-process round trip at store time)."""
+    progcache.configure(str(tmp_path))
+    sess = _session()
+    sess.warm()
+    arts = list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX))
+    assert len(arts) == len(sess.buckets)
+    with open(arts[0], "rb") as fh:
+        doc = pickle.load(fh)
+    assert doc["schema"] == 1
+    assert doc["meta"]["fingerprint"] == progcache.fingerprint()
+    supported = progcache.exec_roundtrip_supported()
+    assert supported in (True, False)  # stores happened: probed
+    assert doc["format"] == ("exec" if supported else "stablehlo")
+
+
+# ---------------------------------------------------------------------------
+# corruption tolerance
+# ---------------------------------------------------------------------------
+def test_corrupt_artifact_recompiles_and_replaces(tmp_path):
+    progcache.configure(str(tmp_path))
+    sess = _session(buckets=(8,))
+    sess.warm()
+    [art] = list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX))
+    art.write_bytes(b"\x80garbage, not a pickle")
+    stats0 = progcache.stats()
+
+    progcache.clear_memory()  # force the next resolve through disk
+    jax.clear_caches()
+    again = _session(buckets=(8,))
+    again.warm()
+    out = again.decode(_synd(8, np.random.default_rng(0)))
+    assert out.corrections.shape[0] == 8
+    stats = progcache.stats()
+    assert stats["load_errors"] == stats0["load_errors"] + 1
+    assert stats["stores"] == stats0["stores"] + 1  # REPLACED
+    [art2] = list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX))
+    with open(art2, "rb") as fh:
+        assert pickle.load(fh)["schema"] == 1  # valid again
+
+
+def test_fingerprint_mismatch_is_miss_not_crash(tmp_path):
+    progcache.configure(str(tmp_path))
+    sess = _session(buckets=(8,))
+    sess.warm()
+    [art] = list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX))
+    with open(art, "rb") as fh:
+        doc = pickle.load(fh)
+    doc["meta"]["fingerprint"] = {"jaxlib": "9.9.9"}  # foreign toolchain
+    with open(art, "wb") as fh:
+        pickle.dump(doc, fh)
+    stats0 = progcache.stats()
+
+    progcache.clear_memory()
+    jax.clear_caches()
+    again = _session(buckets=(8,))
+    again.warm()  # miss -> recompile; never deserializes foreign payloads
+    assert again.compiles == 1
+    stats = progcache.stats()
+    assert stats["fingerprint_rejects"] == stats0["fingerprint_rejects"] + 1
+    assert stats["load_errors"] == stats0["load_errors"]
+
+
+def test_stale_artifact_invalidation_evicts_disk(tmp_path):
+    """``invalidate()`` default keeps artifacts (dead device buffers —
+    the program description is still right); ``stale_artifact=True``
+    evicts the warm keys' disk entries too."""
+    progcache.configure(str(tmp_path))
+    sess = _session(buckets=(8,))
+    sess.warm()
+    assert len(list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX))) == 1
+    sess.invalidate()  # dead buffers: disk survives
+    assert len(list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX))) == 1
+    sess.warm()
+    sess.invalidate(stale_artifact=True)  # suspect program: disk evicted
+    assert list(tmp_path.rglob("*" + progcache.ARTIFACT_SUFFIX)) == []
+
+
+# ---------------------------------------------------------------------------
+# single-flight
+# ---------------------------------------------------------------------------
+def test_concurrent_cold_start_single_flight(tmp_path):
+    """N threads racing one key: exactly ONE lower+compile happens; the
+    losers block on the winner and share its program."""
+    progcache.configure(str(tmp_path))
+    lowers = []
+    lock = threading.Lock()
+    inner = jax.jit(lambda x: x * 2)
+
+    class CountingJit:
+        def lower(self, *a, **k):
+            with lock:
+                lowers.append(1)
+            return inner.lower(*a, **k)
+
+    results, errors = [], []
+    barrier = threading.Barrier(6)
+
+    def racer():
+        try:
+            barrier.wait(timeout=30)
+            compiled, source = progcache.compile_cached(
+                CountingJit(), (jnp.arange(4.0),),
+                kind="t.race", parts={"shape": (4,)})
+            results.append((np.asarray(compiled(jnp.arange(4.0))), source))
+        except Exception as exc:  # noqa: BLE001 — surfaced below
+            errors.append(exc)
+
+    threads = [threading.Thread(target=racer) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    assert len(lowers) == 1
+    assert sum(1 for _r, s in results if s == "compile") == 1
+    assert sum(1 for _r, s in results if s == "mem") == 5
+    for r, _s in results:
+        assert np.array_equal(r, np.arange(4.0) * 2)
+    assert progcache.stats()["misses"] == 1
+
+
+# ---------------------------------------------------------------------------
+# fleet warm-start push under host_kill chaos
+# ---------------------------------------------------------------------------
+def test_fleet_handoff_warm_push_end_to_end(tmp_path):
+    """ISSUE 20 acceptance: a seeded ``host_kill`` against a COLD 2-host
+    fleet with the program cache active.  The router pre-pushes the dying
+    family's program keys alongside the journal; the successor loads them
+    at adopt time (``serve.session.warm_loads``, no misses) so the first
+    adopted frame finds its program resident — and the storm stays
+    exactly-once, bit-exact vs the offline decode."""
+    resilience.set_default_policy(FAST_POLICY)
+    telemetry.enable()
+    progcache.configure(str(tmp_path))
+    reqs = 10
+    fleet = LocalFleet(
+        lambda: {"hgp_rep3": _session(buckets=(8, 32))},
+        n_hosts=2, warm=False)
+    try:
+        host, port = fleet.address
+        plan = faultinject.FaultPlan([
+            faultinject.Fault(site="fleet_host_tick", kind="host_kill",
+                              after=reqs)], seed=20)
+        rng = np.random.default_rng(20)
+        answered = []
+        with plan.active(), DecodeClient(host, port, reconnect=True,
+                                         timeout=60.0) as cli:
+            for _ in range(3 * reqs):
+                synd = _synd(int(rng.integers(1, 8)), rng)
+                res = cli.submit("hgp_rep3", synd).result(timeout=120)
+                answered.append((synd, res.corrections))
+                fleet.chaos_tick()
+        assert _counter("serve.host_kills") == 1
+        assert _counter("router.handoffs") >= 1
+        assert _counter("router.program_pushes") >= 1
+        assert _counter("serve.session.warm_loads") >= 1
+        assert _counter("serve.session.warm_load_misses") == 0
+        assert len(answered) == 3 * reqs  # exactly once
+        synd = np.concatenate([s for s, _ in answered])
+        served = np.concatenate([c for _, c in answered])
+        offline = BP_CLS.GetDecoder(_params()).decode_batch(synd)
+        assert np.array_equal(served, offline)
+    finally:
+        fleet.stop()
